@@ -1,0 +1,209 @@
+"""HTTP(S) extender server: routing, middleware, and mTLS.
+
+Route and middleware parity with the reference (extender/scheduler.go):
+  * routes ``/scheduler/{prioritize,filter,bind}`` plus a 404 catch-all
+    (scheduler.go:86-91);
+  * middleware chain content-type -> length -> method: a request whose
+    ``Content-Type`` is not exactly ``application/json`` gets 404
+    (scheduler.go:41-52), a body over 1 GB gets 500 (scheduler.go:28-38),
+    a non-POST gets 405 (scheduler.go:15-26);
+  * TLS: >=1.2, ECDHE-{RSA,ECDSA}-AES256-GCM-SHA384 cipher pinning, required
+    and verified client certificates against a CA pool, 5 s read-header /
+    10 s write timeouts (scheduler.go:110-143).
+"""
+
+from __future__ import annotations
+
+import ssl
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, TYPE_CHECKING
+
+from platform_aware_scheduling_tpu.utils import klog
+
+if TYPE_CHECKING:  # pragma: no cover
+    from platform_aware_scheduling_tpu.extender.types import Scheduler
+
+MAX_CONTENT_LENGTH = 1 * 1000 * 1000 * 1000  # 1 GB (scheduler.go:30)
+READ_HEADER_TIMEOUT_S = 5.0
+WRITE_TIMEOUT_S = 10.0
+
+
+@dataclass
+class HTTPRequest:
+    method: str
+    path: str
+    headers: Dict[str, str]
+    body: bytes
+
+    def header(self, name: str) -> str:
+        # HTTP header names are case-insensitive
+        for k, v in self.headers.items():
+            if k.lower() == name.lower():
+                return v
+        return ""
+
+
+@dataclass
+class HTTPResponse:
+    status: int = 200
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    @classmethod
+    def json(cls, body: bytes, status: int = 200) -> "HTTPResponse":
+        return cls(status=status, headers={"Content-Type": "application/json"}, body=body)
+
+
+def not_found_handler(request: HTTPRequest) -> HTTPResponse:
+    """404 catch-all for unknown paths (scheduler.go:79-84)."""
+    klog.v(2).info_s(
+        f"Requested resource: '{request.path}' not found", component="extender"
+    )
+    return HTTPResponse(status=404, headers={"Content-Type": "application/json"})
+
+
+def apply_middleware(handler, request: HTTPRequest) -> HTTPResponse:
+    """content-type -> content-length -> POST-only prechecks (scheduler.go:69-75).
+
+    The content-type check is an exact string comparison, as in the reference
+    (so ``application/json; charset=utf-8`` is rejected)."""
+    if request.header("Content-Type") != "application/json":
+        klog.v(2).info_s("request content type not application/json", component="extender")
+        return HTTPResponse(status=404)
+    if len(request.body) > MAX_CONTENT_LENGTH:
+        klog.v(2).info_s("request size too large", component="extender")
+        return HTTPResponse(status=500)
+    if request.method != "POST":
+        klog.v(2).info_s("method Type not POST", component="extender")
+        return HTTPResponse(status=405)
+    return handler(request)
+
+
+class Server:
+    """Wraps a Scheduler implementation with the HTTP(S) extender endpoint
+    (reference extender/types.go:18-20, scheduler.go:86-143)."""
+
+    def __init__(self, scheduler: "Scheduler"):
+        self.scheduler = scheduler
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._ready = threading.Event()
+
+    # -- routing -------------------------------------------------------------
+
+    def route(self, request: HTTPRequest) -> HTTPResponse:
+        routes = {
+            "/scheduler/prioritize": self.scheduler.prioritize,
+            "/scheduler/filter": self.scheduler.filter,
+            "/scheduler/bind": self.scheduler.bind,
+        }
+        handler = routes.get(request.path, not_found_handler)
+        return apply_middleware(handler, request)
+
+    # -- serving -------------------------------------------------------------
+
+    def start_server(
+        self,
+        port: str,
+        cert_file: str = "",
+        key_file: str = "",
+        ca_file: str = "",
+        unsafe: bool = False,
+        host: str = "",
+        block: bool = True,
+    ) -> None:
+        """Start serving; mirrors ``Server.StartServer`` (scheduler.go:86-108).
+
+        With ``unsafe=True`` serves plain HTTP; otherwise mutual-TLS with the
+        pinned configuration.  ``block=False`` serves on a daemon thread
+        (callers use :meth:`wait_ready` / :meth:`shutdown`)."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            timeout = READ_HEADER_TIMEOUT_S
+
+            def _handle(self) -> None:
+                length = int(self.headers.get("Content-Length") or 0)
+                if length > MAX_CONTENT_LENGTH:
+                    # refuse to slurp oversized bodies; parity with the
+                    # ContentLength middleware check
+                    self.send_response(500)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                body = self.rfile.read(length) if length > 0 else b""
+                request = HTTPRequest(
+                    method=self.command,
+                    path=self.path,
+                    headers=dict(self.headers.items()),
+                    body=body,
+                )
+                response = server.route(request)
+                self.send_response(response.status)
+                for k, v in response.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(response.body)))
+                self.end_headers()
+                if response.body:
+                    self.wfile.write(response.body)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _handle
+
+            def log_message(self, fmt, *args):  # route through klog instead
+                klog.v(5).infof("http: " + fmt, *args)
+
+        httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        httpd.daemon_threads = True
+        httpd.timeout = WRITE_TIMEOUT_S
+
+        if unsafe:
+            klog.v(2).info_s(f"Extender Listening on HTTP {port}", component="extender")
+        else:
+            context = configure_secure_context(cert_file, key_file, ca_file)
+            httpd.socket = context.wrap_socket(httpd.socket, server_side=True)
+            klog.v(2).info_s(f"Extender Listening on HTTPS {port}", component="extender")
+
+        self._httpd = httpd
+        self._ready.set()
+        if block:
+            httpd.serve_forever()
+        else:
+            thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+            thread.start()
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def wait_ready(self, timeout: float = 10.0) -> bool:
+        return self._ready.wait(timeout)
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._ready.clear()
+
+
+def configure_secure_context(
+    cert_file: str, key_file: str, ca_file: str
+) -> ssl.SSLContext:
+    """The mTLS configuration of ``configureSecureServer`` (scheduler.go:110-143):
+    TLS >= 1.2, pinned AES-256-GCM ECDHE suites, client certs required and
+    verified against the CA pool."""
+    context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    context.minimum_version = ssl.TLSVersion.TLSv1_2
+    context.verify_mode = ssl.CERT_REQUIRED
+    try:
+        context.load_verify_locations(cafile=ca_file)
+    except (OSError, ssl.SSLError) as exc:
+        klog.v(2).info_s(f"caCert read failed: {exc}", component="extender")
+    context.load_cert_chain(certfile=cert_file, keyfile=key_file)
+    # TLS 1.2 suites pinned as in the reference; TLS 1.3 suites are not
+    # configurable (same stance as Go's CipherSuites field).
+    context.set_ciphers("ECDHE-RSA-AES256-GCM-SHA384:ECDHE-ECDSA-AES256-GCM-SHA384")
+    return context

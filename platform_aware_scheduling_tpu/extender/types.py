@@ -1,0 +1,188 @@
+"""Scheduler-extender protocol wire types.
+
+JSON field names are the Go-default (capitalized) names of the reference's
+re-implemented upstream types (reference extender/types.go:22-82): ``Args``
+carries ``Pod`` / ``Nodes`` / ``NodeNames``; ``FilterResult`` carries
+``Nodes`` / ``NodeNames`` / ``FailedNodes`` / ``Error``; priorities are
+``[{"Host": .., "Score": ..}]``; bindings use ``PodName`` / ``PodNamespace``
+/ ``PodUID`` / ``Node``.  Node objects are passed through as raw dicts so
+responses round-trip the scheduler's own node JSON exactly.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Protocol
+
+from platform_aware_scheduling_tpu.kube.objects import Node, Pod
+
+
+class DecodeError(ValueError):
+    """Raised when a request body cannot be decoded into the expected type."""
+
+
+@dataclass
+class Args:
+    """Arguments for Filter/Prioritize (reference extender/types.go:41-50)."""
+
+    pod: Pod
+    # populated when the extender is registered nodeCacheCapable: false
+    nodes: Optional[List[Node]]
+    # populated when the extender is registered nodeCacheCapable: true
+    node_names: Optional[List[str]]
+
+    @classmethod
+    def from_json(cls, body: bytes) -> "Args":
+        try:
+            obj = json.loads(body)
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            raise DecodeError(f"error decoding request: {exc}") from exc
+        if not isinstance(obj, dict):
+            raise DecodeError("error decoding request: not an object")
+        pod = Pod(obj.get("Pod") or {})
+        nodes_obj = obj.get("Nodes")
+        nodes = None
+        if nodes_obj is not None:
+            items = nodes_obj.get("items")
+            nodes = [Node(item) for item in (items or [])]
+        node_names = obj.get("NodeNames")
+        return cls(pod=pod, nodes=nodes, node_names=node_names)
+
+    def to_json(self) -> bytes:
+        nodes = None
+        if self.nodes is not None:
+            nodes = {"metadata": {}, "items": [n.raw for n in self.nodes]}
+        return json.dumps(
+            {"Pod": self.pod.raw, "Nodes": nodes, "NodeNames": self.node_names}
+        ).encode()
+
+
+@dataclass
+class HostPriority:
+    """Priority of one host; higher is better (reference extender/types.go:26)."""
+
+    host: str
+    score: int
+
+    def to_obj(self) -> Dict[str, Any]:
+        return {"Host": self.host, "Score": self.score}
+
+
+def encode_host_priority_list(items: List[HostPriority]) -> bytes:
+    return (json.dumps([hp.to_obj() for hp in items]) + "\n").encode()
+
+
+def decode_host_priority_list(body: bytes) -> List[HostPriority]:
+    obj = json.loads(body)
+    if obj is None:
+        return []
+    return [HostPriority(host=e["Host"], score=e["Score"]) for e in obj]
+
+
+@dataclass
+class FilterResult:
+    """Filter verb response (reference extender/types.go:53-64)."""
+
+    nodes: Optional[List[Node]] = None
+    node_names: Optional[List[str]] = None
+    failed_nodes: Dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    def to_obj(self) -> Dict[str, Any]:
+        nodes = None
+        if self.nodes is not None:
+            items = [n.raw for n in self.nodes] if self.nodes else None
+            nodes = {"metadata": {}, "items": items}
+        return {
+            "Nodes": nodes,
+            "NodeNames": self.node_names,
+            "FailedNodes": self.failed_nodes if self.failed_nodes is not None else None,
+            "Error": self.error,
+        }
+
+    def to_json(self) -> bytes:
+        return (json.dumps(self.to_obj()) + "\n").encode()
+
+    @classmethod
+    def from_json(cls, body: bytes) -> "FilterResult":
+        obj = json.loads(body)
+        nodes = None
+        nodes_obj = obj.get("Nodes")
+        if nodes_obj is not None:
+            nodes = [Node(item) for item in (nodes_obj.get("items") or [])]
+        return cls(
+            nodes=nodes,
+            node_names=obj.get("NodeNames"),
+            failed_nodes=obj.get("FailedNodes") or {},
+            error=obj.get("Error") or "",
+        )
+
+
+@dataclass
+class BindingArgs:
+    """Bind verb arguments (reference extender/types.go:67-76)."""
+
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    node: str
+
+    @classmethod
+    def from_json(cls, body: bytes) -> "BindingArgs":
+        try:
+            obj = json.loads(body)
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            raise DecodeError(f"error decoding request: {exc}") from exc
+        if not isinstance(obj, dict):
+            raise DecodeError("error decoding request: not an object")
+        return cls(
+            pod_name=obj.get("PodName", ""),
+            pod_namespace=obj.get("PodNamespace", ""),
+            pod_uid=obj.get("PodUID", ""),
+            node=obj.get("Node", ""),
+        )
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "PodName": self.pod_name,
+                "PodNamespace": self.pod_namespace,
+                "PodUID": self.pod_uid,
+                "Node": self.node,
+            }
+        ).encode()
+
+
+@dataclass
+class BindingResult:
+    """Bind verb response (reference extender/types.go:79-82)."""
+
+    error: str = ""
+
+    def to_json(self) -> bytes:
+        return (json.dumps({"Error": self.error}) + "\n").encode()
+
+    @classmethod
+    def from_json(cls, body: bytes) -> "BindingResult":
+        obj = json.loads(body)
+        return cls(error=obj.get("Error") or "")
+
+
+class Scheduler(Protocol):
+    """The three scheduler verbs an extender implements
+    (reference extender/types.go:11-15).  Handlers receive the parsed HTTP
+    request and return the response to send."""
+
+    def filter(self, request: "HTTPRequest") -> "HTTPResponse": ...
+
+    def prioritize(self, request: "HTTPRequest") -> "HTTPResponse": ...
+
+    def bind(self, request: "HTTPRequest") -> "HTTPResponse": ...
+
+
+# imported late to avoid a cycle; re-exported for typing convenience
+from platform_aware_scheduling_tpu.extender.server import (  # noqa: E402
+    HTTPRequest,
+    HTTPResponse,
+)

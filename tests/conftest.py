@@ -1,0 +1,21 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip shardings compile and
+execute without TPU hardware (the driver separately dry-runs the multi-chip
+path via ``__graft_entry__.dryrun_multichip``).  The env vars must be set
+before JAX is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# repo root on sys.path so `import platform_aware_scheduling_tpu` works
+# without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,246 @@
+"""Wire-layer tests: middleware parity, routing, and JSON round-trips
+(modeled on the reference's httptest-driven handler tests,
+telemetry-aware-scheduling/pkg/telemetryscheduler/scheduler_test.go)."""
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    HTTPResponse,
+    Server,
+    apply_middleware,
+)
+from platform_aware_scheduling_tpu.extender.types import (
+    Args,
+    BindingArgs,
+    BindingResult,
+    FilterResult,
+    HostPriority,
+    decode_host_priority_list,
+    encode_host_priority_list,
+)
+from platform_aware_scheduling_tpu.kube.objects import Node, Pod
+
+
+class EchoScheduler:
+    """Records calls; returns canned bodies."""
+
+    def __init__(self):
+        self.calls = []
+
+    def filter(self, request):
+        self.calls.append(("filter", request.body))
+        return HTTPResponse.json(b'{"Error": ""}')
+
+    def prioritize(self, request):
+        self.calls.append(("prioritize", request.body))
+        return HTTPResponse.json(b"[]")
+
+    def bind(self, request):
+        self.calls.append(("bind", request.body))
+        return HTTPResponse.json(b'{"Error": ""}')
+
+
+def make_request(method="POST", path="/scheduler/filter", content_type="application/json", body=b"{}"):
+    headers = {}
+    if content_type is not None:
+        headers["Content-Type"] = content_type
+    return HTTPRequest(method=method, path=path, headers=headers, body=body)
+
+
+class TestMiddleware:
+    """Status-code parity with extender/scheduler.go:15-52."""
+
+    def handler(self, request):
+        return HTTPResponse(status=200, body=b"ok")
+
+    def test_wrong_content_type_404(self):
+        resp = apply_middleware(self.handler, make_request(content_type="text/plain"))
+        assert resp.status == 404
+
+    def test_content_type_with_charset_rejected(self):
+        # exact string comparison, as in the reference
+        resp = apply_middleware(
+            self.handler, make_request(content_type="application/json; charset=utf-8")
+        )
+        assert resp.status == 404
+
+    def test_missing_content_type_404(self):
+        resp = apply_middleware(self.handler, make_request(content_type=None))
+        assert resp.status == 404
+
+    def test_oversized_body_500(self):
+        req = make_request()
+        req.body = b"x"  # fake the size via a slotted override of len check
+        big = HTTPRequest(req.method, req.path, req.headers, b"0" * 10)
+        big.body = b"0" * 10
+        # build a request whose body exceeds 1 GB without allocating one:
+        class FakeBody(bytes):
+            def __len__(self):
+                return 2 * 1000 * 1000 * 1000
+
+        big.body = FakeBody()
+        resp = apply_middleware(self.handler, big)
+        assert resp.status == 500
+
+    def test_non_post_405(self):
+        resp = apply_middleware(self.handler, make_request(method="GET"))
+        assert resp.status == 405
+
+    def test_ok_passthrough(self):
+        resp = apply_middleware(self.handler, make_request())
+        assert resp.status == 200 and resp.body == b"ok"
+
+
+class TestRouting:
+    def test_known_routes_dispatch(self):
+        scheduler = EchoScheduler()
+        server = Server(scheduler)
+        for verb in ("filter", "prioritize", "bind"):
+            resp = server.route(make_request(path=f"/scheduler/{verb}"))
+            assert resp.status == 200
+        assert [c[0] for c in scheduler.calls] == ["filter", "prioritize", "bind"]
+
+    def test_unknown_path_404_with_json_header(self):
+        server = Server(EchoScheduler())
+        resp = server.route(make_request(path="/nope"))
+        assert resp.status == 404
+        assert resp.headers.get("Content-Type") == "application/json"
+
+
+class TestWireTypes:
+    def test_args_roundtrip(self):
+        pod = Pod({"metadata": {"name": "p1", "namespace": "default",
+                                "labels": {"telemetry-policy": "pol"}}})
+        nodes = [Node({"metadata": {"name": "node1"}}),
+                 Node({"metadata": {"name": "node2"}})]
+        args = Args(pod=pod, nodes=nodes, node_names=None)
+        decoded = Args.from_json(args.to_json())
+        assert decoded.pod.name == "p1"
+        assert decoded.pod.get_labels()["telemetry-policy"] == "pol"
+        assert [n.name for n in decoded.nodes] == ["node1", "node2"]
+        assert decoded.node_names is None
+
+    def test_args_node_names_mode(self):
+        args = Args.from_json(json.dumps(
+            {"Pod": {"metadata": {"name": "p"}}, "Nodes": None,
+             "NodeNames": ["a", "b"]}).encode())
+        assert args.nodes is None
+        assert args.node_names == ["a", "b"]
+
+    def test_host_priority_list_roundtrip(self):
+        hps = [HostPriority("node1", 10), HostPriority("node2", 9)]
+        body = encode_host_priority_list(hps)
+        obj = json.loads(body)
+        assert obj == [{"Host": "node1", "Score": 10}, {"Host": "node2", "Score": 9}]
+        assert decode_host_priority_list(body) == hps
+
+    def test_filter_result_shape(self):
+        result = FilterResult(
+            nodes=[Node({"metadata": {"name": "n1"}})],
+            node_names=["n1", ""],
+            failed_nodes={"n2": "Node violates"},
+            error="",
+        )
+        obj = json.loads(result.to_json())
+        assert obj["Nodes"]["items"][0]["metadata"]["name"] == "n1"
+        assert obj["NodeNames"] == ["n1", ""]
+        assert obj["FailedNodes"] == {"n2": "Node violates"}
+        assert obj["Error"] == ""
+
+    def test_binding_args_decode(self):
+        args = BindingArgs.from_json(json.dumps(
+            {"PodName": "p", "PodNamespace": "ns", "PodUID": "u1", "Node": "n1"}
+        ).encode())
+        assert (args.pod_name, args.pod_namespace, args.pod_uid, args.node) == (
+            "p", "ns", "u1", "n1")
+
+    def test_binding_result(self):
+        assert json.loads(BindingResult().to_json()) == {"Error": ""}
+        assert BindingResult.from_json(b'{"Error": "boom"}').error == "boom"
+
+
+class TestLiveServer:
+    """End-to-end over a real socket (unsafe/plain-HTTP mode)."""
+
+    @pytest.fixture()
+    def server(self):
+        scheduler = EchoScheduler()
+        server = Server(scheduler)
+        server.start_server(port="0", unsafe=True, host="127.0.0.1", block=False)
+        assert server.wait_ready()
+        yield server, scheduler
+        server.shutdown()
+
+    def post(self, port, path, body=b"{}", content_type="application/json"):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        headers = {"Content-Type": content_type} if content_type else {}
+        conn.request("POST", path, body=body, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+    def test_post_filter(self, server):
+        srv, scheduler = server
+        status, data = self.post(srv.port, "/scheduler/filter")
+        assert status == 200
+        assert json.loads(data) == {"Error": ""}
+        assert scheduler.calls[0][0] == "filter"
+
+    def test_unknown_path(self, server):
+        srv, _ = server
+        status, _ = self.post(srv.port, "/bogus")
+        assert status == 404
+
+    def test_wrong_content_type(self, server):
+        srv, _ = server
+        status, _ = self.post(srv.port, "/scheduler/filter", content_type="text/plain")
+        assert status == 404
+
+    def test_get_rejected(self, server):
+        srv, _ = server
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+        conn.request("GET", "/scheduler/filter", headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 405
+
+    def test_concurrent_posts(self, server):
+        srv, scheduler = server
+        errors = []
+
+        def worker():
+            try:
+                status, _ = self.post(srv.port, "/scheduler/prioritize")
+                assert status == 200
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(scheduler.calls) == 8
+
+
+class TestDuration:
+    def test_parse(self):
+        from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+        assert parse_duration("5s") == 5.0
+        assert parse_duration("2s") == 2.0
+        assert parse_duration("100ms") == 0.1
+        assert parse_duration("1.5h") == 5400.0
+        assert parse_duration("1m30s") == 90.0
+        with pytest.raises(ValueError):
+            parse_duration("5")
+        with pytest.raises(ValueError):
+            parse_duration("")

@@ -1,0 +1,107 @@
+"""Quantity semantics parity with k8s resource.Quantity
+(reference operator.go CmpInt64 usage; gpuscheduler AsInt64 usage)."""
+
+from fractions import Fraction
+
+import pytest
+
+from platform_aware_scheduling_tpu.utils.quantity import (
+    Quantity,
+    QuantityParseError,
+)
+
+
+class TestParse:
+    @pytest.mark.parametrize(
+        "text,expected",
+        [
+            ("0", 0),
+            ("1", 1),
+            ("-1", -1),
+            ("+5", 5),
+            ("100", 100),
+            ("9999", 9999),
+            ("1k", 1000),
+            ("1M", 10**6),
+            ("1G", 10**9),
+            ("1T", 10**12),
+            ("1P", 10**15),
+            ("1E", 10**18),
+            ("1Ki", 1024),
+            ("1Mi", 1024**2),
+            ("1Gi", 1024**3),
+            ("1Ti", 1024**4),
+            ("128Mi", 128 * 1024**2),
+            ("500m", Fraction(1, 2)),
+            ("250m", Fraction(1, 4)),
+            ("100u", Fraction(1, 10**4)),
+            ("100n", Fraction(1, 10**7)),
+            ("1e3", 1000),
+            ("1E3", 1000),
+            ("1e-3", Fraction(1, 1000)),
+            ("2.5", Fraction(5, 2)),
+            ("2.5Gi", Fraction(5, 2) * 1024**3),
+            ("0.1", Fraction(1, 10)),
+            (".5", Fraction(1, 2)),
+            ("5.", 5),
+            ("-500m", Fraction(-1, 2)),
+            ("104857600000m", 104857600),
+        ],
+    )
+    def test_parse_values(self, text, expected):
+        assert Quantity(text).value == Fraction(expected)
+
+    @pytest.mark.parametrize("text", ["", "abc", "1X", "--1", "1.2.3", "Ki", "1 Ki", "e3"])
+    def test_parse_errors(self, text):
+        with pytest.raises(QuantityParseError):
+            Quantity(text)
+
+    def test_parse_int_and_float(self):
+        assert Quantity(42).value == 42
+        assert Quantity(0.5).value == Fraction(1, 2)
+
+
+class TestCmp:
+    def test_cmp_int64(self):
+        assert Quantity("100").cmp_int64(100) == 0
+        assert Quantity("99").cmp_int64(100) == -1
+        assert Quantity("101").cmp_int64(100) == 1
+        # milli-precision comparisons are exact
+        assert Quantity("100001m").cmp_int64(100) == 1
+        assert Quantity("99999m").cmp_int64(100) == -1
+        assert Quantity("100000m").cmp_int64(100) == 0
+
+    def test_cmp_quantity(self):
+        assert Quantity("1Gi").cmp(Quantity("1G")) == 1  # 1073741824 > 1e9
+        assert Quantity("500m").cmp(Quantity("0.5")) == 0
+        assert Quantity("1").cmp(Quantity("2")) == -1
+
+    def test_cmp_huge(self):
+        huge = str(2**63 - 1)
+        assert Quantity(huge).cmp_int64(2**63 - 1) == 0
+        assert Quantity(huge + "000m").cmp_int64(2**63 - 1) == 0
+
+
+class TestAccessors:
+    def test_as_int64(self):
+        assert Quantity("5").as_int64() == (5, True)
+        assert Quantity("1Ki").as_int64() == (1024, True)
+        # fractional value: (0, False) like Go AsInt64
+        assert Quantity("500m").as_int64() == (0, False)
+        # out of range
+        assert Quantity(str(2**64)).as_int64() == (0, False)
+
+    def test_milli_value_exact(self):
+        assert Quantity("5").milli_value_exact() == (5000, True)
+        assert Quantity("500m").milli_value_exact() == (500, True)
+        v, exact = Quantity("1u").milli_value_exact()  # sub-milli -> inexact
+        assert not exact and v == 0
+        v, exact = Quantity(str(2**63)).milli_value_exact()  # overflow clamps
+        assert not exact and v == 2**63 - 1
+
+    def test_as_dec(self):
+        assert Quantity("1Ki").as_dec() == "1024"
+        assert Quantity("5").as_dec() == "5"
+
+    def test_str_roundtrip(self):
+        assert str(Quantity("128Mi")) == "128Mi"
