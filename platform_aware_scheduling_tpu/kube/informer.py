@@ -1,0 +1,175 @@
+"""List-watch informers with client-go replay/resync semantics.
+
+Both schedulers hang their state off informers: TAS watches the TASPolicy CRD
+(reference pkg/controller/controller.go:38-57) and GAS watches pods/nodes
+(reference node_resource_cache.go:93-141).  The semantics reproduced here:
+
+  * initial list delivers ADDED for every object, then the watch stream
+    delivers ADDED/MODIFIED/DELETED;
+  * a broken watch re-lists and delta-syncs: new objects -> add, changed ->
+    update, vanished -> delete wrapped in ``DeletedFinalStateUnknown``
+    (which GAS's filter unwraps, reference node_resource_cache.go:146-158);
+  * a resync period re-delivers update(obj, obj) for everything cached —
+    this is the replay that rebuilds GAS state after restart (survey §3.7).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from platform_aware_scheduling_tpu.utils import klog
+
+
+@dataclass
+class DeletedFinalStateUnknown:
+    """Stand-in delivered when an object vanished during a watch gap."""
+
+    key: str
+    obj: Any
+
+
+class ListWatch:
+    """A pair of callables: ``list() -> (objects, resource_version)`` and
+    ``watch(resource_version) -> iterator of (event_type, obj)``."""
+
+    def __init__(
+        self,
+        list_func: Callable[[], Tuple[List[Any], str]],
+        watch_func: Callable[[str], Iterator[Tuple[str, Any]]],
+        key_func: Callable[[Any], str],
+    ):
+        self.list = list_func
+        self.watch = watch_func
+        self.key = key_func
+
+
+class Informer:
+    def __init__(
+        self,
+        list_watch: ListWatch,
+        on_add: Optional[Callable[[Any], None]] = None,
+        on_update: Optional[Callable[[Any, Any], None]] = None,
+        on_delete: Optional[Callable[[Any], None]] = None,
+        resync_period: float = 0.0,
+        filter_func: Optional[Callable[[Any], bool]] = None,
+    ):
+        self._lw = list_watch
+        self._on_add = on_add or (lambda obj: None)
+        self._on_update = on_update or (lambda old, new: None)
+        self._on_delete = on_delete or (lambda obj: None)
+        self._resync_period = resync_period
+        self._filter = filter_func
+        self._store: Dict[str, Any] = {}
+        self._store_lock = threading.RLock()
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._resource_version = ""
+
+    # -- store reads (the "lister") ------------------------------------------
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._store_lock:
+            return self._store.get(key)
+
+    def list(self) -> List[Any]:
+        with self._store_lock:
+            return list(self._store.values())
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- event plumbing ------------------------------------------------------
+
+    def _passes(self, obj: Any) -> bool:
+        return self._filter is None or bool(self._filter(obj))
+
+    def _dispatch_add(self, obj: Any) -> None:
+        if self._passes(obj):
+            self._on_add(obj)
+
+    def _dispatch_update(self, old: Any, new: Any) -> None:
+        if self._passes(new):
+            self._on_update(old, new)
+
+    def _dispatch_delete(self, obj: Any) -> None:
+        if self._passes(obj):
+            self._on_delete(obj)
+
+    def _relist(self, initial: bool) -> None:
+        objects, rv = self._lw.list()
+        new_state = {self._lw.key(obj): obj for obj in objects}
+        with self._store_lock:
+            old_state = dict(self._store)
+            self._store = dict(new_state)
+            self._resource_version = rv
+        for key, obj in new_state.items():
+            if key not in old_state:
+                self._dispatch_add(obj)
+            elif old_state[key] != obj:
+                self._dispatch_update(old_state[key], obj)
+        for key, obj in old_state.items():
+            if key not in new_state:
+                if initial:
+                    self._dispatch_delete(obj)
+                else:
+                    self._dispatch_delete(DeletedFinalStateUnknown(key=key, obj=obj))
+
+    def _run(self) -> None:
+        last_resync = time.monotonic()
+        first = True
+        while not self._stop.is_set():
+            try:
+                self._relist(initial=first)
+                first = False
+                self._synced.set()
+                for event_type, obj in self._lw.watch(self._resource_version):
+                    if self._stop.is_set():
+                        return
+                    key = self._lw.key(obj)
+                    if event_type == "ADDED":
+                        with self._store_lock:
+                            old = self._store.get(key)
+                            self._store[key] = obj
+                        if old is None:
+                            self._dispatch_add(obj)
+                        else:
+                            self._dispatch_update(old, obj)
+                    elif event_type == "MODIFIED":
+                        with self._store_lock:
+                            old = self._store.get(key)
+                            self._store[key] = obj
+                        self._dispatch_update(old, obj)
+                    elif event_type == "DELETED":
+                        with self._store_lock:
+                            self._store.pop(key, None)
+                        self._dispatch_delete(obj)
+                    if (
+                        self._resync_period > 0
+                        and time.monotonic() - last_resync > self._resync_period
+                    ):
+                        last_resync = time.monotonic()
+                        for cached in self.list():
+                            self._dispatch_update(cached, cached)
+            except StopIteration:
+                continue
+            except Exception as exc:  # watch broke: back off, re-list
+                if self._stop.is_set():
+                    return
+                klog.v(4).info_s(f"informer watch error, relisting: {exc}")
+                self._stop.wait(0.2)

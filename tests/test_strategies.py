@@ -1,0 +1,222 @@
+"""Strategy-layer tests (reference pkg/strategies/*/ *_test.go)."""
+
+import pytest
+
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicyRule
+from platform_aware_scheduling_tpu.tas.strategies import (
+    core,
+    deschedule,
+    dontschedule,
+    scheduleonmetric,
+)
+from platform_aware_scheduling_tpu.testing.builders import make_node
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+
+def metric_cache(**metrics):
+    """metrics: name -> {node: value-string}"""
+    cache = AutoUpdatingCache()
+    for name, values in metrics.items():
+        cache.write_metric(name, None)
+        cache.write_metric(
+            name, {n: NodeMetric(value=Quantity(v)) for n, v in values.items()}
+        )
+    return cache
+
+
+class TestEvaluateRule:
+    """operator.go:13-26 parity (reference operator_test.go)."""
+
+    @pytest.mark.parametrize(
+        "value,op,target,expected",
+        [
+            ("9", "LessThan", 10, True),
+            ("10", "LessThan", 10, False),
+            ("11", "GreaterThan", 10, True),
+            ("10", "GreaterThan", 10, False),
+            ("10", "Equals", 10, True),
+            ("9", "Equals", 10, False),
+            # milli-precision exactness
+            ("9999m", "LessThan", 10, True),
+            ("10001m", "GreaterThan", 10, True),
+            ("10000m", "Equals", 10, True),
+        ],
+    )
+    def test_operators(self, value, op, target, expected):
+        rule = TASPolicyRule(metricname="m", operator=op, target=target)
+        assert core.evaluate_rule(Quantity(value), rule) is expected
+
+    def test_unknown_operator_raises(self):
+        rule = TASPolicyRule(metricname="m", operator="Near", target=10)
+        with pytest.raises(KeyError):
+            core.evaluate_rule(Quantity("1"), rule)
+
+
+class TestOrderedList:
+    def info(self):
+        return {
+            "a": NodeMetric(value=Quantity("30")),
+            "b": NodeMetric(value=Quantity("10")),
+            "c": NodeMetric(value=Quantity("20")),
+        }
+
+    def test_greater_than_descending(self):
+        out = core.ordered_list(self.info(), "GreaterThan")
+        assert [m.node_name for m in out] == ["a", "c", "b"]
+
+    def test_less_than_ascending(self):
+        out = core.ordered_list(self.info(), "LessThan")
+        assert [m.node_name for m in out] == ["b", "c", "a"]
+
+    def test_other_operator_input_order(self):
+        out = core.ordered_list(self.info(), "Equals")
+        assert [m.node_name for m in out] == ["a", "b", "c"]
+
+
+def ds_strategy(policy="pol", rules=None):
+    return dontschedule.Strategy(
+        policy_name=policy,
+        rules=rules
+        or [TASPolicyRule(metricname="filter1", operator="GreaterThan", target=10)],
+    )
+
+
+class TestDontSchedule:
+    def test_violated_or_semantics(self):
+        cache = metric_cache(
+            filter1={"node1": "5", "node2": "20"},
+            filter2={"node1": "100", "node2": "0"},
+        )
+        strategy = dontschedule.Strategy(
+            policy_name="pol",
+            rules=[
+                TASPolicyRule("filter1", "GreaterThan", 10),
+                TASPolicyRule("filter2", "GreaterThan", 50),
+            ],
+        )
+        # node2 violates rule1, node1 violates rule2 -> both in the set
+        assert set(strategy.violated(cache)) == {"node1", "node2"}
+
+    def test_missing_metric_skipped(self):
+        cache = metric_cache(filter1={"node1": "20"})
+        strategy = dontschedule.Strategy(
+            policy_name="pol",
+            rules=[
+                TASPolicyRule("missing", "GreaterThan", 10),
+                TASPolicyRule("filter1", "GreaterThan", 10),
+            ],
+        )
+        assert set(strategy.violated(cache)) == {"node1"}
+
+    def test_equals_dedup_semantics(self):
+        a = ds_strategy()
+        b = ds_strategy()
+        c = ds_strategy(rules=[TASPolicyRule("other", "GreaterThan", 10)])
+        d = ds_strategy(policy="pol2")
+        assert a.equals(b)
+        assert not a.equals(c)
+        assert not a.equals(d)
+        # empty rule lists are never equal (reference quirk)
+        assert not dontschedule.Strategy(policy_name="x").equals(
+            dontschedule.Strategy(policy_name="x")
+        )
+
+
+class TestEnforcerRegistry:
+    def test_register_add_remove(self):
+        enforcer = core.MetricEnforcer()
+        strategy = deschedule.Strategy(
+            policy_name="p1",
+            rules=[TASPolicyRule("m", "GreaterThan", 1)],
+        )
+        enforcer.register_strategy_type(strategy)
+        assert enforcer.is_registered("deschedule")
+        enforcer.add_strategy(strategy, "deschedule")
+        assert len(enforcer.registered_strategies["deschedule"]) == 1
+        # duplicate not added
+        dup = deschedule.Strategy(
+            policy_name="p1", rules=[TASPolicyRule("m", "GreaterThan", 1)]
+        )
+        enforcer.add_strategy(dup, "deschedule")
+        assert len(enforcer.registered_strategies["deschedule"]) == 1
+        enforcer.remove_strategy(dup, "deschedule")
+        assert len(enforcer.registered_strategies["deschedule"]) == 0
+
+    def test_unregistered_type_not_stored(self):
+        enforcer = core.MetricEnforcer()
+        strategy = ds_strategy()
+        enforcer.add_strategy(strategy, "dontschedule")  # type never registered
+        assert "dontschedule" not in enforcer.registered_strategies
+
+    def test_non_enforceable_like_registration(self):
+        enforcer = core.MetricEnforcer()
+        s = scheduleonmetric.Strategy(
+            policy_name="p", rules=[TASPolicyRule("m", "GreaterThan", 1)]
+        )
+        enforcer.register_strategy_type(s)
+        enforcer.add_strategy(s, "scheduleonmetric")
+        # scheduleonmetric implements the Enforceable protocol (no-op), so it
+        # is stored, mirroring the reference where all strategies implement
+        # Enforce
+        assert len(enforcer.registered_strategies["scheduleonmetric"]) == 1
+
+
+class TestDescheduleEnforce:
+    def setup_enforcer(self):
+        fake = FakeKubeClient()
+        fake.add_node(make_node("node1", labels={}))
+        fake.add_node(make_node("node2", labels={}))
+        enforcer = core.MetricEnforcer(fake)
+        strategy = deschedule.Strategy(
+            policy_name="deschedule-test",
+            rules=[TASPolicyRule("health_metric", "GreaterThan", 0)],
+        )
+        enforcer.register_strategy_type(strategy)
+        enforcer.add_strategy(strategy, "deschedule")
+        return fake, enforcer, strategy
+
+    def test_enforce_labels_violating_node(self):
+        fake, enforcer, strategy = self.setup_enforcer()
+        cache = metric_cache(health_metric={"node1": "1", "node2": "0"})
+        strategy.enforce(enforcer, cache)
+        assert fake.get_node("node1").get_labels().get("deschedule-test") == "violating"
+        assert "deschedule-test" not in fake.get_node("node2").get_labels()
+
+    def test_enforce_relabels_recovered_node_null(self):
+        fake, enforcer, strategy = self.setup_enforcer()
+        cache = metric_cache(health_metric={"node1": "1", "node2": "0"})
+        strategy.enforce(enforcer, cache)
+        # node1 recovers
+        cache2 = metric_cache(health_metric={"node1": "0", "node2": "0"})
+        strategy.enforce(enforcer, cache2)
+        # reference parity: label flips to "null", not removed (enforce.go:118-132)
+        assert fake.get_node("node1").get_labels().get("deschedule-test") == "null"
+
+    def test_cleanup_removes_labels(self):
+        fake, enforcer, strategy = self.setup_enforcer()
+        cache = metric_cache(health_metric={"node1": "1", "node2": "0"})
+        strategy.enforce(enforcer, cache)
+        strategy.cleanup(enforcer, "deschedule-test")
+        assert "deschedule-test" not in fake.get_node("node1").get_labels()
+
+    def test_periodic_enforcement_loop(self):
+        import time
+
+        fake, enforcer, strategy = self.setup_enforcer()
+        cache = metric_cache(health_metric={"node1": "1", "node2": "0"})
+        stop = enforcer.start_enforcing(cache, 0.02)
+        try:
+            deadline = time.time() + 2
+            while time.time() < deadline:
+                if fake.get_node("node1").get_labels().get("deschedule-test") == "violating":
+                    break
+                time.sleep(0.01)
+            assert (
+                fake.get_node("node1").get_labels().get("deschedule-test")
+                == "violating"
+            )
+        finally:
+            stop.set()

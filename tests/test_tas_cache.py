@@ -1,0 +1,140 @@
+"""TAS cache + metrics client tests (reference pkg/cache/autoupdating_test.go,
+pkg/metrics/client_test.go)."""
+
+import time
+
+import pytest
+
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
+from platform_aware_scheduling_tpu.tas.metrics import (
+    CustomMetricsClient,
+    DummyMetricsClient,
+    MetricsError,
+    NodeMetric,
+    instance_of_mock_metric_client_map,
+    wrap_metrics,
+)
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+
+def seeded_cache():
+    cache = AutoUpdatingCache()
+    cache.write_metric("dummyMetric1", None)  # register
+    cache.write_metric(
+        "dummyMetric1",
+        {"node A": NodeMetric(value=Quantity("100")),
+         "node B": NodeMetric(value=Quantity("200"))},
+    )
+    return cache
+
+
+class TestAutoUpdatingCache:
+    def test_read_write_metric(self):
+        cache = seeded_cache()
+        info = cache.read_metric("dummyMetric1")
+        assert info["node A"].value.cmp_int64(100) == 0
+
+    def test_read_missing_metric_raises(self):
+        with pytest.raises(CacheMissError):
+            AutoUpdatingCache().read_metric("nope")
+
+    def test_register_does_not_clobber(self):
+        cache = seeded_cache()
+        # a second nil registration must preserve the data
+        cache.write_metric("dummyMetric1", None)
+        assert cache.read_metric("dummyMetric1")["node B"].value.cmp_int64(200) == 0
+
+    def test_refcounted_delete(self):
+        cache = seeded_cache()
+        cache.write_metric("dummyMetric1", None)  # second registration (refcount 2)
+        cache.delete_metric("dummyMetric1")
+        # still present: one registration remains
+        assert cache.read_metric("dummyMetric1")
+        cache.delete_metric("dummyMetric1")
+        with pytest.raises(CacheMissError):
+            cache.read_metric("dummyMetric1")
+
+    def test_policy_roundtrip(self):
+        cache = AutoUpdatingCache()
+        policy = TASPolicy(metadata={"name": "p", "namespace": "default"})
+        cache.write_policy("default", "p", policy)
+        assert cache.read_policy("default", "p").name == "p"
+        cache.delete_policy("default", "p")
+        with pytest.raises(CacheMissError):
+            cache.read_policy("default", "p")
+
+    def test_periodic_update_refreshes(self):
+        """Values change after a ticker period (autoupdating_test.go:15-62)."""
+        cache = AutoUpdatingCache()
+        cache.write_metric("m", None)
+        client = DummyMetricsClient({"m": {"n1": NodeMetric(value=Quantity("1"))}})
+        stop = cache.start_periodic_update(0.02, client)
+        try:
+            deadline = time.time() + 2
+            while time.time() < deadline:
+                try:
+                    if cache.read_metric("m")["n1"].value.cmp_int64(1) == 0:
+                        break
+                except CacheMissError:
+                    pass
+                time.sleep(0.01)
+            assert cache.read_metric("m")["n1"].value.cmp_int64(1) == 0
+            # now the backend changes; cache must follow
+            client.store["m"] = {"n1": NodeMetric(value=Quantity("5"))}
+            deadline = time.time() + 2
+            while time.time() < deadline:
+                if cache.read_metric("m")["n1"].value.cmp_int64(5) == 0:
+                    break
+                time.sleep(0.01)
+            assert cache.read_metric("m")["n1"].value.cmp_int64(5) == 0
+        finally:
+            stop.set()
+
+    def test_mirror_hooks_fire(self):
+        cache = AutoUpdatingCache()
+        events = []
+        cache.on_metric_write.append(lambda name, data: events.append(("w", name)))
+        cache.on_metric_delete.append(lambda name: events.append(("d", name)))
+        cache.write_metric("m", None)
+        cache.write_metric("m", {"n": NodeMetric(value=Quantity("1"))})
+        cache.delete_metric("m")
+        assert events == [("w", "m"), ("w", "m"), ("d", "m")]
+
+
+class TestMetricsClient:
+    def test_wrap_metrics_default_window(self):
+        info = wrap_metrics(
+            {"items": [{"describedObject": {"kind": "Node", "name": "n1"},
+                        "value": "50"}]}
+        )
+        assert info["n1"].window_seconds == 60.0
+        assert info["n1"].value.cmp_int64(50) == 0
+
+    def test_wrap_metrics_explicit_window(self):
+        info = wrap_metrics(
+            {"items": [{"describedObject": {"name": "n1"}, "windowSeconds": 30,
+                        "value": "104857600000m"}]}
+        )
+        assert info["n1"].window_seconds == 30.0
+        assert info["n1"].value.cmp_int64(104857600) == 0
+
+    def test_custom_metrics_client_via_fake(self):
+        fake = FakeKubeClient()
+        fake.set_node_metric("health_metric", "node1", "0")
+        fake.set_node_metric("health_metric", "node2", "1")
+        client = CustomMetricsClient(fake)
+        info = client.get_node_metric("health_metric")
+        assert set(info) == {"node1", "node2"}
+
+    def test_empty_items_error(self):
+        client = CustomMetricsClient(FakeKubeClient())
+        with pytest.raises(MetricsError, match="no metrics returned"):
+            client.get_node_metric("missing")
+
+    def test_dummy_client(self):
+        client = DummyMetricsClient(instance_of_mock_metric_client_map())
+        assert client.get_node_metric("dummyMetric1")["node A"].value.cmp_int64(100) == 0
+        with pytest.raises(MetricsError):
+            client.get_node_metric("other")
