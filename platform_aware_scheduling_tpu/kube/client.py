@@ -28,10 +28,13 @@ from platform_aware_scheduling_tpu.utils import klog
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
-# TASPolicy CRD coordinates (reference pkg/telemetrypolicy/api/v1alpha1/types.go:9-13)
-CRD_GROUP = "telemetry.intel.com"
-CRD_VERSION = "v1alpha1"
-CRD_PLURAL = "taspolicies"
+# TASPolicy CRD coordinates — single source of truth in the schema module
+# (reference pkg/telemetrypolicy/api/v1alpha1/types.go:9-13)
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
+    GROUP as CRD_GROUP,
+    PLURAL as CRD_PLURAL,
+    VERSION as CRD_VERSION,
+)
 
 CUSTOM_METRICS_GROUP = "custom.metrics.k8s.io"
 CUSTOM_METRICS_VERSIONS = ("v1beta2", "v1beta1")

@@ -16,7 +16,6 @@ Both schedulers hang their state off informers: TAS watches the TASPolicy CRD
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -67,6 +66,7 @@ class Informer:
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._resync_thread: Optional[threading.Thread] = None
         self._resource_version = ""
 
     # -- store reads (the "lister") ------------------------------------------
@@ -90,6 +90,13 @@ class Informer:
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        if self._resync_period > 0:
+            # dedicated timer thread: an idle watch stream must not starve
+            # resync (client-go resyncs from its own timer too)
+            self._resync_thread = threading.Thread(
+                target=self._resync_loop, daemon=True
+            )
+            self._resync_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -130,8 +137,14 @@ class Informer:
                 else:
                     self._dispatch_delete(DeletedFinalStateUnknown(key=key, obj=obj))
 
+    def _resync_loop(self) -> None:
+        """Re-deliver update(obj, obj) for everything cached, every resync
+        period — the replay that rebuilds GAS state (survey §3.7)."""
+        while not self._stop.wait(self._resync_period):
+            for cached in self.list():
+                self._dispatch_update(cached, cached)
+
     def _run(self) -> None:
-        last_resync = time.monotonic()
         first = True
         while not self._stop.is_set():
             try:
@@ -159,13 +172,6 @@ class Informer:
                         with self._store_lock:
                             self._store.pop(key, None)
                         self._dispatch_delete(obj)
-                    if (
-                        self._resync_period > 0
-                        and time.monotonic() - last_resync > self._resync_period
-                    ):
-                        last_resync = time.monotonic()
-                        for cached in self.list():
-                            self._dispatch_update(cached, cached)
             except StopIteration:
                 continue
             except Exception as exc:  # watch broke: back off, re-list

@@ -62,6 +62,10 @@ class AutoUpdatingCache:
         self._store = _SerializedStore()
         self._metric_refcounts: Dict[str, int] = {}
         self._mtx = threading.Lock()
+        # held across store mutation + hook delivery so mirror subscribers
+        # observe mutations in store order (the reference gets this from its
+        # single cache goroutine, cache.go:43-63)
+        self._mutation_lock = threading.RLock()
         # mirror hooks: fired after a successful mutation
         self.on_metric_write: List[Callable[[str, Optional[NodeMetricsInfo]], None]] = []
         self.on_metric_delete: List[Callable[[str], None]] = []
@@ -85,9 +89,10 @@ class AutoUpdatingCache:
     # -- Writer ---------------------------------------------------------------
 
     def write_policy(self, namespace: str, policy_name: str, policy: TASPolicy) -> None:
-        self._store.add(POLICY_PATH.format(namespace, policy_name), policy)
-        for hook in self.on_policy_write:
-            hook(namespace, policy_name, policy)
+        with self._mutation_lock:
+            self._store.add(POLICY_PATH.format(namespace, policy_name), policy)
+            for hook in self.on_policy_write:
+                hook(namespace, policy_name, policy)
 
     def write_metric(
         self, metric_name: str, data: Optional[NodeMetricsInfo] = None
@@ -95,41 +100,44 @@ class AutoUpdatingCache:
         """Empty/None data registers the metric (incrementing its refcount)
         without clobbering current values (autoupdating.go:105-122)."""
         payload = data if data else None
-        self._store.add(METRIC_PATH.format(metric_name), payload)
-        if payload is None:
-            with self._mtx:
-                self._metric_refcounts[metric_name] = (
-                    self._metric_refcounts.get(metric_name, 0) + 1
-                )
-        for hook in self.on_metric_write:
-            hook(metric_name, payload)
+        with self._mutation_lock:
+            self._store.add(METRIC_PATH.format(metric_name), payload)
+            if payload is None:
+                with self._mtx:
+                    self._metric_refcounts[metric_name] = (
+                        self._metric_refcounts.get(metric_name, 0) + 1
+                    )
+            for hook in self.on_metric_write:
+                hook(metric_name, payload)
 
     def delete_policy(self, namespace: str, policy_name: str) -> None:
         klog.v(2).info_s(
             "deleting " + POLICY_PATH.format(namespace, policy_name),
             component="controller",
         )
-        self._store.delete(POLICY_PATH.format(namespace, policy_name))
-        for hook in self.on_policy_delete:
-            hook(namespace, policy_name)
+        with self._mutation_lock:
+            self._store.delete(POLICY_PATH.format(namespace, policy_name))
+            for hook in self.on_policy_delete:
+                hook(namespace, policy_name)
 
     def delete_metric(self, metric_name: str) -> None:
         """Refcounted delete: evicted only when the last registered policy
         using it is removed (autoupdating.go:124-137)."""
-        evicted = False
-        with self._mtx:
-            total = self._metric_refcounts.get(metric_name)
-            if total == 1:
-                del self._metric_refcounts[metric_name]
-                self._store.delete(METRIC_PATH.format(metric_name))
-                evicted = True
-            elif total is not None:
-                self._metric_refcounts[metric_name] = total - 1
-            else:
-                self._metric_refcounts[metric_name] = -1
-        if evicted:
-            for hook in self.on_metric_delete:
-                hook(metric_name)
+        with self._mutation_lock:
+            evicted = False
+            with self._mtx:
+                total = self._metric_refcounts.get(metric_name)
+                if total == 1:
+                    del self._metric_refcounts[metric_name]
+                    self._store.delete(METRIC_PATH.format(metric_name))
+                    evicted = True
+                elif total is not None:
+                    self._metric_refcounts[metric_name] = total - 1
+                else:
+                    self._metric_refcounts[metric_name] = -1
+            if evicted:
+                for hook in self.on_metric_delete:
+                    hook(metric_name)
 
     # -- SelfUpdating -----------------------------------------------------------
 

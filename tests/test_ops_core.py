@@ -1,0 +1,235 @@
+"""Device-kernel correctness: ops/{i64,rules,scoring} cross-checked against
+the exact host implementations (tas/strategies/core.py) on adversarial
+int64 values — full range, ties, negatives, sentinels."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops.rules import (
+    OP_EQUALS,
+    OP_GREATER_THAN,
+    OP_LESS_THAN,
+    RuleSet,
+    evaluate_rules,
+    rule_matches,
+    violated_nodes,
+)
+from platform_aware_scheduling_tpu.ops.scoring import (
+    filter_kernel,
+    ordinal_scores,
+    prioritize_kernel,
+)
+
+EDGE = np.array(
+    [
+        -(2**63),
+        -(2**63) + 1,
+        -(2**32) - 1,
+        -(2**32),
+        -(2**32) + 1,
+        -1,
+        0,
+        1,
+        2**31 - 1,
+        2**31,
+        2**32 - 1,
+        2**32,
+        2**32 + 1,
+        2**63 - 2,
+        2**63 - 1,
+    ],
+    dtype=np.int64,
+)
+
+
+def rand_i64(rng, n):
+    exp = rng.integers(0, 63, size=n)
+    base = rng.integers(0, 2**62, size=n, dtype=np.int64) >> exp.astype(np.int64)
+    sign = rng.choice([-1, 1], size=n).astype(np.int64)
+    return base * sign
+
+
+class TestI64:
+    def test_roundtrip(self):
+        vals = np.concatenate([EDGE, rand_i64(np.random.default_rng(0), 100)])
+        split = i64.from_int64(vals)
+        np.testing.assert_array_equal(i64.to_int64_np(split), vals)
+
+    def test_cmp_matches_python(self):
+        rng = np.random.default_rng(1)
+        a = np.concatenate([EDGE, rand_i64(rng, 200), EDGE])
+        b = np.concatenate([rand_i64(rng, len(EDGE)), rand_i64(rng, 200), EDGE])
+        got = np.asarray(i64.cmp(i64.from_int64(a), i64.from_int64(b)))
+        want = np.sign(a.astype(object) - b.astype(object)).astype(np.int32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_flip_reverses_order(self):
+        vals = np.sort(np.concatenate([EDGE, rand_i64(np.random.default_rng(2), 50)]))
+        flipped = i64.to_int64_np(i64.flip(i64.from_int64(vals)))
+        assert list(flipped) == sorted(flipped, reverse=True)
+
+    def test_add_sub_neg(self):
+        rng = np.random.default_rng(3)
+        a = rand_i64(rng, 100) // 2
+        b = rand_i64(rng, 100) // 2
+        np.testing.assert_array_equal(
+            i64.to_int64_np(i64.add(i64.from_int64(a), i64.from_int64(b))), a + b
+        )
+        np.testing.assert_array_equal(
+            i64.to_int64_np(i64.sub(i64.from_int64(a), i64.from_int64(b))), a - b
+        )
+        np.testing.assert_array_equal(
+            i64.to_int64_np(i64.neg(i64.from_int64(a))), -a
+        )
+
+    def test_sort_by_key_exact(self):
+        rng = np.random.default_rng(4)
+        vals = np.concatenate([EDGE, rand_i64(rng, 100)])
+        idx = np.arange(len(vals), dtype=np.int32)
+        (perm,) = i64.sort_by_key(i64.from_int64(vals), jnp.asarray(idx))
+        got = vals[np.asarray(perm)]
+        np.testing.assert_array_equal(got, np.sort(vals))
+
+
+class TestRules:
+    def test_rule_matches_all_ops(self):
+        vals = EDGE
+        targets = np.array([0] * len(EDGE), dtype=np.int64)
+        v = i64.from_int64(vals)
+        t = i64.from_int64(targets)
+        lt_mask = np.asarray(rule_matches(v, jnp.int32(OP_LESS_THAN), t))
+        gt_mask = np.asarray(rule_matches(v, jnp.int32(OP_GREATER_THAN), t))
+        eq_mask = np.asarray(rule_matches(v, jnp.int32(OP_EQUALS), t))
+        np.testing.assert_array_equal(lt_mask, vals < 0)
+        np.testing.assert_array_equal(gt_mask, vals > 0)
+        np.testing.assert_array_equal(eq_mask, vals == 0)
+
+    def _ruleset(self, rows, ops, targets, active=None):
+        r = len(rows)
+        active = [True] * r if active is None else active
+        t = i64.from_int64(np.asarray(targets, dtype=np.int64))
+        return RuleSet(
+            metric_row=jnp.asarray(np.asarray(rows, dtype=np.int32)),
+            op_id=jnp.asarray(np.asarray(ops, dtype=np.int32)),
+            target=t,
+            active=jnp.asarray(np.asarray(active, dtype=bool)),
+        )
+
+    def test_violated_or_semantics(self):
+        # 2 metrics x 4 nodes; rule0: m0 > 10, rule1: m1 < 5
+        values = i64.from_int64(
+            np.array([[20, 5, 20, 0], [9, 9, 1, 1]], dtype=np.int64)
+        )
+        present = jnp.asarray(
+            np.array([[True, True, False, True], [True, True, True, False]])
+        )
+        rules = self._ruleset([0, 1], [OP_GREATER_THAN, OP_LESS_THAN], [10, 5])
+        got = np.asarray(violated_nodes(values, present, rules))
+        # node0: m0=20>10 -> violated; node1: m0=5, m1=9 -> no;
+        # node2: m0 absent, m1=1<5 -> violated; node3: m0=0, m1 absent -> no
+        np.testing.assert_array_equal(got, [True, False, True, False])
+
+    def test_inactive_rules_ignored(self):
+        values = i64.from_int64(np.array([[100, 100]], dtype=np.int64))
+        present = jnp.asarray(np.ones((1, 2), dtype=bool))
+        rules = self._ruleset([0, 0], [OP_GREATER_THAN, OP_GREATER_THAN], [0, 0],
+                              active=[False, False])
+        got = np.asarray(violated_nodes(values, present, rules))
+        np.testing.assert_array_equal(got, [False, False])
+
+    def test_evaluate_rules_shape(self):
+        values = i64.from_int64(np.zeros((3, 5), dtype=np.int64))
+        present = jnp.asarray(np.ones((3, 5), dtype=bool))
+        rules = self._ruleset([0, 1, 2], [OP_EQUALS] * 3, [0, 0, 1])
+        got = np.asarray(evaluate_rules(values, present, rules))
+        assert got.shape == (3, 5)
+        np.testing.assert_array_equal(got[2], [False] * 5)
+
+
+def host_prioritize(values, valid, descending):
+    """Reference semantics in pure python: stable sort of valid nodes by
+    value (ties by index), score = 10 - rank."""
+    idxs = [i for i in range(len(values)) if valid[i]]
+    idxs.sort(key=lambda i: ((-values[i]) if descending else values[i], i))
+    return {i: 10 - rank for rank, i in enumerate(idxs)}
+
+
+class TestScoring:
+    @pytest.mark.parametrize("op,descending", [(OP_LESS_THAN, False),
+                                               (OP_GREATER_THAN, True)])
+    def test_ordinal_scores_vs_host(self, op, descending):
+        rng = np.random.default_rng(7)
+        vals = np.concatenate([EDGE, rand_i64(rng, 40),
+                               np.array([0, 0, 7, 7], dtype=np.int64)])
+        valid = rng.random(len(vals)) > 0.3
+        res = ordinal_scores(
+            i64.from_int64(vals), jnp.asarray(valid), jnp.int32(op)
+        )
+        want = host_prioritize(list(vals), list(valid), descending)
+        got_scores = np.asarray(res.scores)
+        got_valid = np.asarray(res.valid)
+        np.testing.assert_array_equal(got_valid, valid)
+        for i, score in want.items():
+            assert got_scores[i] == score, (i, vals[i])
+
+    def test_ordinal_scores_input_order_for_equals(self):
+        # non-LT/GT operator: no sort, score by input (index) order
+        vals = np.array([5, 1, 9, 3], dtype=np.int64)
+        valid = np.array([True, False, True, True])
+        res = ordinal_scores(
+            i64.from_int64(vals), jnp.asarray(valid), jnp.int32(OP_EQUALS)
+        )
+        scores = np.asarray(res.scores)
+        assert scores[0] == 10 and scores[2] == 9 and scores[3] == 8
+
+    def test_int64_min_greaterthan_sentinel_collision(self):
+        # flip(INT64_MIN) == INT64_MAX == the invalid sentinel: valid lane
+        # must still rank before invalid lanes
+        vals = np.array([-(2**63), 4], dtype=np.int64)
+        valid = np.array([True, False])
+        res = ordinal_scores(
+            i64.from_int64(vals), jnp.asarray(valid), jnp.int32(OP_GREATER_THAN)
+        )
+        assert np.asarray(res.scores)[0] == 10
+
+    def test_prioritize_kernel_end_to_end(self):
+        # metric matrix [2 metrics, 6 nodes]; rule: metric1 GreaterThan
+        values = i64.from_int64(
+            np.array(
+                [[1, 2, 3, 4, 5, 6], [10, 60, 30, 0, 50, 40]], dtype=np.int64
+            )
+        )
+        present = jnp.asarray(
+            np.array(
+                [[True] * 6, [True, True, True, False, True, True]]
+            )
+        )
+        candidates = jnp.asarray(np.array([True, True, False, True, True, True]))
+        res = prioritize_kernel(
+            values, present, jnp.int32(1), jnp.int32(OP_GREATER_THAN), candidates
+        )
+        scores = np.asarray(res.scores)
+        valid = np.asarray(res.valid)
+        # valid candidates on metric1: n0=10, n1=60, n4=50, n5=40 (n2 not a
+        # candidate, n3 absent) -> ranks: n1,n4,n5,n0
+        np.testing.assert_array_equal(
+            valid, [True, True, False, False, True, True]
+        )
+        assert scores[1] == 10 and scores[4] == 9 and scores[5] == 8 and scores[0] == 7
+
+    def test_filter_kernel(self):
+        values = i64.from_int64(np.array([[20, 5, 20, 0]], dtype=np.int64))
+        present = jnp.asarray(np.array([[True, True, False, True]]))
+        rules = RuleSet(
+            metric_row=jnp.asarray(np.array([0], dtype=np.int32)),
+            op_id=jnp.asarray(np.array([OP_GREATER_THAN], dtype=np.int32)),
+            target=i64.from_int64(np.array([10], dtype=np.int64)),
+            active=jnp.asarray(np.array([True])),
+        )
+        candidates = jnp.asarray(np.array([True, True, True, False]))
+        got = np.asarray(filter_kernel(values, present, rules, candidates))
+        # n0 violates (20>10); n1 ok; n2 absent from metric -> passes;
+        # n3 not candidate
+        np.testing.assert_array_equal(got, [False, True, True, False])

@@ -1,0 +1,148 @@
+"""TensorStateMirror: cache-hook sync, interning, capacity growth,
+policy compilation, host-only fallback marking."""
+
+import numpy as np
+
+from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops.rules import (
+    OP_GREATER_THAN,
+    OP_LESS_THAN,
+)
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.testing.builders import make_policy, rule
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+
+def info(**kv):
+    return {node: NodeMetric(value=Quantity(v)) for node, v in kv.items()}
+
+
+def attach_pair():
+    cache = AutoUpdatingCache()
+    mirror = TensorStateMirror(node_capacity=4, metric_capacity=2)
+    mirror.attach(cache)
+    return cache, mirror
+
+
+def test_metric_write_lands_in_matrix():
+    cache, mirror = attach_pair()
+    cache.write_metric("health", info(node1="10", node2="3500m"))
+    view = mirror.device_view()
+    row = 0
+    i1, i2 = view.node_index["node1"], view.node_index["node2"]
+    vals = i64.to_int64_np(view.values)
+    assert vals[row, i1] == 10_000  # milli-units
+    assert vals[row, i2] == 3500
+    present = np.asarray(view.present)
+    assert present[row, i1] and present[row, i2]
+    assert not present[row].sum() > 2
+
+
+def test_view_memoized_until_mutation():
+    cache, mirror = attach_pair()
+    cache.write_metric("m", info(a="1"))
+    v1 = mirror.device_view()
+    assert mirror.device_view() is v1
+    cache.write_metric("m", info(a="2"))
+    v2 = mirror.device_view()
+    assert v2 is not v1
+    # old snapshot untouched (copy-on-write)
+    assert i64.to_int64_np(v1.values)[0, v1.node_index["a"]] == 1000
+
+
+def test_node_capacity_growth():
+    cache, mirror = attach_pair()
+    cache.write_metric("m", info(**{f"n{i}": str(i) for i in range(20)}))
+    view = mirror.device_view()
+    assert view.node_capacity >= 20
+    vals = i64.to_int64_np(view.values)
+    for i in range(20):
+        assert vals[0, view.node_index[f"n{i}"]] == i * 1000
+
+
+def test_metric_capacity_growth_and_row_reuse():
+    cache, mirror = attach_pair()
+    for m in ["m0", "m1", "m2", "m3", "m4"]:
+        cache.write_metric(m, info(a="1"))
+    # register (refcount) then delete m2 -> its row is freed and reused
+    cache.write_metric("m2")
+    cache.delete_metric("m2")
+    cache.write_metric("m9", info(a="9"))
+    view = mirror.device_view()
+    vals = i64.to_int64_np(view.values)
+    present = np.asarray(view.present)
+    col = view.node_index["a"]
+    live_rows = present[:, col].sum()
+    assert live_rows == 5  # m0,m1,m3,m4,m9
+    assert 9000 in vals[:, col]
+
+
+def test_candidate_mask_and_unknown_nodes():
+    cache, mirror = attach_pair()
+    cache.write_metric("m", info(a="1", b="2"))
+    view = mirror.device_view()
+    mask, unknown = view.candidate_mask(["a", "ghost", "b"])
+    assert unknown == ["ghost"]
+    m = np.asarray(mask)
+    assert m[view.node_index["a"]] and m[view.node_index["b"]]
+    assert m.sum() == 2
+
+
+def test_policy_compilation():
+    cache, mirror = attach_pair()
+    cache.write_metric("cpu", info(a="1"))
+    policy = TASPolicy.from_obj(
+        make_policy(
+            "p1",
+            strategies={
+                "dontschedule": [rule("cpu", "GreaterThan", 80)],
+                "scheduleonmetric": [rule("mem", "LessThan", 0)],
+            },
+        )
+    )
+    cache.write_policy("default", "p1", policy)
+    compiled = mirror.policy("default", "p1")
+    assert compiled is not None
+    rs = compiled.device_rules("dontschedule")
+    assert rs is not None
+    assert int(rs.op_id[0]) == OP_GREATER_THAN
+    assert i64.to_int64_np(rs.target)[0] == 80_000
+    assert bool(rs.active[0]) and not bool(rs.active[1])
+    assert compiled.scheduleonmetric_op == OP_LESS_THAN
+    # the scheduleonmetric metric got interned even before any values
+    view = mirror.device_view()
+    assert compiled.scheduleonmetric_row >= 0
+
+
+def test_unknown_operator_marks_host_only():
+    cache, mirror = attach_pair()
+    policy = TASPolicy.from_obj(
+        make_policy("p", strategies={"dontschedule": [rule("m", "Weird", 1)]})
+    )
+    cache.write_policy("default", "p", policy)
+    compiled = mirror.policy("default", "p")
+    assert compiled.dontschedule.host_only
+    assert compiled.device_rules("dontschedule") is None
+
+
+def test_inexact_quantity_marks_metric_host_only():
+    cache, mirror = attach_pair()
+    # 1/3000 has no exact milli representation
+    cache.write_metric("m", {"a": NodeMetric(value=Quantity("333333n"))})
+    assert mirror.metric_host_only("m")
+    cache.write_metric("m", info(a="5"))
+    assert not mirror.metric_host_only("m")
+
+
+def test_policy_delete_removes_compiled():
+    cache, mirror = attach_pair()
+    policy = TASPolicy.from_obj(
+        make_policy("p", strategies={"dontschedule": [rule("m", "LessThan", 1)]})
+    )
+    cache.write_policy("default", "p", policy)
+    assert mirror.policy("default", "p") is not None
+    cache.delete_policy("default", "p")
+    assert mirror.policy("default", "p") is None
