@@ -1,0 +1,125 @@
+"""TAS service main: flags, assembly, signal handling.
+
+Reference: telemetry-aware-scheduling/cmd/main.go:31-117.  Identical flag
+surface (``--kubeConfig --port --cert --key --cacert --unsafe --syncPeriod``
+plus klog ``--v``); assembly adds the TPU twist: a TensorStateMirror is
+attached to the cache so the extender's hot path runs the jitted scoring
+kernels, with the exact host path as automatic fallback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+from typing import List, Optional
+
+from platform_aware_scheduling_tpu.extender.server import Server
+from platform_aware_scheduling_tpu.kube.client import KubeClient, get_kube_client
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.controller import TelemetryPolicyController
+from platform_aware_scheduling_tpu.tas.metrics import CustomMetricsClient
+from platform_aware_scheduling_tpu.tas.strategies import (
+    core,
+    deschedule,
+    dontschedule,
+    scheduleonmetric,
+)
+from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
+from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tas-extender",
+        description="Telemetry-aware scheduling extender (TPU-native)",
+    )
+    default_kubeconfig = os.path.join(
+        os.environ.get("HOME", "/root"), ".kube", "config"
+    )
+    parser.add_argument("--kubeConfig", default=default_kubeconfig,
+                        help="location of kubernetes config file")
+    parser.add_argument("--port", default="9001",
+                        help="port on which the scheduler extender will listen")
+    parser.add_argument("--cert", default="/etc/kubernetes/pki/ca.crt",
+                        help="cert file extender will use")
+    parser.add_argument("--key", default="/etc/kubernetes/pki/ca.key",
+                        help="key file extender will use")
+    parser.add_argument("--cacert", default="/etc/kubernetes/pki/ca.crt",
+                        help="ca file extender will use")
+    parser.add_argument("--unsafe", action="store_true",
+                        help="unsafe instances of extender will be served over http")
+    parser.add_argument("--syncPeriod", default="5s",
+                        help="interval between cache syncs, e.g. 1m or 2s")
+    parser.add_argument("--v", type=int, default=2, help="klog verbosity")
+    return parser
+
+
+def assemble(
+    kube_client: KubeClient,
+    metrics_client,
+    sync_period_s: float,
+    enable_device_path: bool = True,
+):
+    """Wire cache + mirror + extender + controller + enforcer (the body of
+    ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
+    a stop Event controlling every background loop."""
+    cache = AutoUpdatingCache()
+    mirror: Optional[TensorStateMirror] = None
+    if enable_device_path:
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+    extender = MetricsExtender(cache, mirror=mirror)
+
+    enforcer = core.MetricEnforcer(kube_client)
+    enforcer.register_strategy_type(deschedule.Strategy())
+    enforcer.register_strategy_type(scheduleonmetric.Strategy())
+    enforcer.register_strategy_type(dontschedule.Strategy())
+
+    controller = TelemetryPolicyController(kube_client, cache, enforcer)
+
+    stop = threading.Event()
+    cache.start_periodic_update(sync_period_s, metrics_client)
+    controller.run(stop)
+    enforcer.start_enforcing(cache, sync_period_s)
+    return cache, mirror, extender, controller, enforcer, stop
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    klog.set_verbosity(args.v)
+    sync_period_s = parse_duration(args.syncPeriod)
+
+    kube_client = get_kube_client(args.kubeConfig)
+    metrics_client = CustomMetricsClient(kube_client)
+    _, _, extender, _, _, stop = assemble(kube_client, metrics_client, sync_period_s)
+
+    server = Server(extender)
+    threading.Thread(
+        target=lambda: server.start_server(
+            port=args.port,
+            cert_file=args.cert,
+            key_file=args.key,
+            ca_file=args.cacert,
+            unsafe=args.unsafe,
+            block=True,
+        ),
+        daemon=True,
+    ).start()
+
+    # catchInterrupt (reference cmd/main.go:113-117)
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    stop.set()
+    server.shutdown()
+    klog.v(1).info_s("Exiting", component="extender")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,6 +33,8 @@ from platform_aware_scheduling_tpu.ops.rules import (
 class PrioritizeResult(NamedTuple):
     scores: jax.Array  # int32 [N] — 10 - rank, valid lanes only
     valid: jax.Array  # bool [N] — candidate ∩ metric-present
+    perm: jax.Array  # int32 [N] — node indices in rank order (valid first)
+    valid_count: jax.Array  # int32 scalar — number of valid lanes
 
 
 def _rank_keys(
@@ -73,7 +75,12 @@ def ordinal_scores(
     (perm,) = i64.sort_by_key(key, index, tiebreak=tiebreak)
     ranks = jnp.zeros(n, dtype=jnp.int32).at[perm].set(index)
     scores = jnp.int32(10) - ranks
-    return PrioritizeResult(scores=scores, valid=valid)
+    return PrioritizeResult(
+        scores=scores,
+        valid=valid,
+        perm=perm,
+        valid_count=jnp.sum(valid).astype(jnp.int32),
+    )
 
 
 @partial(jax.jit, static_argnames=())

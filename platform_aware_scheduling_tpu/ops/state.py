@@ -51,6 +51,7 @@ class CompiledRuleSet:
     targets: np.ndarray  # int64 [R_pad] milli-units
     active: np.ndarray  # bool [R_pad]
     host_only: bool = False  # unknown operator somewhere -> host fallback
+    metric_names: Tuple[str, ...] = ()  # for host-only metric checks
 
     def to_device(self) -> RuleSet:
         t_hi, t_lo = i64.split_int64_np(self.targets)
@@ -71,6 +72,7 @@ class CompiledPolicy:
     # scheduleonmetric uses only Rules[0] (telemetryscheduler.go:115-124)
     scheduleonmetric_row: int = -1
     scheduleonmetric_op: int = -1
+    scheduleonmetric_metric: str = ""
     scheduleonmetric_host_only: bool = False
     _device_cache: Dict[str, RuleSet] = field(default_factory=dict)
 
@@ -275,6 +277,7 @@ class TensorStateMirror:
             targets=targets,
             active=active,
             host_only=host_only,
+            metric_names=tuple(rule.metricname for rule in rules),
         )
 
     def _compile_policy(self, policy: TASPolicy) -> CompiledPolicy:
@@ -287,12 +290,13 @@ class TensorStateMirror:
         if "deschedule" in strategies:
             compiled.deschedule = self._compile_rules(strategies["deschedule"].rules)
         som = strategies.get("scheduleonmetric")
-        if som is not None and som.rules:
+        if som is not None and som.rules and som.rules[0].metricname:
             rule = som.rules[0]
             compiled.scheduleonmetric_row = self._intern_metric(rule.metricname)
             op = OP_IDS.get(rule.operator)
             compiled.scheduleonmetric_op = -1 if op is None else op
-            compiled.scheduleonmetric_host_only = op is None
+            compiled.scheduleonmetric_metric = rule.metricname
+            compiled.scheduleonmetric_host_only = False
         return compiled
 
     # -- reads ----------------------------------------------------------------

@@ -1,0 +1,321 @@
+"""TAS scheduling logic: the Prioritize/Filter/Bind verbs over policy rules.
+
+Reference: telemetry-aware-scheduling/pkg/telemetryscheduler/
+telemetryscheduler.go.  Wire behavior is reproduced quirk-for-quirk
+(callers depend on it):
+
+  * decode failures and empty node lists return an empty 200 body
+    (telemetryscheduler.go:41-48 — the Go handler just returns);
+  * a pod without the ``telemetry-policy`` label gets status 400 but the
+    handler STILL runs and writes ``[]`` (no return after WriteHeader,
+    telemetryscheduler.go:50-53);
+  * a nil filter result is 404 with body ``null`` (:170-175);
+  * FailedNodes messages are the literal "Node violates" (the reference's
+    one-element strings.Join never uses its separator, :206);
+  * FilterResult.NodeNames is built by splitting "n1 n2 " on spaces and so
+    carries a trailing empty string (:212);
+  * Bind is 404 — TAS does not bind (:179-181).
+
+Two execution paths produce identical wire bytes:
+
+  * **device path** (default): the jitted kernels of ops/scoring.py over the
+    TensorStateMirror — one fused XLA pass instead of the per-node Go loop;
+  * **host path**: exact-semantics Python (strategies/core.py), used as
+    fallback whenever the mirror marks a policy/metric host-only (inexact
+    milli conversion, unknown operator) and as the control in tests.
+
+For non-sorting operators the reference's output order is Go map iteration
+— randomized per process.  The device path is deterministic (node interning
+order), which is within the reference's behavior envelope.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    HTTPResponse,
+    not_found_handler,
+)
+from platform_aware_scheduling_tpu.extender.types import (
+    Args,
+    FilterResult,
+    HostPriority,
+    encode_host_priority_list,
+)
+from platform_aware_scheduling_tpu.kube.objects import Node, Pod
+from platform_aware_scheduling_tpu.ops.scoring import filter_kernel, prioritize_kernel
+from platform_aware_scheduling_tpu.ops.state import CompiledPolicy, TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
+from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
+from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
+
+import jax.numpy as jnp
+
+TAS_POLICY_LABEL = "telemetry-policy"
+
+
+class MetricsExtender:
+    """extender.Scheduler implementation for TAS
+    (reference telemetryscheduler.go:25-34)."""
+
+    def __init__(
+        self,
+        cache: AutoUpdatingCache,
+        mirror: Optional[TensorStateMirror] = None,
+        recorder: Optional[LatencyRecorder] = None,
+    ):
+        self.cache = cache
+        self.mirror = mirror
+        self.recorder = recorder or LatencyRecorder()
+
+    # -- verbs ----------------------------------------------------------------
+
+    def prioritize(self, request: HTTPRequest) -> HTTPResponse:
+        start = time.perf_counter()
+        try:
+            klog.v(2).info_s("Received prioritize request", component="extender")
+            args = self._decode(request)
+            if args is None:
+                return HTTPResponse()
+            if not args.nodes:
+                klog.v(2).info_s(
+                    "bad extender arguments. No nodes in list", component="extender"
+                )
+                return HTTPResponse()
+            status = 200
+            if TAS_POLICY_LABEL not in args.pod.get_labels():
+                klog.v(2).info_s("no policy associated with pod", component="extender")
+                status = 400  # and still prioritize (telemetryscheduler.go:50-54)
+            prioritized = self._prioritize_nodes(args)
+            return HTTPResponse.json(
+                encode_host_priority_list(prioritized), status=status
+            )
+        finally:
+            self.recorder.observe("prioritize", time.perf_counter() - start)
+
+    def filter(self, request: HTTPRequest) -> HTTPResponse:
+        start = time.perf_counter()
+        try:
+            klog.v(2).info_s("Filter request received", component="extender")
+            args = self._decode(request)
+            if args is None:
+                return HTTPResponse()
+            result = self._filter_nodes(args)
+            if result is None:
+                klog.v(2).info_s("No filtered nodes returned", component="extender")
+                return HTTPResponse.json(b"null\n", status=404)
+            return HTTPResponse.json(result.to_json())
+        finally:
+            self.recorder.observe("filter", time.perf_counter() - start)
+
+    def bind(self, request: HTTPRequest) -> HTTPResponse:
+        # TAS does not implement Bind (telemetryscheduler.go:179-181)
+        return HTTPResponse(status=404)
+
+    # -- decode ---------------------------------------------------------------
+
+    def _decode(self, request: HTTPRequest) -> Optional[Args]:
+        """DecodeExtenderRequest (telemetryscheduler.go:63-78): errors —
+        including a missing Nodes list — log and produce an empty 200."""
+        if not request.body:
+            klog.v(2).info_s("request body empty", component="extender")
+            return None
+        try:
+            args = Args.from_json(request.body)
+        except Exception as exc:
+            klog.v(2).info_s(f"error decoding request: {exc}", component="extender")
+            return None
+        if args.nodes is None:
+            klog.v(2).info_s("no nodes in list", component="extender")
+            return None
+        return args
+
+    # -- prioritize logic ------------------------------------------------------
+
+    def _prioritize_nodes(self, args: Args) -> List[HostPriority]:
+        """prioritizeNodes (telemetryscheduler.go:81-100): any failure
+        degrades to an empty priority list."""
+        try:
+            policy = self._policy_from_pod(args.pod)
+        except Exception as exc:
+            klog.v(2).info_s(
+                f"get policy from pod failed: {exc}", component="extender"
+            )
+            return []
+        rule = self._scheduling_rule(policy)
+        if rule is None:
+            klog.v(2).info_s(
+                "get scheduling rule from policy failed: no scheduling rule found",
+                component="extender",
+            )
+            return []
+        names = [node.name for node in args.nodes or []]
+        compiled = self._device_policy(policy)
+        if compiled is not None and self._device_prioritize_ok(compiled, rule):
+            try:
+                return self._prioritize_device(compiled, names)
+            except Exception as exc:  # device trouble must never fail the verb
+                klog.error("device prioritize failed, host fallback: %s", exc)
+        return self._prioritize_host(rule, names)
+
+    def _prioritize_device(
+        self, compiled: CompiledPolicy, candidate_names: List[str]
+    ) -> List[HostPriority]:
+        view = self.mirror.device_view()
+        mask, _unknown = view.candidate_mask(candidate_names)
+        res = prioritize_kernel(
+            view.values,
+            view.present,
+            jnp.int32(compiled.scheduleonmetric_row),
+            jnp.int32(compiled.scheduleonmetric_op),
+            mask,
+        )
+        perm = np.asarray(res.perm)
+        count = int(res.valid_count)
+        return [
+            HostPriority(host=view.node_names[int(perm[i])], score=10 - i)
+            for i in range(count)
+        ]
+
+    def _prioritize_host(
+        self, rule: TASPolicyRule, candidate_names: List[str]
+    ) -> List[HostPriority]:
+        """prioritizeNodesForRule (telemetryscheduler.go:128-149), exact
+        host semantics."""
+        try:
+            node_data = self.cache.read_metric(rule.metricname)
+        except CacheMissError as exc:
+            klog.v(2).info_s(
+                f"failed to prioritize: {exc}, {rule.metricname}",
+                component="extender",
+            )
+            return []
+        filtered = {
+            name: node_data[name] for name in candidate_names if name in node_data
+        }
+        ordered = core.ordered_list(filtered, rule.operator)
+        return [
+            HostPriority(host=entry.node_name, score=10 - i)
+            for i, entry in enumerate(ordered)
+        ]
+
+    # -- filter logic ----------------------------------------------------------
+
+    def _filter_nodes(self, args: Args) -> Optional[FilterResult]:
+        """filterNodes (telemetryscheduler.go:184-225)."""
+        try:
+            policy = self._policy_from_pod(args.pod)
+        except Exception as exc:
+            klog.v(2).info_s(
+                f"get policy from pod failed {exc}", component="extender"
+            )
+            return None
+        strategy = self._dontschedule_strategy(policy)
+        if strategy is None:
+            klog.v(2).info_s(
+                "Don't scheduler strategy failed no dontschedule strategy found",
+                component="extender",
+            )
+            return None
+        violating = self._violating_nodes(policy, strategy)
+        if not args.nodes:
+            klog.v(2).info_s("No nodes to compare", component="extender")
+            return None
+        filtered: List[Node] = []
+        failed: Dict[str, str] = {}
+        available = ""
+        for node in args.nodes:
+            if node.name in violating:
+                failed[node.name] = "Node violates"
+            else:
+                filtered.append(node)
+                available += node.name + " "
+        node_names = available.split(" ")  # trailing "" kept (see module doc)
+        if available:
+            klog.v(2).info_s(
+                f"Filtered nodes for {policy.name}: {available}",
+                component="extender",
+            )
+        return FilterResult(
+            nodes=filtered, node_names=node_names, failed_nodes=failed, error=""
+        )
+
+    def _violating_nodes(
+        self, policy: TASPolicy, strategy: dontschedule.Strategy
+    ) -> Dict[str, None]:
+        compiled = self._device_policy(policy)
+        if compiled is not None and self._device_filter_ok(compiled):
+            try:
+                return self._violating_device(compiled)
+            except Exception as exc:
+                klog.error("device filter failed, host fallback: %s", exc)
+        return strategy.violated(self.cache)
+
+    def _violating_device(self, compiled: CompiledPolicy) -> Dict[str, None]:
+        view = self.mirror.device_view()
+        rules = compiled.device_rules("dontschedule")
+        all_nodes = jnp.ones(view.node_capacity, dtype=bool)
+        passing = filter_kernel(view.values, view.present, rules, all_nodes)
+        mask = ~np.asarray(passing)
+        return {
+            view.node_names[i]: None
+            for i in np.nonzero(mask)[0]
+            if i < len(view.node_names)
+        }
+
+    # -- shared helpers --------------------------------------------------------
+
+    def _policy_from_pod(self, pod: Pod) -> TASPolicy:
+        """getPolicyFromPod (telemetryscheduler.go:103-112)."""
+        policy_name = pod.get_labels().get(TAS_POLICY_LABEL)
+        if policy_name is None:
+            raise CacheMissError(f"no policy found in pod spec for pod {pod.name}")
+        return self.cache.read_policy(pod.namespace, policy_name)
+
+    def _scheduling_rule(self, policy: TASPolicy) -> Optional[TASPolicyRule]:
+        """getSchedulingRule (telemetryscheduler.go:115-124): rule[0] of
+        scheduleonmetric, requiring a non-empty metric name."""
+        strat = policy.strategies.get("scheduleonmetric")
+        if strat and strat.rules and strat.rules[0].metricname:
+            return strat.rules[0]
+        return None
+
+    def _dontschedule_strategy(
+        self, policy: TASPolicy
+    ) -> Optional[dontschedule.Strategy]:
+        """getDontScheduleStrategy (telemetryscheduler.go:228-235)."""
+        strat = policy.strategies.get("dontschedule")
+        if strat is None or not strat.rules:
+            return None
+        return dontschedule.Strategy.from_policy_strategy(strat)
+
+    # -- device-path eligibility ----------------------------------------------
+
+    def _device_policy(self, policy: TASPolicy) -> Optional[CompiledPolicy]:
+        if self.mirror is None:
+            return None
+        return self.mirror.policy(policy.namespace, policy.name)
+
+    def _device_prioritize_ok(
+        self, compiled: CompiledPolicy, rule: TASPolicyRule
+    ) -> bool:
+        return (
+            compiled.scheduleonmetric_row >= 0
+            and not compiled.scheduleonmetric_host_only
+            and not self.mirror.metric_host_only(rule.metricname)
+        )
+
+    def _device_filter_ok(self, compiled: CompiledPolicy) -> bool:
+        rules = compiled.dontschedule
+        if rules is None or rules.host_only or not rules.active.any():
+            return False
+        return not any(
+            self.mirror.metric_host_only(name) for name in rules.metric_names
+        )

@@ -1,0 +1,171 @@
+"""TASPolicy controller tests: live informer over the fake kube client —
+the active informer test the reference left commented out
+(reference pkg/controller/controller_test.go:34-38)."""
+
+import time
+
+import pytest
+
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
+from platform_aware_scheduling_tpu.tas.controller import (
+    InvalidStrategyError,
+    TelemetryPolicyController,
+    cast_strategy,
+)
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
+    TASPolicy,
+    TASPolicyStrategy,
+)
+from platform_aware_scheduling_tpu.tas.strategies import core, deschedule, dontschedule
+from platform_aware_scheduling_tpu.testing.builders import make_policy, rule
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def build():
+    kube = FakeKubeClient()
+    cache = AutoUpdatingCache()
+    enforcer = core.MetricEnforcer(kube)
+    enforcer.register_strategy_type(deschedule.Strategy())
+    enforcer.register_strategy_type(dontschedule.Strategy())
+    controller = TelemetryPolicyController(kube, cache, enforcer)
+    return kube, cache, enforcer, controller
+
+
+POLICY = make_policy(
+    "demo-policy",
+    strategies={
+        "dontschedule": [rule("memory", "GreaterThan", 80)],
+        "deschedule": [rule("memory", "GreaterThan", 90)],
+        "scheduleonmetric": [rule("cpu", "LessThan", 0)],
+    },
+)
+
+
+class TestCastStrategy:
+    def test_known_types(self):
+        strat = TASPolicyStrategy.from_obj(
+            {"policyName": "p", "rules": [rule("m", "LessThan", 1)]}
+        )
+        for name in ("dontschedule", "deschedule", "scheduleonmetric"):
+            instance = cast_strategy(name, strat)
+            assert instance.strategy_type() == name
+            assert instance.rules[0].metricname == "m"
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(InvalidStrategyError):
+            cast_strategy("labeling-v2", TASPolicyStrategy())
+
+
+class TestControllerLive:
+    def test_add_policy_via_watch(self):
+        kube, cache, enforcer, controller = build()
+        informer = controller.run()
+        assert informer.wait_for_cache_sync()
+        kube.create_taspolicy(POLICY)
+        assert wait_until(
+            lambda: _has_policy(cache, "default", "demo-policy")
+        )
+        # metrics registered (refcounted) for every rule
+        assert set(cache.registered_metric_names()) == {"memory", "cpu"}
+        # enforceable strategies registered under their types
+        assert wait_until(
+            lambda: len(enforcer.registered_strategies["deschedule"]) == 1
+        )
+
+    def test_update_policy_reregisters(self):
+        kube, cache, enforcer, controller = build()
+        controller.run().wait_for_cache_sync()
+        kube.create_taspolicy(POLICY)
+        assert wait_until(lambda: _has_policy(cache, "default", "demo-policy"))
+        updated = make_policy(
+            "demo-policy",
+            strategies={
+                "dontschedule": [rule("disk", "GreaterThan", 70)],
+                "deschedule": [rule("memory", "GreaterThan", 95)],
+                "scheduleonmetric": [rule("cpu", "LessThan", 0)],
+            },
+        )
+        updated["metadata"]["resourceVersion"] = "2"
+        kube.update_taspolicy(updated)
+        assert wait_until(
+            lambda: "disk" in cache.registered_metric_names()
+        )
+        pol = cache.read_policy("default", "demo-policy")
+        assert pol.strategies["dontschedule"].rules[0].metricname == "disk"
+        assert wait_until(
+            lambda: any(
+                s.rules[0].target == 95
+                for s in enforcer.registered_strategies["deschedule"].values()
+            )
+        )
+
+    def test_delete_policy_cleans_up(self):
+        kube, cache, enforcer, controller = build()
+        controller.run().wait_for_cache_sync()
+        kube.create_taspolicy(POLICY)
+        assert wait_until(lambda: _has_policy(cache, "default", "demo-policy"))
+        kube.delete_taspolicy("default", "demo-policy")
+        assert wait_until(
+            lambda: not _has_policy(cache, "default", "demo-policy")
+        )
+        assert wait_until(
+            lambda: len(enforcer.registered_strategies["deschedule"]) == 0
+        )
+        assert cache.registered_metric_names() == []
+
+    def test_policies_present_before_start_are_replayed(self):
+        kube, cache, _, controller = build()
+        kube.create_taspolicy(POLICY)  # exists before the informer starts
+        controller.run().wait_for_cache_sync()
+        assert wait_until(lambda: _has_policy(cache, "default", "demo-policy"))
+
+    def test_mirror_follows_controller(self):
+        kube, cache, _, controller = build()
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+        controller.run().wait_for_cache_sync()
+        kube.create_taspolicy(POLICY)
+        assert wait_until(
+            lambda: mirror.policy("default", "demo-policy") is not None
+        )
+        compiled = mirror.policy("default", "demo-policy")
+        assert compiled.dontschedule is not None
+        assert compiled.scheduleonmetric_metric == "cpu"
+
+
+def _has_policy(cache, ns, name) -> bool:
+    try:
+        cache.read_policy(ns, name)
+        return True
+    except CacheMissError:
+        return False
+
+
+class TestAssemble:
+    def test_cmd_assemble_wires_everything(self):
+        from platform_aware_scheduling_tpu.cmd.tas import assemble
+        from platform_aware_scheduling_tpu.tas.metrics import DummyMetricsClient
+
+        kube = FakeKubeClient()
+        kube.set_node_metric("memory", "node1", "50")
+        cache, mirror, extender, controller, enforcer, stop = assemble(
+            kube, DummyMetricsClient({}), sync_period_s=0.05
+        )
+        try:
+            kube.create_taspolicy(POLICY)
+            assert wait_until(lambda: _has_policy(cache, "default", "demo-policy"))
+            assert mirror is not None
+            assert extender.mirror is mirror
+            assert enforcer.is_registered("deschedule")
+        finally:
+            stop.set()

@@ -1,0 +1,310 @@
+"""TAS MetricsExtender verb tests — table-driven against pre-seeded caches,
+mirroring reference pkg/telemetryscheduler/scheduler_test.go, plus
+device-path vs host-path wire equivalence."""
+
+import json
+
+import numpy as np
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
+from platform_aware_scheduling_tpu.testing.builders import make_policy, rule
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+
+def post(body: dict | bytes) -> HTTPRequest:
+    raw = body if isinstance(body, bytes) else json.dumps(body).encode()
+    return HTTPRequest(
+        method="POST",
+        path="/scheduler/prioritize",
+        headers={"Content-Type": "application/json"},
+        body=raw,
+    )
+
+
+def args_obj(pod_labels=None, node_names=None, namespace="default"):
+    return {
+        "Pod": {
+            "metadata": {
+                "name": "big pod",
+                "namespace": namespace,
+                "labels": pod_labels or {},
+            }
+        },
+        "Nodes": {
+            "items": [{"metadata": {"name": n}} for n in (node_names or [])]
+        },
+    }
+
+
+def metric_info(**kv):
+    return {n: NodeMetric(value=Quantity(str(v))) for n, v in kv.items()}
+
+
+POLICY1 = make_policy(
+    "policy1",
+    strategies={
+        "scheduleonmetric": [rule("metric1", "GreaterThan", 0)],
+        "dontschedule": [rule("metric1", "GreaterThan", 40)],
+    },
+)
+
+
+def build(with_mirror: bool):
+    cache = AutoUpdatingCache()
+    mirror = None
+    if with_mirror:
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+    cache.write_policy("default", "policy1", TASPolicy.from_obj(POLICY1))
+    return cache, MetricsExtender(cache, mirror=mirror)
+
+
+@pytest.fixture(params=[False, True], ids=["host", "device"])
+def extender(request):
+    cache, ext = build(request.param)
+    return cache, ext
+
+
+class TestPrioritize:
+    def test_get_and_return_node(self, extender):
+        cache, ext = extender
+        cache.write_metric("metric1", metric_info(**{"node A": 100, "node B": 90}))
+        resp = ext.prioritize(
+            post(args_obj({"telemetry-policy": "policy1"}, ["node A", "node B"]))
+        )
+        assert resp.status == 200
+        assert json.loads(resp.body) == [
+            {"Host": "node A", "Score": 10},
+            {"Host": "node B", "Score": 9},
+        ]
+
+    def test_policy_not_found_returns_empty(self, extender):
+        cache, ext = extender
+        cache.write_metric("metric1", metric_info(**{"node A": 100}))
+        resp = ext.prioritize(
+            post(args_obj({"telemetry-policy": "missing"}, ["node A"]))
+        )
+        assert resp.status == 200
+        assert json.loads(resp.body) == []
+
+    def test_empty_cache_returns_empty(self, extender):
+        _, ext = extender
+        resp = ext.prioritize(
+            post(args_obj({"telemetry-policy": "policy1"}, ["node A"]))
+        )
+        assert json.loads(resp.body) == []
+
+    def test_unlabelled_pod_gets_400_but_still_answers(self, extender):
+        cache, ext = extender
+        cache.write_metric("metric1", metric_info(**{"node A": 100}))
+        resp = ext.prioritize(post(args_obj({}, ["node A"])))
+        assert resp.status == 400
+        assert json.loads(resp.body) == []
+
+    def test_malformed_args_empty_200(self, extender):
+        _, ext = extender
+        resp = ext.prioritize(post(b"{not json"))
+        assert resp.status == 200 and resp.body == b""
+        resp = ext.prioritize(post({"Pod": {}}))  # Nodes nil
+        assert resp.status == 200 and resp.body == b""
+
+    def test_no_nodes_in_list_empty_200(self, extender):
+        _, ext = extender
+        resp = ext.prioritize(post(args_obj({"telemetry-policy": "policy1"}, [])))
+        assert resp.status == 200 and resp.body == b""
+
+    def test_lessthan_sorts_ascending(self, extender):
+        cache, ext = extender
+        policy = make_policy(
+            "asc", strategies={"scheduleonmetric": [rule("m", "LessThan", 0)]}
+        )
+        cache.write_policy("default", "asc", TASPolicy.from_obj(policy))
+        cache.write_metric("m", metric_info(a=30, b=10, c=20))
+        resp = ext.prioritize(
+            post(args_obj({"telemetry-policy": "asc"}, ["a", "b", "c"]))
+        )
+        assert json.loads(resp.body) == [
+            {"Host": "b", "Score": 10},
+            {"Host": "c", "Score": 9},
+            {"Host": "a", "Score": 8},
+        ]
+
+    def test_candidates_missing_from_metric_skipped(self, extender):
+        cache, ext = extender
+        cache.write_metric("metric1", metric_info(**{"node A": 5}))
+        resp = ext.prioritize(
+            post(args_obj({"telemetry-policy": "policy1"}, ["node A", "ghost"]))
+        )
+        assert json.loads(resp.body) == [{"Host": "node A", "Score": 10}]
+
+    def test_scores_go_negative_past_rank_10(self, extender):
+        cache, ext = extender
+        names = [f"n{i:02d}" for i in range(12)]
+        cache.write_metric(
+            "metric1", metric_info(**{n: 100 - i for i, n in enumerate(names)})
+        )
+        resp = ext.prioritize(
+            post(args_obj({"telemetry-policy": "policy1"}, names))
+        )
+        out = json.loads(resp.body)
+        assert out[0] == {"Host": "n00", "Score": 10}
+        assert out[11] == {"Host": "n11", "Score": -1}
+
+
+class TestFilter:
+    def test_get_and_return_node(self, extender):
+        cache, ext = extender
+        cache.write_metric("metric1", metric_info(nodeA=10, nodeB=50))
+        resp = ext.filter(
+            post(args_obj({"telemetry-policy": "policy1"}, ["nodeA", "nodeB"]))
+        )
+        assert resp.status == 200
+        out = json.loads(resp.body)
+        assert [n["metadata"]["name"] for n in out["Nodes"]["items"]] == ["nodeA"]
+        assert out["NodeNames"] == ["nodeA", ""]  # reference trailing-split quirk
+        assert out["FailedNodes"] == {"nodeB": "Node violates"}
+        assert out["Error"] == ""
+
+    def test_no_policy_404_null(self, extender):
+        _, ext = extender
+        resp = ext.filter(post(args_obj({"telemetry-policy": "nope"}, ["node A"])))
+        assert resp.status == 404
+        assert resp.body == b"null\n"
+
+    def test_no_dontschedule_strategy_404(self, extender):
+        cache, ext = extender
+        policy = make_policy(
+            "som-only", strategies={"scheduleonmetric": [rule("m", "GreaterThan", 0)]}
+        )
+        cache.write_policy("default", "som-only", TASPolicy.from_obj(policy))
+        resp = ext.filter(
+            post(args_obj({"telemetry-policy": "som-only"}, ["node A"]))
+        )
+        assert resp.status == 404
+
+    def test_empty_candidates_404(self, extender):
+        cache, ext = extender
+        cache.write_metric("metric1", metric_info(**{"node A": 10}))
+        resp = ext.filter(post(args_obj({"telemetry-policy": "policy1"}, [])))
+        assert resp.status == 404
+
+    def test_all_violating(self, extender):
+        cache, ext = extender
+        cache.write_metric("metric1", metric_info(**{"node A": 99, "node B": 77}))
+        resp = ext.filter(
+            post(args_obj({"telemetry-policy": "policy1"}, ["node A", "node B"]))
+        )
+        out = json.loads(resp.body)
+        assert out["Nodes"]["items"] is None
+        assert out["NodeNames"] == [""]
+        assert set(out["FailedNodes"]) == {"node A", "node B"}
+
+    def test_metric_missing_passes_everything(self, extender):
+        cache, ext = extender
+        resp = ext.filter(
+            post(args_obj({"telemetry-policy": "policy1"}, ["node A", "node B"]))
+        )
+        out = json.loads(resp.body)
+        assert out["FailedNodes"] == {}
+        assert [n["metadata"]["name"] for n in out["Nodes"]["items"]] == [
+            "node A",
+            "node B",
+        ]
+
+
+class TestBind:
+    def test_bind_404(self, extender):
+        _, ext = extender
+        resp = ext.bind(post({}))
+        assert resp.status == 404
+
+
+class TestDeviceHostEquivalence:
+    """Same cache state, same requests: device path output must byte-match
+    the host path (the whole fidelity contract)."""
+
+    @pytest.mark.parametrize("op", ["GreaterThan", "LessThan"])
+    def test_prioritize_random_state(self, op):
+        rng = np.random.default_rng(42)
+        cache_h, ext_h = build(False)
+        cache_d, ext_d = build(True)
+        policy = make_policy(
+            "p", strategies={"scheduleonmetric": [rule("m", op, 0)]}
+        )
+        names = [f"node{i}" for i in range(50)]
+        # distinct values so ordering is unique (tie order differs by design)
+        vals = rng.permutation(np.arange(-25_000, 25_000, 1000))[: len(names)]
+        info = metric_info(**{n: int(v) for n, v in zip(names, vals)})
+        for cache in (cache_h, cache_d):
+            cache.write_policy("default", "p", TASPolicy.from_obj(policy))
+            cache.write_metric("m", info)
+        req = post(args_obj({"telemetry-policy": "p"}, names[:40]))
+        assert ext_h.prioritize(req).body == ext_d.prioritize(req).body
+
+    def test_filter_random_state(self):
+        rng = np.random.default_rng(43)
+        cache_h, ext_h = build(False)
+        cache_d, ext_d = build(True)
+        policy = make_policy(
+            "p",
+            strategies={
+                "dontschedule": [
+                    rule("m1", "GreaterThan", 50),
+                    rule("m2", "LessThan", -10),
+                ]
+            },
+        )
+        names = [f"node{i}" for i in range(60)]
+        m1 = metric_info(
+            **{n: int(rng.integers(0, 100)) for n in names if rng.random() > 0.2}
+        )
+        m2 = metric_info(
+            **{n: int(rng.integers(-50, 50)) for n in names if rng.random() > 0.2}
+        )
+        for cache in (cache_h, cache_d):
+            cache.write_policy("default", "p", TASPolicy.from_obj(policy))
+            cache.write_metric("m1", m1)
+            cache.write_metric("m2", m2)
+        req = post(args_obj({"telemetry-policy": "p"}, names))
+        assert ext_h.filter(req).body == ext_d.filter(req).body
+
+    def test_device_path_actually_used(self):
+        cache, ext = build(True)
+        cache.write_metric("metric1", metric_info(**{"node A": 100}))
+        # sabotage the host cache read to prove the device path answered
+        compiled = ext.mirror.policy("default", "policy1")
+        assert compiled is not None and compiled.scheduleonmetric_row >= 0
+        orig = ext.cache.read_metric
+        ext.cache.read_metric = lambda name: (_ for _ in ()).throw(AssertionError())
+        try:
+            resp = ext.prioritize(
+                post(args_obj({"telemetry-policy": "policy1"}, ["node A"]))
+            )
+            assert json.loads(resp.body) == [{"Host": "node A", "Score": 10}]
+        finally:
+            ext.cache.read_metric = orig
+
+    def test_host_only_metric_falls_back(self):
+        cache, ext = build(True)
+        # sub-milli value: inexact -> host path must serve it
+        cache.write_metric(
+            "metric1",
+            {
+                "node A": NodeMetric(value=Quantity("100500u")),
+                "node B": NodeMetric(value=Quantity("2")),
+            },
+        )
+        assert ext.mirror.metric_host_only("metric1")
+        resp = ext.prioritize(
+            post(args_obj({"telemetry-policy": "policy1"}, ["node A", "node B"]))
+        )
+        assert json.loads(resp.body) == [
+            {"Host": "node B", "Score": 10},
+            {"Host": "node A", "Score": 9},
+        ]
