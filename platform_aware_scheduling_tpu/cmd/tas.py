@@ -82,9 +82,9 @@ def assemble(
     controller = TelemetryPolicyController(kube_client, cache, enforcer)
 
     stop = threading.Event()
-    cache.start_periodic_update(sync_period_s, metrics_client)
+    cache.start_periodic_update(sync_period_s, metrics_client, stop=stop)
     controller.run(stop)
-    enforcer.start_enforcing(cache, sync_period_s)
+    enforcer.start_enforcing(cache, sync_period_s, stop=stop)
     return cache, mirror, extender, controller, enforcer, stop
 
 
@@ -98,27 +98,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     _, _, extender, _, _, stop = assemble(kube_client, metrics_client, sync_period_s)
 
     server = Server(extender)
-    threading.Thread(
-        target=lambda: server.start_server(
-            port=args.port,
-            cert_file=args.cert,
-            key_file=args.key,
-            ca_file=args.cacert,
-            unsafe=args.unsafe,
-            block=True,
-        ),
-        daemon=True,
-    ).start()
+    done = threading.Event()
+    failed = []
+
+    def serve():
+        try:
+            server.start_server(
+                port=args.port,
+                cert_file=args.cert,
+                key_file=args.key,
+                ca_file=args.cacert,
+                unsafe=args.unsafe,
+                block=True,
+            )
+        except Exception as exc:
+            # a dead server must take the process down so the kubelet
+            # restarts it, not leave a Running pod that serves nothing
+            klog.error("extender server failed: %s", exc)
+            failed.append(exc)
+            done.set()
+
+    threading.Thread(target=serve, daemon=True).start()
 
     # catchInterrupt (reference cmd/main.go:113-117)
-    done = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: done.set())
     done.wait()
     stop.set()
     server.shutdown()
     klog.v(1).info_s("Exiting", component="extender")
-    return 0
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

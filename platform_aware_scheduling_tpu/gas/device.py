@@ -1,0 +1,134 @@
+"""Staging layer between the GAS cache and the batched binpack kernel.
+
+Builds the padded ``[nodes, cards, resources]`` tensors for one Filter
+request and runs ops/binpack.py.  Padding uses power-of-two buckets per
+axis so XLA recompiles per bucket, never per request (same recompile-
+avoidance strategy as the TAS mirror, SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from platform_aware_scheduling_tpu.gas import scheduler as gas_logic
+from platform_aware_scheduling_tpu.gas.utils import container_requests
+from platform_aware_scheduling_tpu.kube.objects import Pod
+from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops.binpack import (
+    BinpackNodeState,
+    BinpackRequest,
+    binpack_kernel,
+)
+
+import jax.numpy as jnp
+
+
+def _bucket(n: int, minimum: int) -> int:
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+class DeviceBinpacker:
+    """Evaluates one pod's fit against many nodes in one XLA pass."""
+
+    def __init__(self, cache):
+        self.cache = cache
+
+    def batch_fit(self, pod: Pod, node_names: Sequence[str]) -> Optional[List[bool]]:
+        requests = container_requests(pod)
+        shares = [
+            gas_logic.get_per_gpu_resource_request(req) for req in requests
+        ]
+        max_gpus = max((k for _, k in shares), default=0)
+        resources = sorted({name for req in requests for name in req})
+        if not resources or max_gpus == 0:
+            # no per-card demand: every readable node with GPUs fits, which
+            # the host loop decides cheaply — no point shipping tensors
+            return None
+
+        t_pad = _bucket(len(requests), 2)
+        r_pad = _bucket(len(resources), 4)
+        k_pad = _bucket(max_gpus, 2)
+        res_index = {name: i for i, name in enumerate(resources)}
+
+        need = np.zeros((t_pad, r_pad), dtype=np.int64)
+        need_active = np.zeros((t_pad, r_pad), dtype=bool)
+        num_gpus = np.zeros(t_pad, dtype=np.int32)
+        container_active = np.zeros(t_pad, dtype=bool)
+        for t, ((per_gpu, k), req) in enumerate(zip(shares, requests)):
+            container_active[t] = True
+            num_gpus[t] = k
+            for name, value in per_gpu.items():
+                need[t, res_index[name]] = value
+                need_active[t, res_index[name]] = True
+
+        # per-node staging; nodes that fail before card logic are pre-failed
+        staged = []  # (position, cards, capacity_map, used_map, gpu_set)
+        prefail = np.zeros(len(node_names), dtype=bool)
+        max_cards = 1
+        for pos, name in enumerate(node_names):
+            try:
+                node = self.cache.fetch_node(name)
+            except Exception:
+                prefail[pos] = True
+                continue
+            gpus = gas_logic.get_node_gpu_list(node)
+            if not gpus:
+                prefail[pos] = True
+                continue
+            capacity = gas_logic.get_per_gpu_resource_capacity(node, len(gpus))
+            used = self.cache.get_node_resource_status(name)
+            cards = sorted(set(gpus) | set(used))
+            max_cards = max(max_cards, len(cards))
+            staged.append((pos, cards, capacity, used, set(gpus)))
+
+        if not staged:
+            return [False] * len(node_names)
+
+        n = len(staged)
+        c_pad = _bucket(max_cards, 4)
+        used_np = np.zeros((n, c_pad, r_pad), dtype=np.int64)
+        cap_np = np.zeros((n, r_pad), dtype=np.int64)
+        cap_present = np.zeros((n, r_pad), dtype=bool)
+        card_valid = np.zeros((n, c_pad), dtype=bool)
+        card_real = np.zeros((n, c_pad), dtype=bool)
+        for row, (_pos, cards, capacity, used, gpu_set) in enumerate(staged):
+            for name, value in capacity.items():
+                idx = res_index.get(name)
+                if idx is not None:
+                    cap_np[row, idx] = value
+                    cap_present[row, idx] = True
+            for ci, card in enumerate(cards):
+                card_real[row, ci] = True
+                card_valid[row, ci] = card in gpu_set
+                for name, value in used.get(card, {}).items():
+                    idx = res_index.get(name)
+                    if idx is not None:
+                        used_np[row, ci, idx] = value
+
+        used_hi, used_lo = i64.split_int64_np(used_np)
+        cap_hi, cap_lo = i64.split_int64_np(cap_np)
+        need_hi, need_lo = i64.split_int64_np(need)
+        state = BinpackNodeState(
+            used=i64.I64(hi=jnp.asarray(used_hi), lo=jnp.asarray(used_lo)),
+            capacity=i64.I64(hi=jnp.asarray(cap_hi), lo=jnp.asarray(cap_lo)),
+            cap_present=jnp.asarray(cap_present),
+            card_valid=jnp.asarray(card_valid),
+            card_real=jnp.asarray(card_real),
+        )
+        request = BinpackRequest(
+            need=i64.I64(hi=jnp.asarray(need_hi), lo=jnp.asarray(need_lo)),
+            need_active=jnp.asarray(need_active),
+            num_gpus=jnp.asarray(num_gpus),
+            container_active=jnp.asarray(container_active),
+        )
+        result = binpack_kernel(state, request, k_pad)
+        fits_np = np.asarray(result.fits)
+        out = [False] * len(node_names)
+        for row, (pos, *_rest) in enumerate(staged):
+            out[pos] = bool(fits_np[row])
+        return out

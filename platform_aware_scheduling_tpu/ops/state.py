@@ -69,11 +69,13 @@ class CompiledPolicy:
 
     dontschedule: Optional[CompiledRuleSet] = None
     deschedule: Optional[CompiledRuleSet] = None
-    # scheduleonmetric uses only Rules[0] (telemetryscheduler.go:115-124)
+    # scheduleonmetric uses only Rules[0] (telemetryscheduler.go:115-124).
+    # Unknown operators compile to op_id -1 == index-order ranking, which is
+    # within the reference's envelope (Go map order is randomized there), so
+    # scheduleonmetric never forces a host fallback.
     scheduleonmetric_row: int = -1
     scheduleonmetric_op: int = -1
     scheduleonmetric_metric: str = ""
-    scheduleonmetric_host_only: bool = False
     _device_cache: Dict[str, RuleSet] = field(default_factory=dict)
 
     def device_rules(self, strategy: str) -> Optional[RuleSet]:
@@ -296,7 +298,6 @@ class TensorStateMirror:
             op = OP_IDS.get(rule.operator)
             compiled.scheduleonmetric_op = -1 if op is None else op
             compiled.scheduleonmetric_metric = rule.metricname
-            compiled.scheduleonmetric_host_only = False
         return compiled
 
     # -- reads ----------------------------------------------------------------
@@ -319,14 +320,27 @@ class TensorStateMirror:
         staging arrays are copied at snapshot time so in-flight kernels never
         see a torn update."""
         with self._lock:
-            if self._view is not None and self._view.version == self._version:
-                return self._view
-            hi, lo = i64.split_int64_np(self._values)
-            self._view = DeviceView(
-                values=i64.I64(hi=jnp.asarray(hi), lo=jnp.asarray(lo)),
-                present=jnp.asarray(self._present.copy()),
-                node_names=list(self._node_names),
-                node_index=dict(self._node_index),
-                version=self._version,
-            )
+            return self._view_locked()
+
+    def policy_with_view(
+        self, namespace: str, name: str
+    ) -> Tuple[Optional[CompiledPolicy], DeviceView]:
+        """Atomic (compiled policy, device snapshot) pair under ONE lock
+        acquisition — the policy's rule tensors hold metric ROW indices, so
+        reading them and the matrix in two steps could straddle a metric-row
+        reuse and evaluate the wrong metric."""
+        with self._lock:
+            return self._policies.get((namespace, name)), self._view_locked()
+
+    def _view_locked(self) -> DeviceView:
+        if self._view is not None and self._view.version == self._version:
             return self._view
+        hi, lo = i64.split_int64_np(self._values)
+        self._view = DeviceView(
+            values=i64.I64(hi=jnp.asarray(hi), lo=jnp.asarray(lo)),
+            present=jnp.asarray(self._present.copy()),
+            node_names=list(self._node_names),
+            node_index=dict(self._node_index),
+            version=self._version,
+        )
+        return self._view

@@ -183,10 +183,11 @@ class AutoUpdatingCache:
         period_seconds: float,
         client: Client,
         initial_data: Optional[Dict[str, Any]] = None,
+        stop: Optional[threading.Event] = None,
     ) -> threading.Event:
         """Run :meth:`periodic_update` on a daemon thread; returns the stop
-        event."""
-        stop = threading.Event()
+        event (caller-supplied ``stop`` is used when given)."""
+        stop = stop or threading.Event()
         thread = threading.Thread(
             target=self.periodic_update,
             args=(period_seconds, client, initial_data, stop),

@@ -96,7 +96,7 @@ class TelemetryPolicyController:
         self._informer = Informer(
             ListWatch(list_policies, watch_policies, key),
             on_add=self._guarded(self.on_add),
-            on_update=self._guarded2(self.on_update),
+            on_update=self._guarded(self.on_update),
             on_delete=self._guarded(self.on_delete),
         )
         self._informer.start()
@@ -108,18 +108,9 @@ class TelemetryPolicyController:
         return self._informer
 
     def _guarded(self, fn):
-        def wrapped(obj):
+        def wrapped(*args):
             try:
-                fn(obj)
-            except Exception as exc:
-                klog.error("Recovered from policy event panic: %s", exc)
-
-        return wrapped
-
-    def _guarded2(self, fn):
-        def wrapped(old, new):
-            try:
-                fn(old, new)
+                fn(*args)
             except Exception as exc:
                 klog.error("Recovered from policy event panic: %s", exc)
 

@@ -191,8 +191,13 @@ class MetricEnforcer:
             for strategy_type in self.registered_strategy_types():
                 self.enforce_strategy(strategy_type, cache)
 
-    def start_enforcing(self, cache, period_seconds: float) -> threading.Event:
-        stop = threading.Event()
+    def start_enforcing(
+        self,
+        cache,
+        period_seconds: float,
+        stop: Optional[threading.Event] = None,
+    ) -> threading.Event:
+        stop = stop or threading.Event()
         thread = threading.Thread(
             target=self.enforce_registered_strategies,
             args=(cache, period_seconds, stop),

@@ -32,14 +32,13 @@ order), which is within the reference's behavior envelope.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from platform_aware_scheduling_tpu.extender.server import (
     HTTPRequest,
     HTTPResponse,
-    not_found_handler,
 )
 from platform_aware_scheduling_tpu.extender.types import (
     Args,
@@ -49,7 +48,11 @@ from platform_aware_scheduling_tpu.extender.types import (
 )
 from platform_aware_scheduling_tpu.kube.objects import Node, Pod
 from platform_aware_scheduling_tpu.ops.scoring import filter_kernel, prioritize_kernel
-from platform_aware_scheduling_tpu.ops.state import CompiledPolicy, TensorStateMirror
+from platform_aware_scheduling_tpu.ops.state import (
+    CompiledPolicy,
+    DeviceView,
+    TensorStateMirror,
+)
 from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
 from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
@@ -157,18 +160,20 @@ class MetricsExtender:
             )
             return []
         names = [node.name for node in args.nodes or []]
-        compiled = self._device_policy(policy)
+        compiled, view = self._device_policy(policy)
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
-                return self._prioritize_device(compiled, names)
+                return self._prioritize_device(compiled, view, names)
             except Exception as exc:  # device trouble must never fail the verb
                 klog.error("device prioritize failed, host fallback: %s", exc)
         return self._prioritize_host(rule, names)
 
     def _prioritize_device(
-        self, compiled: CompiledPolicy, candidate_names: List[str]
+        self,
+        compiled: CompiledPolicy,
+        view: DeviceView,
+        candidate_names: List[str],
     ) -> List[HostPriority]:
-        view = self.mirror.device_view()
         mask, _unknown = view.candidate_mask(candidate_names)
         res = prioritize_kernel(
             view.values,
@@ -250,16 +255,17 @@ class MetricsExtender:
     def _violating_nodes(
         self, policy: TASPolicy, strategy: dontschedule.Strategy
     ) -> Dict[str, None]:
-        compiled = self._device_policy(policy)
+        compiled, view = self._device_policy(policy)
         if compiled is not None and self._device_filter_ok(compiled):
             try:
-                return self._violating_device(compiled)
+                return self._violating_device(compiled, view)
             except Exception as exc:
                 klog.error("device filter failed, host fallback: %s", exc)
         return strategy.violated(self.cache)
 
-    def _violating_device(self, compiled: CompiledPolicy) -> Dict[str, None]:
-        view = self.mirror.device_view()
+    def _violating_device(
+        self, compiled: CompiledPolicy, view: DeviceView
+    ) -> Dict[str, None]:
         rules = compiled.device_rules("dontschedule")
         all_nodes = jnp.ones(view.node_capacity, dtype=bool)
         passing = filter_kernel(view.values, view.present, rules, all_nodes)
@@ -298,18 +304,19 @@ class MetricsExtender:
 
     # -- device-path eligibility ----------------------------------------------
 
-    def _device_policy(self, policy: TASPolicy) -> Optional[CompiledPolicy]:
+    def _device_policy(self, policy: TASPolicy):
+        """Atomic (compiled, view) snapshot — see
+        TensorStateMirror.policy_with_view for why both come from one lock
+        acquisition."""
         if self.mirror is None:
-            return None
-        return self.mirror.policy(policy.namespace, policy.name)
+            return None, None
+        return self.mirror.policy_with_view(policy.namespace, policy.name)
 
     def _device_prioritize_ok(
         self, compiled: CompiledPolicy, rule: TASPolicyRule
     ) -> bool:
-        return (
-            compiled.scheduleonmetric_row >= 0
-            and not compiled.scheduleonmetric_host_only
-            and not self.mirror.metric_host_only(rule.metricname)
+        return compiled.scheduleonmetric_row >= 0 and not self.mirror.metric_host_only(
+            rule.metricname
         )
 
     def _device_filter_ok(self, compiled: CompiledPolicy) -> bool:

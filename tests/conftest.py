@@ -9,12 +9,21 @@ before JAX is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the ambient environment may pin JAX to the real
+# accelerator (e.g. an axon sitecustomize calling
+# jax.config.update("jax_platforms", "axon,cpu"), which beats env vars);
+# tests always run on the virtual CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 # repo root on sys.path so `import platform_aware_scheduling_tpu` works
 # without installation

@@ -1,0 +1,204 @@
+"""E2E tier: the full TAS service — HTTP server, cache, mirror, controller,
+metric puller, enforcer — assembled exactly as cmd/tas.py does, driven over
+a real socket, with cluster state in the fake kube layer.
+
+Mirrors the reference's kind-cluster e2e scenarios
+(reference .github/e2e/e2e_test.go):
+  * TestTASFilter   (:89)  — only the node passing dontschedule survives
+  * TestTASPrioritize (:126) — the best-metric node wins
+  * TestTASDeschedule (:162) — violating nodes get the <policy>=violating label
+  * TestAddAndDeletePolicy (:203) — 5x policy churn keeps answering correctly
+The metric fixtures play the role of the node{1,2,3} textfile fixtures
+(.github/scripts/policies/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from platform_aware_scheduling_tpu.cmd.tas import assemble
+from platform_aware_scheduling_tpu.extender.server import Server
+from platform_aware_scheduling_tpu.tas.metrics import CustomMetricsClient
+from platform_aware_scheduling_tpu.testing.builders import (
+    make_node,
+    make_policy,
+    rule,
+)
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+
+SYNC_PERIOD_S = 0.05
+
+
+def wait_until(pred, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture()
+def cluster():
+    kube = FakeKubeClient()
+    for name in ("kind-worker", "kind-worker2", "kind-worker3"):
+        kube.add_node(make_node(name))
+    # textfile-fixture equivalents (.github/scripts/policies/node{1,2,3}):
+    # only kind-worker2 passes filter1 <= 40; worker2 wins prioritize1;
+    # worker2 violates deschedule1 > 8
+    metrics = {
+        "filter1_metric": {"kind-worker": 90, "kind-worker2": 20, "kind-worker3": 70},
+        "prioritize1_metric": {"kind-worker": 10, "kind-worker2": 9999, "kind-worker3": 50},
+        "deschedule1_metric": {"kind-worker": 1, "kind-worker2": 9, "kind-worker3": 2},
+    }
+    for metric, per_node in metrics.items():
+        for node, value in per_node.items():
+            kube.set_node_metric(metric, node, str(value))
+
+    cache, mirror, extender, controller, enforcer, stop = assemble(
+        kube, CustomMetricsClient(kube), SYNC_PERIOD_S
+    )
+    server = Server(extender)
+    import threading
+
+    threading.Thread(
+        target=lambda: server.start_server(
+            port="0", unsafe=True, host="127.0.0.1", block=True
+        ),
+        daemon=True,
+    ).start()
+    assert server.wait_ready()
+    yield kube, cache, server, stop
+    stop.set()
+    server.shutdown()
+
+
+def call(server, verb, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/scheduler/{verb}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def sched_args(policy_name):
+    return {
+        "Pod": {
+            "metadata": {
+                "name": "demo-pod",
+                "namespace": "default",
+                "labels": {"telemetry-policy": policy_name},
+            }
+        },
+        "Nodes": {
+            "items": [
+                {"metadata": {"name": n}}
+                for n in ("kind-worker", "kind-worker2", "kind-worker3")
+            ]
+        },
+    }
+
+
+def demo_policy(name="e2e-policy"):
+    return make_policy(
+        name,
+        strategies={
+            "dontschedule": [rule("filter1_metric", "GreaterThan", 40)],
+            "scheduleonmetric": [rule("prioritize1_metric", "GreaterThan", 0)],
+            "deschedule": [rule("deschedule1_metric", "GreaterThan", 8)],
+        },
+    )
+
+
+def policy_ready(kube, server, name):
+    """Policy created AND metrics pulled (the waitForMetrics equivalent,
+    e2e_test.go:242-255): a filter answer that actually excludes nodes."""
+
+    def check():
+        status, body = call(server, "filter", sched_args(name))
+        if status != 200:
+            return False
+        out = json.loads(body)
+        return out.get("FailedNodes")
+
+    return wait_until(check)
+
+
+class TestE2E:
+    def test_filter(self, cluster):
+        kube, cache, server, _ = cluster
+        kube.create_taspolicy(demo_policy())
+        assert policy_ready(kube, server, "e2e-policy")
+        status, body = call(server, "filter", sched_args("e2e-policy"))
+        assert status == 200
+        out = json.loads(body)
+        assert out["NodeNames"] == ["kind-worker2", ""]
+        assert set(out["FailedNodes"]) == {"kind-worker", "kind-worker3"}
+
+    def test_prioritize(self, cluster):
+        kube, cache, server, _ = cluster
+        kube.create_taspolicy(demo_policy())
+        assert policy_ready(kube, server, "e2e-policy")
+        status, body = call(server, "prioritize", sched_args("e2e-policy"))
+        assert status == 200
+        out = json.loads(body)
+        assert out[0] == {"Host": "kind-worker2", "Score": 10}
+        assert len(out) == 3
+
+    def test_deschedule_labels_node(self, cluster):
+        kube, cache, server, _ = cluster
+        kube.create_taspolicy(demo_policy())
+        assert policy_ready(kube, server, "e2e-policy")
+        # enforcer ticks every SYNC_PERIOD_S; kind-worker2 violates (9 > 8)
+        assert wait_until(
+            lambda: kube.get_node("kind-worker2").get_labels().get("e2e-policy")
+            == "violating"
+        )
+        others = [
+            kube.get_node(n).get_labels().get("e2e-policy")
+            for n in ("kind-worker", "kind-worker3")
+        ]
+        assert all(v in (None, "null") for v in others)
+
+    def test_deschedule_label_clears_when_healthy(self, cluster):
+        kube, cache, server, _ = cluster
+        kube.create_taspolicy(demo_policy())
+        assert wait_until(
+            lambda: kube.get_node("kind-worker2").get_labels().get("e2e-policy")
+            == "violating"
+        )
+        kube.set_node_metric("deschedule1_metric", "kind-worker2", "1")
+        # reference's label-to-"null" oddity (deschedule/enforce.go:118-132)
+        assert wait_until(
+            lambda: kube.get_node("kind-worker2").get_labels().get("e2e-policy")
+            == "null"
+        )
+
+    def test_add_and_delete_policy_churn(self, cluster):
+        """e2e_test.go:203-205: repeated add/delete must not wedge state."""
+        kube, cache, server, _ = cluster
+        for round_ in range(5):
+            kube.create_taspolicy(demo_policy())
+            assert policy_ready(kube, server, "e2e-policy"), round_
+            status, body = call(server, "filter", sched_args("e2e-policy"))
+            assert json.loads(body)["NodeNames"] == ["kind-worker2", ""], round_
+            kube.delete_taspolicy("default", "e2e-policy")
+            assert wait_until(
+                lambda: json.loads(
+                    call(server, "filter", sched_args("e2e-policy"))[1]
+                )
+                is None,
+                timeout=5.0,
+            ), round_
+
+    def test_unknown_policy_404(self, cluster):
+        _, _, server, _ = cluster
+        status, body = call(server, "filter", sched_args("ghost-policy"))
+        assert status == 404
+        assert body == b"null\n"

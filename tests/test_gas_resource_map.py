@@ -1,0 +1,95 @@
+"""ResourceMap arithmetic parity with the reference's overflow/clamp rules
+(reference gpu-aware-scheduling/pkg/gpuscheduler/resource_map_test.go
+behaviors)."""
+
+import pytest
+
+from platform_aware_scheduling_tpu.gas.resource_map import (
+    INT64_MAX,
+    InputError,
+    OverflowError64,
+    ResourceMap,
+)
+
+
+class TestAdd:
+    def test_add_new_and_existing(self):
+        rm = ResourceMap()
+        rm.add("r", 5)
+        rm.add("r", 7)
+        assert rm["r"] == 12
+
+    def test_add_negative_rejected(self):
+        rm = ResourceMap(r=1)
+        with pytest.raises(InputError):
+            rm.add("r", -1)
+        assert rm["r"] == 1
+
+    def test_add_overflow_detected(self):
+        rm = ResourceMap(r=INT64_MAX)
+        with pytest.raises(OverflowError64):
+            rm.add("r", 1)
+        assert rm["r"] == INT64_MAX
+
+    def test_add_to_missing_key_no_overflow_check(self):
+        # fresh key skips the overflow branch, like the reference
+        rm = ResourceMap()
+        rm.add("r", INT64_MAX)
+        assert rm["r"] == INT64_MAX
+
+
+class TestSubtract:
+    def test_subtract_basic(self):
+        rm = ResourceMap(r=10)
+        rm.subtract("r", 4)
+        assert rm["r"] == 6
+
+    def test_subtract_clamps_to_zero(self):
+        rm = ResourceMap(r=3)
+        rm.subtract("r", 10)
+        assert rm["r"] == 0
+
+    def test_subtract_missing_key_errors(self):
+        rm = ResourceMap()
+        with pytest.raises(InputError):
+            rm.subtract("ghost", 1)
+
+    def test_subtract_negative_rejected(self):
+        rm = ResourceMap(r=1)
+        with pytest.raises(InputError):
+            rm.subtract("r", -1)
+
+
+class TestTransactional:
+    def test_add_rm_all_or_nothing(self):
+        rm = ResourceMap(a=1, b=INT64_MAX)
+        with pytest.raises(OverflowError64):
+            rm.add_rm(ResourceMap(a=1, b=1))
+        assert rm == {"a": 1, "b": INT64_MAX}  # untouched
+
+    def test_subtract_rm_all_or_nothing(self):
+        rm = ResourceMap(a=5)
+        with pytest.raises(InputError):
+            rm.subtract_rm(ResourceMap(a=1, ghost=1))
+        assert rm == {"a": 5}
+
+    def test_add_rm_success(self):
+        rm = ResourceMap(a=1)
+        rm.add_rm(ResourceMap(a=2, b=3))
+        assert rm == {"a": 3, "b": 3}
+
+
+class TestDivide:
+    def test_divide(self):
+        rm = ResourceMap(a=10, b=7)
+        rm.divide(2)
+        assert rm == {"a": 5, "b": 3}
+
+    def test_divide_by_one_noop(self):
+        rm = ResourceMap(a=9)
+        rm.divide(1)
+        assert rm == {"a": 9}
+
+    def test_divide_bad_divider(self):
+        with pytest.raises(InputError):
+            ResourceMap(a=1).divide(0)

@@ -1,0 +1,364 @@
+"""GAS extender tests: filter fit-checks, bind booking/rollback, cache
+ingestion/replay, device-vs-host binpack equivalence."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.gas.cache import Cache, get_key
+from platform_aware_scheduling_tpu.gas.resource_map import ResourceMap
+from platform_aware_scheduling_tpu.gas.scheduler import (
+    GASExtender,
+    check_resource_capacity,
+    get_node_gpu_list,
+    get_per_gpu_resource_capacity,
+    get_per_gpu_resource_request,
+)
+from platform_aware_scheduling_tpu.gas.utils import (
+    CARD_ANNOTATION,
+    container_requests,
+    has_gpu_resources,
+    is_completed_pod,
+)
+from platform_aware_scheduling_tpu.testing.builders import make_node, make_pod
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+
+
+def post(obj) -> HTTPRequest:
+    return HTTPRequest(
+        method="POST",
+        path="/scheduler/filter",
+        headers={"Content-Type": "application/json"},
+        body=json.dumps(obj).encode(),
+    )
+
+
+def gpu_node(name, cards=2, i915=2, millicores=2000, memory=4000):
+    return make_node(
+        name,
+        labels={"gpu.intel.com/cards": ".".join(f"card{i}" for i in range(cards))},
+        allocatable={
+            "gpu.intel.com/i915": str(i915),
+            "gpu.intel.com/millicores": str(millicores),
+            "gpu.intel.com/memory.max": str(memory),
+        },
+    )
+
+
+def gpu_pod(name, i915="1", millicores="500", node_name="", annotations=None,
+            phase="Pending", containers=1):
+    reqs = [{
+        "gpu.intel.com/i915": i915,
+        "gpu.intel.com/millicores": millicores,
+    }] * containers
+    return make_pod(
+        name,
+        container_requests=reqs,
+        node_name=node_name,
+        annotations=annotations,
+        phase=phase,
+    )
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture(params=[False, True], ids=["host", "device"])
+def setup(request):
+    kube = FakeKubeClient()
+    cache = Cache(kube, start=False)
+    ext = GASExtender(kube, cache=cache, use_device=request.param)
+    yield kube, cache, ext
+    cache.stop()
+
+
+def start(cache):
+    cache.start()
+
+
+class TestUtils:
+    def test_container_requests_prefix_only(self):
+        pod = make_pod("p", container_requests=[
+            {"cpu": "2", "gpu.intel.com/i915": "1", "gpu.intel.com/millicores": "100"}
+        ])
+        reqs = container_requests(pod)
+        assert reqs == [{"gpu.intel.com/i915": 1, "gpu.intel.com/millicores": 100}]
+
+    def test_fractional_quantity_reads_zero(self):
+        # AsInt64 of a fractional quantity: value 0 (reference ignores ok)
+        pod = make_pod("p", container_requests=[{"gpu.intel.com/tiles": "500m"}])
+        assert container_requests(pod) == [{"gpu.intel.com/tiles": 0}]
+
+    def test_has_gpu_resources(self):
+        assert has_gpu_resources(gpu_pod("p"))
+        assert not has_gpu_resources(make_pod("p", container_requests=[{"cpu": "1"}]))
+        assert not has_gpu_resources(None)
+
+    def test_is_completed_pod(self):
+        assert is_completed_pod(make_pod("p", phase="Succeeded"))
+        assert is_completed_pod(make_pod("p", phase="Failed"))
+        assert not is_completed_pod(make_pod("p", phase="Running"))
+        pod = make_pod("p", phase="Running")
+        pod.metadata["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+        assert is_completed_pod(pod)
+
+
+class TestHelpers:
+    def test_gpu_list_and_capacity(self):
+        node = gpu_node("n1", cards=2, i915=2, millicores=2000)
+        assert get_node_gpu_list(node) == ["card0", "card1"]
+        per_gpu = get_per_gpu_resource_capacity(node, 2)
+        assert per_gpu["gpu.intel.com/i915"] == 1
+        assert per_gpu["gpu.intel.com/millicores"] == 1000
+
+    def test_no_label_gives_empty(self):
+        assert get_node_gpu_list(make_node("n")) == []
+
+    def test_per_gpu_request_division(self):
+        rm = ResourceMap({"gpu.intel.com/i915": 2, "gpu.intel.com/millicores": 900})
+        per_gpu, k = get_per_gpu_resource_request(rm)
+        assert k == 2
+        assert per_gpu["gpu.intel.com/millicores"] == 450
+        assert per_gpu["gpu.intel.com/i915"] == 1
+
+    def test_check_resource_capacity(self):
+        cap = ResourceMap(a=10)
+        assert check_resource_capacity(ResourceMap(a=5), cap, ResourceMap(a=5))
+        assert not check_resource_capacity(ResourceMap(a=6), cap, ResourceMap(a=5))
+        assert not check_resource_capacity(ResourceMap(b=0), cap, ResourceMap())
+        assert not check_resource_capacity(ResourceMap(a=0), ResourceMap(a=0),
+                                           ResourceMap())
+
+
+class TestFilter:
+    def test_fit_and_reject(self, setup):
+        kube, cache, ext = setup
+        kube.add_node(gpu_node("empty-node"))
+        kube.add_node(gpu_node("small-node", cards=1, i915=1, millicores=100))
+        start(cache)
+        resp = ext.filter(post({
+            "Pod": gpu_pod("p", millicores="500").raw,
+            "NodeNames": ["empty-node", "small-node"],
+        }))
+        assert resp.status == 200
+        out = json.loads(resp.body)
+        assert out["NodeNames"] == ["empty-node"]
+        assert out["FailedNodes"] == {
+            "small-node": "Not enough GPU-resources for deployment"
+        }
+
+    def test_missing_node_names_is_error_404(self, setup):
+        _, cache, ext = setup
+        start(cache)
+        resp = ext.filter(post({"Pod": gpu_pod("p").raw, "Nodes": {"items": []}}))
+        assert resp.status == 404
+        assert "NodeCacheCapable" in json.loads(resp.body)["Error"]
+
+    def test_unknown_node_fails(self, setup):
+        _, cache, ext = setup
+        start(cache)
+        resp = ext.filter(post({
+            "Pod": gpu_pod("p").raw, "NodeNames": ["ghost"],
+        }))
+        out = json.loads(resp.body)
+        assert out["NodeNames"] is None or out["NodeNames"] == []
+        assert "ghost" in out["FailedNodes"]
+
+    def test_used_resources_counted(self, setup):
+        kube, cache, ext = setup
+        kube.add_node(gpu_node("n1", cards=1, i915=2, millicores=1000))
+        start(cache)
+        # book 800 of 1000 millicores on the single card
+        booked = gpu_pod("booked", millicores="800", node_name="n1")
+        cache.adjust_pod_resources_locked(booked, True, "card0", "n1")
+        resp = ext.filter(post({
+            "Pod": gpu_pod("p", millicores="300").raw, "NodeNames": ["n1"],
+        }))
+        out = json.loads(resp.body)
+        assert out["FailedNodes"] == {"n1": "Not enough GPU-resources for deployment"}
+        resp = ext.filter(post({
+            "Pod": gpu_pod("p2", millicores="200").raw, "NodeNames": ["n1"],
+        }))
+        assert json.loads(resp.body)["NodeNames"] == ["n1"]
+
+    def test_multi_gpu_spread(self, setup):
+        kube, cache, ext = setup
+        # 2 cards, 1000 each; i915=2 request of 1600 -> 800 per card: fits
+        kube.add_node(gpu_node("n1", cards=2, i915=2, millicores=2000))
+        start(cache)
+        resp = ext.filter(post({
+            "Pod": gpu_pod("p", i915="2", millicores="1600").raw,
+            "NodeNames": ["n1"],
+        }))
+        assert json.loads(resp.body)["NodeNames"] == ["n1"]
+
+    def test_prioritize_404(self, setup):
+        _, cache, ext = setup
+        resp = ext.prioritize(post({}))
+        assert resp.status == 404
+
+
+class TestBind:
+    def test_bind_annotates_and_books(self, setup):
+        kube, cache, ext = setup
+        kube.add_node(gpu_node("n1"))
+        pod = gpu_pod("p", millicores="500")
+        kube.add_pod(pod)
+        start(cache)
+        resp = ext.bind(post({
+            "PodName": "p", "PodNamespace": "default",
+            "PodUID": pod.uid, "Node": "n1",
+        }))
+        assert resp.status == 200
+        assert json.loads(resp.body) == {"Error": ""}
+        bound = kube.get_pod("default", "p")
+        assert bound.get_annotations()[CARD_ANNOTATION] == "card0"
+        assert "gas-ts" in bound.get_annotations()
+        assert bound.spec_node_name == "n1"
+        used = cache.get_node_resource_status("n1")
+        assert used["card0"]["gpu.intel.com/millicores"] == 500
+
+    def test_bind_unknown_pod_errors(self, setup):
+        _, cache, ext = setup
+        start(cache)
+        resp = ext.bind(post({
+            "PodName": "ghost", "PodNamespace": "default",
+            "PodUID": "u", "Node": "n1",
+        }))
+        assert resp.status == 404
+        assert json.loads(resp.body)["Error"] != ""
+
+    def test_bind_wont_fit_rolls_back(self, setup):
+        kube, cache, ext = setup
+        kube.add_node(gpu_node("n1", cards=1, i915=1, millicores=100))
+        pod = gpu_pod("p", millicores="500")
+        kube.add_pod(pod)
+        start(cache)
+        resp = ext.bind(post({
+            "PodName": "p", "PodNamespace": "default",
+            "PodUID": pod.uid, "Node": "n1",
+        }))
+        assert resp.status == 404
+        assert cache.get_node_resource_status("n1") == {}
+        assert get_key(pod) not in cache.annotated_pods
+
+
+class TestCacheIngestion:
+    def test_annotated_pod_replayed_on_start(self):
+        """Restart reconstruction: informer ADD events replay annotated pods
+        (SURVEY §3.7 / §5.4)."""
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1"))
+        kube.add_pod(gpu_pod("p", millicores="600", node_name="n1",
+                             annotations={CARD_ANNOTATION: "card0"}))
+        cache = Cache(kube, start=False)
+        cache.start()
+        try:
+            assert wait_until(
+                lambda: cache.get_node_resource_status("n1")
+                .get("card0", {})
+                .get("gpu.intel.com/millicores") == 600
+            )
+        finally:
+            cache.stop()
+
+    def test_completed_pod_releases_resources(self):
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1"))
+        pod = gpu_pod("p", millicores="600", node_name="n1",
+                      annotations={CARD_ANNOTATION: "card0"})
+        kube.add_pod(pod)
+        cache = Cache(kube, start=False)
+        cache.start()
+        try:
+            assert wait_until(
+                lambda: get_key(pod) in cache.annotated_pods
+            )
+            done = gpu_pod("p", millicores="600", node_name="n1",
+                           annotations={CARD_ANNOTATION: "card0"},
+                           phase="Succeeded")
+            done.metadata["uid"] = pod.uid
+            done.metadata["resourceVersion"] = "99"
+            kube.update_pod(done)
+            assert wait_until(
+                lambda: get_key(pod) not in cache.annotated_pods
+            )
+            used = cache.get_node_resource_status("n1")
+            assert used["card0"]["gpu.intel.com/millicores"] == 0
+        finally:
+            cache.stop()
+
+    def test_deleted_pod_releases_resources(self):
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1"))
+        pod = gpu_pod("p", millicores="600", node_name="n1",
+                      annotations={CARD_ANNOTATION: "card0"})
+        kube.add_pod(pod)
+        cache = Cache(kube, start=False)
+        cache.start()
+        try:
+            assert wait_until(lambda: get_key(pod) in cache.annotated_pods)
+            kube.delete_pod("default", "p")
+            assert wait_until(lambda: get_key(pod) not in cache.annotated_pods)
+            used = cache.get_node_resource_status("n1")
+            assert used["card0"]["gpu.intel.com/millicores"] == 0
+        finally:
+            cache.stop()
+
+
+class TestDeviceHostEquivalence:
+    """Randomized cluster state: the batched kernel's verdicts must match
+    the host first-fit on every node."""
+
+    def test_random_fit_equivalence(self):
+        rng = np.random.default_rng(7)
+        kube = FakeKubeClient()
+        names = []
+        for i in range(24):
+            name = f"n{i}"
+            names.append(name)
+            kube.add_node(gpu_node(
+                name,
+                cards=int(rng.integers(1, 5)),
+                i915=int(rng.integers(1, 9)),
+                millicores=int(rng.integers(100, 4000)),
+                memory=int(rng.integers(100, 8000)),
+            ))
+        cache = Cache(kube, start=False)
+        ext_host = GASExtender(kube, cache=cache, use_device=False)
+        ext_dev = GASExtender(kube, cache=cache, use_device=True)
+        cache.start()
+        try:
+            # seed random bookings
+            for i in range(10):
+                node = f"n{int(rng.integers(0, 24))}"
+                pod = gpu_pod(f"seed{i}",
+                              millicores=str(int(rng.integers(0, 1500))),
+                              node_name=node)
+                card = f"card{int(rng.integers(0, 4))}"
+                try:
+                    cache.adjust_pod_resources_locked(pod, True, card, node)
+                except Exception:
+                    pass
+            for trial in range(8):
+                pod = gpu_pod(
+                    f"trial{trial}",
+                    i915=str(int(rng.integers(1, 4))),
+                    millicores=str(int(rng.integers(0, 3000))),
+                    containers=int(rng.integers(1, 3)),
+                )
+                req = post({"Pod": pod.raw, "NodeNames": names})
+                host_out = json.loads(ext_host.filter(req).body)
+                dev_out = json.loads(ext_dev.filter(req).body)
+                assert host_out == dev_out, f"trial {trial} diverged"
+        finally:
+            cache.stop()
