@@ -1,0 +1,28 @@
+# Build/test fan-out (capability parity: reference top-level Makefile:1-9).
+.PHONY: all test e2e bench lint image clean dryrun
+
+all: test
+
+test:
+	python -m pytest tests/ -q
+
+e2e:
+	python -m pytest tests/test_e2e.py -q
+
+bench:
+	python bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+lint:
+	python -m compileall -q platform_aware_scheduling_tpu tests bench.py __graft_entry__.py
+
+image:
+	docker build -f deploy/images/Dockerfile.tas -t pas-tpu-tas .
+	docker build -f deploy/images/Dockerfile.gas -t pas-tpu-gas .
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+	rm -rf build dist *.egg-info

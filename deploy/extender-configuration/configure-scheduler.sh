@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Point the stock kube-scheduler at the extender(s): drops the
+# KubeSchedulerConfiguration onto the control-plane host and patches the
+# static-pod manifest to mount + use it.
+# (capability parity: reference deploy/extender-configuration/configure-scheduler.sh)
+set -euo pipefail
+
+CONFIG=${1:-scheduler-config.yaml}
+DEST=/etc/kubernetes/scheduler-extender-config.yaml
+MANIFEST=/etc/kubernetes/manifests/kube-scheduler.yaml
+
+if [[ ! -f "$CONFIG" ]]; then
+  echo "config $CONFIG not found" >&2
+  exit 1
+fi
+
+# detect the served KubeSchedulerConfiguration version
+VERSION=$(kubectl version -o json 2>/dev/null |
+  python3 -c 'import json,sys; v=json.load(sys.stdin)["serverVersion"]; print("v1" if (int(v["major"]),int(v["minor"].rstrip("+")))>=(1,25) else "v1beta3")' \
+  || echo v1)
+sed "s|kubescheduler.config.k8s.io/v1|kubescheduler.config.k8s.io/${VERSION}|" \
+  "$CONFIG" | sudo tee "$DEST" >/dev/null
+
+# mount the config into the scheduler static pod and pass --config
+sudo python3 - "$MANIFEST" "$DEST" <<'EOF'
+import sys, yaml
+manifest_path, config_path = sys.argv[1], sys.argv[2]
+with open(manifest_path) as f:
+    pod = yaml.safe_load(f)
+spec = pod["spec"]
+container = spec["containers"][0]
+flag = f"--config={config_path}"
+if flag not in container["command"]:
+    container["command"] = [
+        c for c in container["command"] if not c.startswith("--config=")
+    ] + [flag]
+mounts = container.setdefault("volumeMounts", [])
+if not any(m.get("name") == "extender-config" for m in mounts):
+    mounts.append({"name": "extender-config", "mountPath": config_path,
+                   "readOnly": True})
+volumes = spec.setdefault("volumes", [])
+if not any(v.get("name") == "extender-config" for v in volumes):
+    volumes.append({"name": "extender-config",
+                    "hostPath": {"path": config_path, "type": "File"}})
+with open(manifest_path, "w") as f:
+    yaml.safe_dump(pod, f)
+print("kube-scheduler manifest updated; kubelet will restart it")
+EOF

@@ -1,0 +1,209 @@
+"""Hardening tier — what the reference's suite lacks (SURVEY §4 gaps):
+mTLS handshake behavior over a live socket, concurrent bind/filter stress
+on the GAS booking path, and the validation prestop runner."""
+
+import json
+import os
+import ssl
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPResponse, Server
+from platform_aware_scheduling_tpu.extender.types import FilterResult
+from platform_aware_scheduling_tpu.gas.cache import Cache
+from platform_aware_scheduling_tpu.gas.scheduler import GASExtender
+from platform_aware_scheduling_tpu.testing.builders import make_node, make_pod
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+
+
+class StubScheduler:
+    def filter(self, request):
+        return HTTPResponse.json(FilterResult(node_names=["n1"]).to_json())
+
+    prioritize = filter
+
+    def bind(self, request):
+        return HTTPResponse(status=404)
+
+
+def gen_certs(tmp_path):
+    """Throwaway CA + server/client certs (SAN 127.0.0.1)."""
+    ca_key = tmp_path / "ca.key"
+    ca_crt = tmp_path / "ca.crt"
+    run = lambda *cmd: subprocess.run(cmd, check=True, capture_output=True)
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+        "-subj", "/CN=test-ca")
+    certs = {}
+    for name in ("server", "client"):
+        key = tmp_path / f"{name}.key"
+        csr = tmp_path / f"{name}.csr"
+        crt = tmp_path / f"{name}.crt"
+        ext = tmp_path / f"{name}.ext"
+        ext.write_text("subjectAltName=IP:127.0.0.1\n")
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={name}")
+        run("openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+            "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(crt),
+            "-days", "1", "-extfile", str(ext))
+        certs[name] = (str(crt), str(key))
+    return str(ca_crt), certs
+
+
+@pytest.fixture(scope="module")
+def tls_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("certs")
+    ca, certs = gen_certs(tmp)
+    server = Server(StubScheduler())
+    thread = threading.Thread(
+        target=lambda: server.start_server(
+            port="0",
+            cert_file=certs["server"][0],
+            key_file=certs["server"][1],
+            ca_file=ca,
+            unsafe=False,
+            host="127.0.0.1",
+            block=True,
+        ),
+        daemon=True,
+    )
+    thread.start()
+    assert server.wait_ready()
+    yield server, ca, certs
+    server.shutdown()
+
+
+class TestMTLS:
+    def _ctx(self, ca, client_cert=None):
+        ctx = ssl.create_default_context(cafile=ca)
+        ctx.check_hostname = False
+        if client_cert:
+            ctx.load_cert_chain(*client_cert)
+        return ctx
+
+    def _post(self, server, ctx):
+        req = urllib.request.Request(
+            f"https://127.0.0.1:{server.port}/scheduler/filter",
+            data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        return urllib.request.urlopen(req, timeout=5, context=ctx)
+
+    def test_mutual_tls_roundtrip(self, tls_server):
+        server, ca, certs = tls_server
+        resp = self._post(server, self._ctx(ca, certs["client"]))
+        assert resp.status == 200
+        assert json.loads(resp.read())["NodeNames"] == ["n1"]
+
+    def test_client_cert_required(self, tls_server):
+        server, ca, _ = tls_server
+        with pytest.raises((ssl.SSLError, urllib.error.URLError, ConnectionError)):
+            self._post(server, self._ctx(ca))
+
+    def test_tls12_minimum(self, tls_server):
+        server, ca, certs = tls_server
+        ctx = self._ctx(ca, certs["client"])
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_1
+        ctx.maximum_version = ssl.TLSVersion.TLSv1_1
+        with pytest.raises((ssl.SSLError, urllib.error.URLError, ConnectionError)):
+            self._post(server, ctx)
+
+
+class TestBindStress:
+    """Concurrent binds + filters must keep booking consistent: every
+    successful bind books exactly its request; total booked usage equals
+    the sum over bound pods (the reference leaves this untested)."""
+
+    def test_concurrent_bind_filter(self):
+        kube = FakeKubeClient()
+        kube.add_node(make_node(
+            "n1",
+            labels={"gpu.intel.com/cards": "card0.card1.card2.card3"},
+            allocatable={"gpu.intel.com/i915": "16",
+                         "gpu.intel.com/millicores": "4000"},
+        ))
+        pods = []
+        for i in range(12):
+            pod = make_pod(
+                f"p{i}",
+                container_requests=[{"gpu.intel.com/i915": "1",
+                                     "gpu.intel.com/millicores": "250"}],
+            )
+            pods.append(pod)
+            kube.add_pod(pod)
+        cache = Cache(kube, start=False)
+        ext = GASExtender(kube, cache=cache, use_device=False)
+        cache.start()
+        try:
+            results = []
+            lock = threading.Lock()
+
+            def do_bind(pod):
+                body = json.dumps({
+                    "PodName": pod.name, "PodNamespace": "default",
+                    "PodUID": pod.uid, "Node": "n1",
+                }).encode()
+                from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+                resp = ext.bind(HTTPRequest("POST", "/scheduler/bind",
+                                            {"Content-Type": "application/json"},
+                                            body))
+                with lock:
+                    results.append(json.loads(resp.body)["Error"])
+
+            def do_filter():
+                from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+                body = json.dumps({
+                    "Pod": make_pod("probe", container_requests=[
+                        {"gpu.intel.com/i915": "1",
+                         "gpu.intel.com/millicores": "100"}]).raw,
+                    "NodeNames": ["n1"],
+                }).encode()
+                ext.filter(HTTPRequest("POST", "/scheduler/filter",
+                                       {"Content-Type": "application/json"},
+                                       body))
+
+            threads = [threading.Thread(target=do_bind, args=(p,)) for p in pods]
+            threads += [threading.Thread(target=do_filter) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            bound = [e for e in results if e == ""]
+            # 4000 millicores / 250 each = 16 would fit by millicores, but
+            # 16 i915 / 4 cards = 4 per card x 4 cards = 16 i915 -> all 12 fit
+            assert len(bound) == 12, results
+            used = cache.get_node_resource_status("n1")
+            total_milli = sum(rm.get("gpu.intel.com/millicores", 0)
+                              for rm in used.values())
+            total_i915 = sum(rm.get("gpu.intel.com/i915", 0)
+                             for rm in used.values())
+            assert total_milli == 12 * 250
+            assert total_i915 == 12
+            # per-card capacity never exceeded
+            for card, rm in used.items():
+                assert rm.get("gpu.intel.com/millicores", 0) <= 1000
+                assert rm.get("gpu.intel.com/i915", 0) <= 4
+        finally:
+            cache.stop()
+
+
+class TestValidationRunner:
+    def test_prestop_triggers_event(self):
+        from platform_aware_scheduling_tpu.testing.validation import serve_prestop
+
+        trigger = threading.Event()
+        server = serve_prestop(trigger, port=0)
+        port = server.server_address[1]
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/prestop", timeout=5
+            )
+            assert resp.status == 200
+            assert trigger.wait(2)
+        finally:
+            server.shutdown()
