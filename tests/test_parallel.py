@@ -204,3 +204,48 @@ class TestPadding:
         assert out.shape == (2, 8)
         assert (out[:, 5:] == -1).all()
         assert pad_to_multiple(arr, 1, 5).shape == (2, 5)
+
+
+class TestAuctionAssign:
+    """auction_assign_kernel must equal greedy_assign_kernel exactly —
+    the fixpoint IS sequential greedy."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_equivalence(self, seed):
+        from platform_aware_scheduling_tpu.ops.assign import (
+            auction_assign_kernel,
+        )
+
+        rng = np.random.default_rng(seed)
+        p, n = int(rng.integers(1, 40)), int(rng.integers(1, 80))
+        # heavy ties + contention: few distinct scores, tight capacity
+        score_np = rng.integers(-3, 3, size=(p, n)).astype(np.int64) * (
+            10 ** int(rng.integers(0, 15))
+        )
+        score = i64.from_int64(score_np)
+        eligible = jnp.asarray(rng.random((p, n)) > 0.3)
+        capacity = jnp.asarray(rng.integers(0, 2, size=n).astype(np.int32))
+        want = greedy_assign_kernel(score, eligible, capacity)
+        got = auction_assign_kernel(score, eligible, capacity)
+        np.testing.assert_array_equal(
+            np.asarray(got.node_for_pod), np.asarray(want.node_for_pod)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.capacity_left), np.asarray(want.capacity_left)
+        )
+
+    def test_eviction_chain(self):
+        """The case naive conflict-resolution gets wrong: pod1 loses its
+        first choice to pod0, must evict pod2 from pod2's first choice."""
+        from platform_aware_scheduling_tpu.ops.assign import (
+            auction_assign_kernel,
+        )
+
+        # pods 0,1 best = node0; pod1 second = node1; pod2 best = node1
+        score = i64.from_int64(
+            np.array([[9, 1, 0], [9, 5, 1], [0, 9, 1]], dtype=np.int64)
+        )
+        eligible = jnp.asarray(np.ones((3, 3), dtype=bool))
+        capacity = jnp.asarray(np.array([1, 1, 1], dtype=np.int32))
+        out = auction_assign_kernel(score, eligible, capacity)
+        np.testing.assert_array_equal(np.asarray(out.node_for_pod), [0, 1, 2])
