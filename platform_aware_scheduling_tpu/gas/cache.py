@@ -103,6 +103,7 @@ class Cache:
         self._stop = threading.Event()
         self._mutation_hooks = []  # fired after booking changes (device mirror)
 
+        self._node_hooks = []  # fired on node add/update/delete (device mirror)
         self._node_informer = Informer(
             ListWatch(
                 lambda: (kube_client.list_nodes(), ""),
@@ -111,6 +112,9 @@ class Cache:
                 ),
                 lambda node: node.name,
             ),
+            on_add=self._node_event,
+            on_update=lambda _old, new: self._node_event(new),
+            on_delete=self._node_deleted,
             resync_period=resync_period_s,
         )
         self._pod_informer = Informer(
@@ -157,6 +161,30 @@ class Cache:
                 return True
             time.sleep(0.01)
         return False
+
+    # -- node events (device-mirror feed) --------------------------------------
+
+    def _node_event(self, node: Node) -> None:
+        for hook in self._node_hooks:
+            hook(node)
+
+    def _node_deleted(self, obj) -> None:
+        if isinstance(obj, DeletedFinalStateUnknown):
+            obj = obj.obj
+        for hook in self._node_hooks:
+            hook(obj, deleted=True)
+
+    def on_node_change(self, hook) -> None:
+        """Register node add/update/delete callback ``hook(node,
+        deleted=False)``; replays the currently-cached nodes so a
+        late-attaching subscriber starts complete."""
+        self._node_hooks.append(hook)
+        for node in self._node_informer.list():
+            hook(node)
+
+    def list_booked_nodes(self):
+        with self._rwmutex:
+            return list(self.node_statuses)
 
     # -- event plumbing (node_resource_cache.go:146-158, 305-400) --------------
 

@@ -74,6 +74,7 @@ class GASExtender:
         cache: Optional[Cache] = None,
         recorder: Optional[LatencyRecorder] = None,
         use_device: bool = True,
+        use_mirror: bool = True,
     ):
         self.kube_client = kube_client
         self.cache = cache if cache is not None else Cache(kube_client)
@@ -84,7 +85,7 @@ class GASExtender:
             # deferred import: keeps the host layer importable without jax
             from platform_aware_scheduling_tpu.gas.device import DeviceBinpacker
 
-            self._device = DeviceBinpacker(self.cache)
+            self._device = DeviceBinpacker(self.cache, use_mirror=use_mirror)
 
     # -- verbs -----------------------------------------------------------------
 

@@ -44,6 +44,11 @@ class BinpackNodeState(NamedTuple):
     cap_present: jax.Array  # bool [N, R] — resource exists in node capacity
     card_valid: jax.Array  # bool [N, C] — card still in the node's GPU label
     card_real: jax.Array  # bool [N, C] — non-padding lane
+    # first-fit priority of each card lane (lower = earlier).  The
+    # reference iterates cards in sorted-name order (scheduler.go:216-224);
+    # a persistent mirror interns card lanes append-only, so name order is
+    # carried explicitly instead of assuming lane order.
+    card_order: jax.Array  # int32 [N, C]
 
 
 class BinpackResult(NamedTuple):
@@ -89,6 +94,7 @@ def _fit_one_node(
     capacity: i64.I64,  # [R]
     cap_present: jax.Array,  # [R]
     card_ok: jax.Array,  # [C]
+    card_order: jax.Array,  # int32 [C]
     request: BinpackRequest,
     max_gpus: int,
 ) -> tuple:
@@ -96,6 +102,7 @@ def _fit_one_node(
     (scheduler.go:313-338 + 200-257): scan containers, scan GPU picks."""
     num_cards = card_ok.shape[0]
     card_iota = jnp.arange(num_cards, dtype=jnp.int32)
+    big_order = jnp.int32(2**30)
 
     def per_container(carry, request_t):
         used, ok = carry
@@ -104,7 +111,10 @@ def _fit_one_node(
         def per_gpu(carry2, step):
             used2, ok2 = carry2
             fits = _card_fits(used2, need, need_active, capacity, cap_present, card_ok)
-            chosen = jnp.min(jnp.where(fits, card_iota, jnp.int32(num_cards)))
+            # first-fit = smallest card_order among fitting lanes
+            best_order = jnp.min(jnp.where(fits, card_order, big_order))
+            on_best = fits & (card_order == best_order)
+            chosen = jnp.min(jnp.where(on_best, card_iota, jnp.int32(num_cards)))
             fitted = chosen < num_cards
             wanted = active & (step < num_gpus)
             book = wanted & fitted
@@ -137,13 +147,14 @@ def binpack_kernel(
 ) -> BinpackResult:
     """Fit ``request`` against every node at once (the batched Filter)."""
     fits, cards = jax.vmap(
-        lambda used, cap, cap_p, ok: _fit_one_node(
-            used, cap, cap_p, ok, request, max_gpus
+        lambda used, cap, cap_p, ok, order: _fit_one_node(
+            used, cap, cap_p, ok, order, request, max_gpus
         )
     )(
         state.used,
         state.capacity,
         state.cap_present,
         state.card_valid & state.card_real,
+        state.card_order,
     )
     return BinpackResult(fits=fits, cards=cards)

@@ -72,11 +72,16 @@ def wait_until(pred, timeout=5.0):
     return False
 
 
-@pytest.fixture(params=[False, True], ids=["host", "device"])
+@pytest.fixture(params=["host", "staged", "mirror"])
 def setup(request):
     kube = FakeKubeClient()
     cache = Cache(kube, start=False)
-    ext = GASExtender(kube, cache=cache, use_device=request.param)
+    ext = GASExtender(
+        kube,
+        cache=cache,
+        use_device=request.param != "host",
+        use_mirror=request.param == "mirror",
+    )
     yield kube, cache, ext
     cache.stop()
 
@@ -335,7 +340,10 @@ class TestDeviceHostEquivalence:
             ))
         cache = Cache(kube, start=False)
         ext_host = GASExtender(kube, cache=cache, use_device=False)
-        ext_dev = GASExtender(kube, cache=cache, use_device=True)
+        ext_dev = GASExtender(kube, cache=cache, use_device=True,
+                              use_mirror=False)
+        ext_mir = GASExtender(kube, cache=cache, use_device=True,
+                              use_mirror=True)
         cache.start()
         try:
             # seed random bookings
@@ -359,6 +367,69 @@ class TestDeviceHostEquivalence:
                 req = post({"Pod": pod.raw, "NodeNames": names})
                 host_out = json.loads(ext_host.filter(req).body)
                 dev_out = json.loads(ext_dev.filter(req).body)
-                assert host_out == dev_out, f"trial {trial} diverged"
+                mir_out = json.loads(ext_mir.filter(req).body)
+                assert host_out == dev_out, f"trial {trial} staged diverged"
+                assert host_out == mir_out, f"trial {trial} mirror diverged"
+        finally:
+            cache.stop()
+
+
+class TestUsageMirrorSync:
+    """The persistent mirror must track node events and bookings live."""
+
+    def _filter_names(self, ext, names, millicores="500"):
+        req = post({"Pod": gpu_pod("probe", millicores=millicores).raw,
+                    "NodeNames": names})
+        return json.loads(ext.filter(req).body)
+
+    def test_node_update_changes_verdict(self):
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1", cards=1, i915=1, millicores=100))
+        cache = Cache(kube, start=False)
+        ext = GASExtender(kube, cache=cache, use_device=True, use_mirror=True)
+        cache.start()
+        try:
+            out = self._filter_names(ext, ["n1"])
+            assert "n1" in out["FailedNodes"]
+            # capacity grows: update the node object
+            bigger = gpu_node("n1", cards=1, i915=2, millicores=2000)
+            bigger.metadata["resourceVersion"] = "7"
+            kube.add_node(bigger)
+            assert wait_until(
+                lambda: self._filter_names(ext, ["n1"])["NodeNames"] == ["n1"]
+            )
+        finally:
+            cache.stop()
+
+    def test_node_delete_prefails(self):
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1"))
+        cache = Cache(kube, start=False)
+        ext = GASExtender(kube, cache=cache, use_device=True, use_mirror=True)
+        cache.start()
+        try:
+            assert wait_until(
+                lambda: self._filter_names(ext, ["n1"])["NodeNames"] == ["n1"]
+            )
+            kube.delete_node("n1")
+            assert wait_until(
+                lambda: "n1" in self._filter_names(ext, ["n1"])["FailedNodes"]
+            )
+        finally:
+            cache.stop()
+
+    def test_vanished_card_booking_tracked(self):
+        """Usage booked on a card missing from the label: lane interned,
+        marked invalid, skipped by first-fit — but label cards still fit."""
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1", cards=2, i915=4, millicores=2000))
+        cache = Cache(kube, start=False)
+        ext = GASExtender(kube, cache=cache, use_device=True, use_mirror=True)
+        cache.start()
+        try:
+            ghost = gpu_pod("ghost", millicores="100", node_name="n1")
+            cache.adjust_pod_resources_locked(ghost, True, "card9", "n1")
+            out = self._filter_names(ext, ["n1"])
+            assert out["NodeNames"] == ["n1"]
         finally:
             cache.stop()
