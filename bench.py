@@ -29,7 +29,7 @@ NUM_NODES = 10_000
 NUM_PODS = 1_000
 NUM_METRICS = 4
 CONTROL_PODS = 30
-DEVICE_REPS = 20
+DEVICE_REPS = 200  # solves per on-device loop; amortizes the tunnel RTT
 
 
 def build_problem(rng):
@@ -105,19 +105,48 @@ def main():
     state, pods = build_problem(rng)
 
     # --- device path: full batched solve ---
-    out = scheduling_step(state, pods)  # compile
-    jax.block_until_ready(out)
-    # throughput: enqueue solves back-to-back and block once — dispatch is
-    # async, so fixed dispatch latency (this chip sits behind a network
-    # tunnel adding ~100 ms RTT) amortizes like it would in a real service
-    # pipeline; per-solve wall latency reported separately below
+    # The chip sits behind a network tunnel: EVERY host readback costs a
+    # ~100 ms RTT and transfers do not pipeline, so per-dispatch timing
+    # measures the tunnel, not the device.  Measure device throughput the
+    # only honest way available: K solves inside ONE compiled program
+    # (each iteration permutes the candidate matrix so no work can be
+    # reused/DCE'd), one readback, RTT amortized over K.
+    import jax.numpy as jnp
+
+    from platform_aware_scheduling_tpu.models.batch_scheduler import PendingPods
+
+    def loop_body(i, carry):
+        checksum, cap = carry
+        rolled = PendingPods(
+            metric_row=pods.metric_row,
+            op_id=pods.op_id,
+            candidates=jnp.roll(pods.candidates, i, axis=1),
+        )
+        out = scheduling_step(state._replace(capacity=cap), rolled)
+        return (
+            checksum + jnp.sum(out.assignment.node_for_pod),
+            out.assignment.capacity_left + jnp.int32(1),
+        )
+
+    @jax.jit
+    def run_k_solves():
+        return jax.lax.fori_loop(
+            0, DEVICE_REPS, loop_body, (jnp.int32(0), state.capacity)
+        )
+
+    checksum, _ = run_k_solves()  # compile
+    _ = int(checksum)
     t0 = time.perf_counter()
-    outs = [scheduling_step(state, pods) for _ in range(DEVICE_REPS)]
-    jax.block_until_ready(outs)
-    device_solve_s = (time.perf_counter() - t0) / DEVICE_REPS
+    checksum, _ = run_k_solves()
+    _ = int(checksum)  # host materialization: forces completion
+    wall = time.perf_counter() - t0
+    device_solve_s = wall / DEVICE_REPS
     device_pods_per_s = NUM_PODS / device_solve_s
+
+    out = scheduling_step(state, pods)
     t0 = time.perf_counter()
-    jax.block_until_ready(scheduling_step(state, pods))
+    out = scheduling_step(state, pods)
+    _ = np.asarray(out.assignment.node_for_pod)
     single_solve_s = time.perf_counter() - t0
 
     # --- host control on a subsample, scaled ---
@@ -138,7 +167,8 @@ def main():
     print(json.dumps(result))
     # context on stderr (the driver takes stdout's single line)
     print(
-        f"device: {device_solve_s*1e3:.2f} ms/solve pipelined, "
+        f"device: {device_solve_s*1e3:.2f} ms/solve ({DEVICE_REPS} "
+        f"capacity-chained solves in one program), "
         f"{single_solve_s*1e3:.2f} ms single-solve wall incl. dispatch RTT "
         f"({NUM_PODS} pods x {NUM_NODES} nodes) on "
         f"{jax.devices()[0].device_kind}; "

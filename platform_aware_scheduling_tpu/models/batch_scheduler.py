@@ -89,12 +89,22 @@ def scheduling_step(state: ClusterState, pods: PendingPods) -> ScheduleOutput:
     )
     present = state.metric_present[pods.metric_row]  # [P, N]
     eligible = pods.candidates & present & ~violating[None, :]
-    # Both assignment kernels are exact greedy-in-order.  Measured on v5e at
-    # 1k x 10k: the scan's P cheap [N] steps (~7 ms) beat the auction's
-    # per-round [P, N] passes under heavy contention (62 rounds, ~36 ms);
-    # auction_assign_kernel wins when pods' rankings are mostly distinct
-    # (few rounds) — callers with that workload can use it directly.
-    assignment = greedy_assign_kernel(score, eligible, state.capacity)
+    # All three assignment kernels are exact greedy-in-order.  Measured on
+    # v5e at 1k x 10k: the Pallas kernel (~6 ms; capacity resident in VMEM,
+    # one launch) beats the XLA scan (~12 ms; P dispatch-bound steps), which
+    # beats the auction under heavy contention (62 rounds, ~36 ms — though
+    # auction wins when pods' rankings are mostly distinct).  Pallas lowers
+    # only on TPU; elsewhere the scan runs.
+    # (single-chip only: a hand-written pallas_call does not auto-partition
+    # under GSPMD — the multi-chip path uses the scan / parallel/sharded.py)
+    if jax.default_backend() == "tpu" and jax.device_count() == 1:
+        from platform_aware_scheduling_tpu.ops.pallas_assign import (
+            greedy_assign_pallas,
+        )
+
+        assignment = greedy_assign_pallas(score, eligible, state.capacity)
+    else:
+        assignment = greedy_assign_kernel(score, eligible, state.capacity)
     return ScheduleOutput(assignment=assignment, violating=violating, score=score)
 
 

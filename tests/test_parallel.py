@@ -249,3 +249,45 @@ class TestAuctionAssign:
         capacity = jnp.asarray(np.array([1, 1, 1], dtype=np.int32))
         out = auction_assign_kernel(score, eligible, capacity)
         np.testing.assert_array_equal(np.asarray(out.node_for_pod), [0, 1, 2])
+
+
+class TestPallasAssign:
+    """Pallas greedy-assign (interpret mode on CPU) must equal the XLA scan."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_equivalence(self, seed):
+        from platform_aware_scheduling_tpu.ops.pallas_assign import (
+            greedy_assign_pallas,
+        )
+
+        rng = np.random.default_rng(seed)
+        p, n = int(rng.integers(1, 30)), int(rng.integers(1, 300))
+        score_np = rng.integers(-(2**62), 2**62, size=(p, n)).astype(np.int64)
+        score = i64.from_int64(score_np)
+        eligible = jnp.asarray(rng.random((p, n)) > 0.3)
+        capacity = jnp.asarray(rng.integers(0, 3, size=n).astype(np.int32))
+        want = greedy_assign_kernel(score, eligible, capacity)
+        got = greedy_assign_pallas(score, eligible, capacity, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(got.node_for_pod), np.asarray(want.node_for_pod)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.capacity_left), np.asarray(want.capacity_left)
+        )
+
+    def test_uint32_bias_edge_values(self):
+        from platform_aware_scheduling_tpu.ops.pallas_assign import (
+            greedy_assign_pallas,
+        )
+
+        # values whose lo limbs straddle the u32 sign bit
+        vals = np.array([[2**31, 2**31 - 1, 2**32 - 1, 0, -1, -(2**31)]],
+                        dtype=np.int64)
+        score = i64.from_int64(vals)
+        eligible = jnp.asarray(np.ones((1, 6), dtype=bool))
+        capacity = jnp.asarray(np.ones(6, dtype=np.int32))
+        want = greedy_assign_kernel(score, eligible, capacity)
+        got = greedy_assign_pallas(score, eligible, capacity, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(got.node_for_pod), np.asarray(want.node_for_pod)
+        )
