@@ -1,0 +1,107 @@
+#!/usr/bin/env bash
+# Real-cluster e2e: kind (1 control-plane + 3 workers) with static metric
+# fixtures, the full metrics pipeline, and the TAS extender wired into
+# kube-scheduler.  Capability parity with the reference's
+# .github/scripts/e2e_setup_cluster.sh; the hermetic in-process version of
+# these scenarios runs in tests/test_e2e.py.
+set -euo pipefail
+
+CLUSTER=${CLUSTER:-pas-tpu-e2e}
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
+REPO_ROOT=$(cd "$SCRIPT_DIR/../.." && pwd)
+
+create_cluster() {
+  cat <<EOF | kind create cluster --name "$CLUSTER" --config=-
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+nodes:
+  - role: control-plane
+  - role: worker
+    extraMounts:
+      - hostPath: $SCRIPT_DIR/policies/node1
+        containerPath: /tmp/node-metrics/test.prom
+  - role: worker
+    extraMounts:
+      - hostPath: $SCRIPT_DIR/policies/node2
+        containerPath: /tmp/node-metrics/test.prom
+  - role: worker
+    extraMounts:
+      - hostPath: $SCRIPT_DIR/policies/node3
+        containerPath: /tmp/node-metrics/test.prom
+EOF
+}
+
+install_metrics_pipeline() {
+  helm repo add prometheus-community \
+    https://prometheus-community.github.io/helm-charts
+  helm repo update
+  helm install node-exporter prometheus-community/prometheus-node-exporter \
+    --set "extraArgs={--collector.textfile.directory=/host/tmp/node-metrics}" \
+    --set "extraHostPathMounts[0].name=textfile" \
+    --set "extraHostPathMounts[0].hostPath=/tmp/node-metrics" \
+    --set "extraHostPathMounts[0].mountPath=/host/tmp/node-metrics" \
+    --set "extraHostPathMounts[0].readOnly=true"
+  helm install prometheus prometheus-community/prometheus
+  cat > /tmp/adapter-values.yaml <<'EOF'
+rules:
+  custom:
+    - seriesQuery: '{__name__=~"^node_.*"}'
+      resources:
+        overrides:
+          instance:
+            resource: node
+      name:
+        matches: ^node_(.*)
+        as: ""
+      metricsQuery: <<.Series>>
+prometheus:
+  url: http://prometheus-server.default.svc
+  port: 80
+EOF
+  helm install prometheus-adapter prometheus-community/prometheus-adapter \
+    -f /tmp/adapter-values.yaml
+}
+
+deploy_tas() {
+  docker build -f "$REPO_ROOT/deploy/images/Dockerfile.tas" \
+    -t pas-tpu-tas "$REPO_ROOT"
+  kind load docker-image pas-tpu-tas --name "$CLUSTER"
+  kubectl apply -f "$REPO_ROOT/deploy/tas/tas-policy-crd.yaml"
+  kubectl apply -f "$REPO_ROOT/deploy/tas/tas-rbac.yaml"
+  kubectl apply -f "$REPO_ROOT/deploy/tas/tas-service.yaml"
+  # e2e runs unsafe (plain HTTP), like the reference's e2e policy
+  kubectl apply -f - <<EOF
+$(sed 's/--cert=.*/--unsafe/; /--key=\|--cacert=/d' \
+    "$REPO_ROOT/deploy/tas/tas-deployment.yaml")
+EOF
+}
+
+configure_scheduler() {
+  docker exec "${CLUSTER}-control-plane" bash -c "
+    cat > /etc/kubernetes/scheduler-extender-config.yaml" <<'EOF'
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+clientConnection:
+  kubeconfig: /etc/kubernetes/scheduler.conf
+extenders:
+  - urlPrefix: "http://tas-service.default.svc.cluster.local:9001"
+    prioritizeVerb: "scheduler/prioritize"
+    filterVerb: "scheduler/filter"
+    weight: 100
+    enableHTTPS: false
+    managedResources:
+      - name: "telemetry/scheduling"
+        ignoredByScheduler: true
+    ignorable: false
+EOF
+  docker cp "$REPO_ROOT/deploy/extender-configuration/configure-scheduler.sh" \
+    "${CLUSTER}-control-plane:/tmp/"
+  docker exec "${CLUSTER}-control-plane" bash /tmp/configure-scheduler.sh \
+    /etc/kubernetes/scheduler-extender-config.yaml
+}
+
+create_cluster
+install_metrics_pipeline
+deploy_tas
+configure_scheduler
+echo "cluster $CLUSTER ready; run the scenario assertions against it"

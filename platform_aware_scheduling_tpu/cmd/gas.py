@@ -43,7 +43,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     kube_client = get_kube_client(args.kubeConfig)
     extender = GASExtender(kube_client)
 
-    server = Server(extender)
+    server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
     done = threading.Event()
     failed = []
 

@@ -74,7 +74,7 @@ def assemble(
         mirror.attach(cache)
     extender = MetricsExtender(cache, mirror=mirror)
 
-    enforcer = core.MetricEnforcer(kube_client)
+    enforcer = core.MetricEnforcer(kube_client, mirror=mirror)
     enforcer.register_strategy_type(deschedule.Strategy())
     enforcer.register_strategy_type(scheduleonmetric.Strategy())
     enforcer.register_strategy_type(dontschedule.Strategy())
@@ -97,7 +97,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     metrics_client = CustomMetricsClient(kube_client)
     _, _, extender, _, _, stop = assemble(kube_client, metrics_client, sync_period_s)
 
-    server = Server(extender)
+    server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
     done = threading.Event()
     failed = []
 

@@ -85,14 +85,29 @@ class Server:
     """Wraps a Scheduler implementation with the HTTP(S) extender endpoint
     (reference extender/types.go:18-20, scheduler.go:86-143)."""
 
-    def __init__(self, scheduler: "Scheduler"):
+    def __init__(self, scheduler: "Scheduler", metrics_provider=None):
+        """``metrics_provider``: optional zero-arg callable returning
+        Prometheus exposition text, served on GET /metrics.  The reference
+        consumes metrics but exports none of its own (SURVEY §5.5); since
+        this framework's north star is p99 latency, the extenders' latency
+        histograms (utils/tracing.py) are exported here."""
         self.scheduler = scheduler
+        self.metrics_provider = metrics_provider
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._ready = threading.Event()
 
     # -- routing -------------------------------------------------------------
 
     def route(self, request: HTTPRequest) -> HTTPResponse:
+        if request.path == "/metrics" and self.metrics_provider is not None:
+            # observability extension: outside the POST/JSON middleware
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "text/plain; version=0.0.4"},
+                body=self.metrics_provider().encode(),
+            )
         routes = {
             "/scheduler/prioritize": self.scheduler.prioritize,
             "/scheduler/filter": self.scheduler.filter,

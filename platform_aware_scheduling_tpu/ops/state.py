@@ -322,6 +322,18 @@ class TensorStateMirror:
         with self._lock:
             return self._view_locked()
 
+    def policy_with_view_by_name(
+        self, name: str
+    ) -> Tuple[Optional[CompiledPolicy], Optional[DeviceView]]:
+        """Lookup by bare policy name — strategies registered with the
+        enforcer only carry the name, not the namespace (the reference's
+        enforcement loop has the same ambiguity, deschedule/enforce.go)."""
+        with self._lock:
+            for (_ns, pname), compiled in self._policies.items():
+                if pname == name:
+                    return compiled, self._view_locked()
+        return None, None
+
     def policy_with_view(
         self, namespace: str, name: str
     ) -> Tuple[Optional[CompiledPolicy], DeviceView]:

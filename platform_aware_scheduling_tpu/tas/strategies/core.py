@@ -103,9 +103,12 @@ class MetricEnforcer:
     """Registers strategies by type and periodically enforces them
     (core/enforcer.go:15-131)."""
 
-    def __init__(self, kube_client=None):
+    def __init__(self, kube_client=None, mirror=None):
         self.registered_strategies: Dict[str, Dict[int, StrategyInterface]] = {}
         self.kube_client = kube_client
+        # optional TensorStateMirror: strategies with a device-path
+        # ``violated_device`` use it during enforcement
+        self.mirror = mirror
         self._lock = threading.RLock()
 
     def register_strategy_type(self, strategy: StrategyInterface) -> None:

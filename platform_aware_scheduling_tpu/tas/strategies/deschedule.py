@@ -53,6 +53,50 @@ class Strategy:
                     violating[node_name] = None
         return violating
 
+    def violated_device(self, mirror) -> "Dict[str, None] | None":
+        """Batched violation detection through the tensor mirror; None means
+        'use the host path' (policy unknown, host-only values, or the
+        compiled rules don't match this instance)."""
+        try:
+            import numpy as np
+
+            from platform_aware_scheduling_tpu.ops.rules import (
+                OP_IDS,
+                violated_nodes,
+            )
+
+            compiled, view = mirror.policy_with_view_by_name(self.policy_name)
+            if compiled is None or compiled.deschedule is None:
+                return None
+            rs = compiled.deschedule
+            if rs.host_only or not rs.active.any():
+                return None
+            if any(mirror.metric_host_only(m) for m in rs.metric_names):
+                return None
+            # the enforcer's strategy instance and the mirror's compiled
+            # policy come from the same CRD event but through different
+            # paths — verify they describe the same rules before trusting
+            # the device result
+            mine = tuple(
+                (r.metricname, OP_IDS.get(r.operator, -1), r.target * 1000)
+                for r in self.rules
+            )
+            theirs = tuple(
+                (name, int(rs.op_ids[i]), int(rs.targets[i]))
+                for i, name in enumerate(rs.metric_names)
+            )
+            if mine != theirs:
+                return None
+            rules = compiled.device_rules("deschedule")
+            mask = np.asarray(violated_nodes(view.values, view.present, rules))
+            names = view.node_names
+            return {
+                names[i]: None for i in np.nonzero(mask)[0] if i < len(names)
+            }
+        except Exception as exc:
+            klog.error("device deschedule failed, host fallback: %s", exc)
+            return None
+
     # -- enforcement (enforce.go) --------------------------------------------
 
     def enforce(self, enforcer: core.MetricEnforcer, cache) -> int:
@@ -110,13 +154,19 @@ class Strategy:
         """node -> [policy names violated] over every registered deschedule
         strategy (enforce.go:154-164)."""
         violations: Dict[str, List[str]] = {}
+        mirror = getattr(enforcer, "mirror", None)
         for strat in list(
             enforcer.registered_strategies.get(STRATEGY_TYPE, {}).values()
         ):
             klog.v(2).info_s(
                 "Evaluating " + strat.get_policy_name(), component="controller"
             )
-            for node in strat.violated(cache):
+            nodes = None
+            if mirror is not None and hasattr(strat, "violated_device"):
+                nodes = strat.violated_device(mirror)
+            if nodes is None:
+                nodes = strat.violated(cache)
+            for node in nodes:
                 violations.setdefault(node, []).append(strat.get_policy_name())
         return violations
 

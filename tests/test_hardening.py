@@ -207,3 +207,75 @@ class TestValidationRunner:
             assert trigger.wait(2)
         finally:
             server.shutdown()
+
+
+class TestMetricsEndpoint:
+    def test_latency_histograms_exported(self):
+        import urllib.request
+        from platform_aware_scheduling_tpu.tas.telemetryscheduler import (
+            MetricsExtender,
+        )
+        from platform_aware_scheduling_tpu.testing.mocks import (
+            mock_self_updating_cache,
+        )
+
+        ext = MetricsExtender(mock_self_updating_cache())
+        server = Server(ext, metrics_provider=ext.recorder.prometheus_text)
+        threading.Thread(
+            target=lambda: server.start_server(
+                port="0", unsafe=True, host="127.0.0.1", block=True
+            ),
+            daemon=True,
+        ).start()
+        assert server.wait_ready()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/scheduler/prioritize",
+                data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=5)
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            )
+            text = resp.read().decode()
+            assert 'pas_request_duration_seconds_count{verb="prioritize"} 1' in text
+            assert "pas_request_duration_seconds_bucket" in text
+            # non-GET is rejected; absent provider stays 404 (parity default)
+            post = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/metrics", data=b"x"
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(post, timeout=5)
+            assert err.value.code == 405
+        finally:
+            server.shutdown()
+
+    def test_metrics_absent_without_provider(self):
+        server = Server(StubScheduler())
+        resp = server.route(
+            __import__(
+                "platform_aware_scheduling_tpu.extender.server",
+                fromlist=["HTTPRequest"],
+            ).HTTPRequest("GET", "/metrics", {}, b"")
+        )
+        assert resp.status == 404
+
+
+class TestReferenceMockParity:
+    def test_mock_caches_and_clients(self):
+        from platform_aware_scheduling_tpu.tas.strategies.core import MetricEnforcer
+        from platform_aware_scheduling_tpu.testing import mocks
+
+        cache = mocks.mock_self_updating_cache()
+        assert cache.read_metric("dummyMetric1")["node A"].value.cmp_int64(1) == 0
+        client = mocks.dummy_metrics_client()
+        assert "node B" in client.get_node_metric("dummyMetric2")
+        enforcer = MetricEnforcer()
+        strat = mocks.MockStrategy()
+        enforcer.register_strategy_type(strat)
+        enforcer.add_strategy(strat, strat.strategy_type())
+        enforcer.enforce_strategy(strat.strategy_type(), cache)
+        assert strat.enforce_calls == 1
+        enforcer.remove_strategy(strat, strat.strategy_type())
+        assert strat.cleanup_calls == 1

@@ -146,3 +146,60 @@ def test_policy_delete_removes_compiled():
     assert mirror.policy("default", "p") is not None
     cache.delete_policy("default", "p")
     assert mirror.policy("default", "p") is None
+
+
+class TestDescheduleDevicePath:
+    def _setup(self, rules_list):
+        from platform_aware_scheduling_tpu.tas.strategies import deschedule
+
+        cache, mirror = attach_pair()
+        policy = TASPolicy.from_obj(
+            make_policy("desched-pol", strategies={"deschedule": rules_list})
+        )
+        cache.write_policy("default", "desched-pol", policy)
+        strat = deschedule.Strategy.from_policy_strategy(
+            policy.strategies["deschedule"]
+        )
+        strat.set_policy_name("desched-pol")
+        return cache, mirror, strat
+
+    def test_device_matches_host(self):
+        import numpy as np
+
+        rng = np.random.default_rng(11)
+        cache, mirror, strat = self._setup(
+            [rule("mem", "GreaterThan", 90), rule("disk", "LessThan", 10)]
+        )
+        names = [f"n{i}" for i in range(40)]
+        cache.write_metric(
+            "mem", info(**{n: str(int(rng.integers(0, 120))) for n in names})
+        )
+        cache.write_metric(
+            "disk",
+            info(**{n: str(int(rng.integers(0, 30))) for n in names[5:]}),
+        )
+        host = strat.violated(cache)
+        device = strat.violated_device(mirror)
+        assert device is not None
+        assert set(device) == set(host)
+
+    def test_mismatched_rules_fall_back(self):
+        from platform_aware_scheduling_tpu.tas.strategies import deschedule
+
+        cache, mirror, strat = self._setup([rule("mem", "GreaterThan", 90)])
+        # a stale strategy instance with different rules must refuse device
+        stale = deschedule.Strategy(
+            policy_name="desched-pol",
+            rules=[TASPolicy.from_obj(
+                make_policy("x", strategies={"deschedule": [
+                    rule("mem", "GreaterThan", 50)]})
+            ).strategies["deschedule"].rules[0]],
+        )
+        assert stale.violated_device(mirror) is None
+
+    def test_unknown_policy_falls_back(self):
+        from platform_aware_scheduling_tpu.tas.strategies import deschedule
+
+        _, mirror = attach_pair()
+        strat = deschedule.Strategy(policy_name="ghost")
+        assert strat.violated_device(mirror) is None
