@@ -55,6 +55,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--syncPeriod", default="5s",
                         help="interval between cache syncs, e.g. 1m or 2s")
     parser.add_argument("--v", type=int, default=2, help="klog verbosity")
+    parser.add_argument("--batchPlanner", action="store_true",
+                        help="solve the whole pending set each sync period "
+                        "and steer pods onto their batch-assigned nodes")
     return parser
 
 
@@ -63,6 +66,7 @@ def assemble(
     metrics_client,
     sync_period_s: float,
     enable_device_path: bool = True,
+    enable_batch_planner: bool = False,
 ):
     """Wire cache + mirror + extender + controller + enforcer (the body of
     ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
@@ -72,7 +76,12 @@ def assemble(
     if enable_device_path:
         mirror = TensorStateMirror()
         mirror.attach(cache)
-    extender = MetricsExtender(cache, mirror=mirror)
+    planner = None
+    if enable_batch_planner and mirror is not None:
+        from platform_aware_scheduling_tpu.tas.planner import BatchPlanner
+
+        planner = BatchPlanner(cache, mirror)
+    extender = MetricsExtender(cache, mirror=mirror, planner=planner)
 
     enforcer = core.MetricEnforcer(kube_client, mirror=mirror)
     enforcer.register_strategy_type(deschedule.Strategy())
@@ -85,6 +94,12 @@ def assemble(
     cache.start_periodic_update(sync_period_s, metrics_client, stop=stop)
     controller.run(stop)
     enforcer.start_enforcing(cache, sync_period_s, stop=stop)
+    if planner is not None:
+        planner_informer = planner.watch(kube_client)
+        planner.start(sync_period_s)
+        threading.Thread(
+            target=lambda: (stop.wait(), planner_informer.stop()), daemon=True
+        ).start()
     return cache, mirror, extender, controller, enforcer, stop
 
 
@@ -95,7 +110,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     kube_client = get_kube_client(args.kubeConfig)
     metrics_client = CustomMetricsClient(kube_client)
-    _, _, extender, _, _, stop = assemble(kube_client, metrics_client, sync_period_s)
+    _, _, extender, _, _, stop = assemble(
+        kube_client,
+        metrics_client,
+        sync_period_s,
+        enable_batch_planner=args.batchPlanner,
+    )
 
     server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
     done = threading.Event()

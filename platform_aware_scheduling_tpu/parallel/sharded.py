@@ -107,6 +107,63 @@ def sharded_prioritize(mesh: Mesh, value: i64.I64, valid, op_id):
     return _impl(value, valid, op_id)
 
 
+def sharded_prioritize_ring(mesh: Mesh, value: i64.I64, valid, op_id):
+    """Ring-pass form of :func:`sharded_prioritize` — identical results.
+
+    Instead of all_gathering the full key set (O(N) memory per chip), each
+    chip's key block circulates the ring via ``ppermute`` while every chip
+    accumulates how many circulating keys rank before each of its local
+    lanes; after D hops the counts are exact global ranks.  This is the
+    ring-attention/sequence-parallel communication pattern (blockwise
+    compute overlapped with neighbor exchange over ICI) applied to the
+    node axis — the memory-scalable path for very large clusters.
+    """
+    n_shards = dict(zip(mesh.axis_names, mesh.devices.shape))[NODE_AXIS]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            i64.I64(hi=P(NODE_AXIS), lo=P(NODE_AXIS)),
+            P(NODE_AXIS),
+            P(),
+        ),
+        out_specs=(P(NODE_AXIS), P(NODE_AXIS)),
+    )
+    def _impl(value_loc, valid_loc, op):
+        n_loc = value_loc.hi.shape[-1]
+        shard = jax.lax.axis_index(NODE_AXIS)
+        offset = (shard * n_loc).astype(jnp.int32)
+        local_idx = jnp.arange(n_loc, dtype=jnp.int32) + offset
+        key_loc = _rank_key(value_loc, valid_loc, op, local_idx)
+        n_total = n_loc * n_shards
+        tie_loc = jnp.where(valid_loc, local_idx, local_idx + n_total)
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+        def hop(carry, _):
+            blk_hi, blk_lo, blk_tie, counts = carry
+            gk = i64.I64(hi=blk_hi[None, :], lo=blk_lo[None, :])
+            lk = i64.I64(hi=key_loc.hi[:, None], lo=key_loc.lo[:, None])
+            cmp = i64.cmp(gk, lk)  # [n_loc, n_loc]
+            before = (cmp == -1) | (
+                (cmp == 0) & (blk_tie[None, :] < tie_loc[:, None])
+            )
+            counts = counts + jnp.sum(before, axis=-1, dtype=jnp.int32)
+            blk_hi = jax.lax.ppermute(blk_hi, NODE_AXIS, perm)
+            blk_lo = jax.lax.ppermute(blk_lo, NODE_AXIS, perm)
+            blk_tie = jax.lax.ppermute(blk_tie, NODE_AXIS, perm)
+            return (blk_hi, blk_lo, blk_tie, counts), None
+
+        zero_counts = jax.lax.pcast(
+            jnp.zeros(n_loc, jnp.int32), (NODE_AXIS,), to="varying"
+        )
+        init = (key_loc.hi, key_loc.lo, tie_loc, zero_counts)
+        (_, _, _, ranks), _ = jax.lax.scan(hop, init, None, length=n_shards)
+        return jnp.int32(10) - ranks, valid_loc
+
+    return _impl(value, valid, op_id)
+
+
 def sharded_greedy_assign(mesh: Mesh, score: i64.I64, eligible, capacity):
     """Greedy batch assignment with the node axis sharded.  Per pod step:
     local argmin reduction + one tiny all_gather; every chip replays the

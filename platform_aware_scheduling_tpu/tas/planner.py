@@ -1,0 +1,253 @@
+"""Batch planner: the whole-pending-set solve wired into the service.
+
+SURVEY §7 step 4's product form.  kube-scheduler's protocol is one pod
+per round-trip; the planner watches pending pods carrying the
+``telemetry-policy`` label, solves the ENTIRE set each sync period with
+``models/batch_scheduler.scheduling_step``, and lets the per-pod verbs be
+answered from the precomputed solution: when Prioritize arrives for a
+planned pod, its batch-assigned node gets the top score, steering the
+sequential scheduler onto the coordinated plan (capacity-aware placement
+the per-pod ordinal scores alone cannot express).
+
+OPT-IN (``--batchPlanner`` on cmd/tas.py): with the planner off the verbs
+behave exactly like the reference.  Planner answers degrade gracefully:
+unknown pod / stale plan / no assignment -> the ordinary per-request path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.kube.objects import Pod, object_key
+from platform_aware_scheduling_tpu.models.batch_scheduler import (
+    ClusterState,
+    PendingPods,
+    scheduling_step,
+)
+from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops.rules import OP_IDS, RuleSet
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
+from platform_aware_scheduling_tpu.utils import klog
+
+TAS_POLICY_LABEL = "telemetry-policy"
+DEFAULT_NODE_CAPACITY = 110  # kubelet's default max pods per node
+
+
+class BatchPlanner:
+    """Maintains the batch solution over the current pending set."""
+
+    def __init__(
+        self,
+        cache: AutoUpdatingCache,
+        mirror: TensorStateMirror,
+        node_capacity: int = DEFAULT_NODE_CAPACITY,
+    ):
+        self.cache = cache
+        self.mirror = mirror
+        self.node_capacity = node_capacity
+        self._lock = threading.Lock()
+        self._pending: Dict[str, Pod] = {}
+        # pod key -> (assigned node name, mirror version it was solved at)
+        self._plan: Dict[str, Tuple[str, int]] = {}
+        self._plan_version = -1
+
+    # -- pending-set maintenance ----------------------------------------------
+
+    def pod_added(self, pod: Pod) -> None:
+        if pod.spec_node_name or TAS_POLICY_LABEL not in pod.get_labels():
+            return
+        with self._lock:
+            self._pending[object_key(pod)] = pod
+
+    def pod_removed(self, pod: Pod) -> None:
+        with self._lock:
+            self._pending.pop(object_key(pod), None)
+            self._plan.pop(object_key(pod), None)
+
+    def pod_bound(self, pod: Pod) -> None:
+        self.pod_removed(pod)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- solve ----------------------------------------------------------------
+
+    def replan(self) -> int:
+        """Solve the current pending set; returns the number of planned
+        pods.  Called from the sync-period loop (and on demand in tests)."""
+        with self._lock:
+            pods = list(self._pending.items())
+        if not pods:
+            with self._lock:
+                self._plan = {}
+            return 0
+        compiled_rows: List[Tuple[str, int, int]] = []  # key, row, op
+        view = None
+        for key, pod in pods:
+            policy_name = pod.get_labels().get(TAS_POLICY_LABEL)
+            compiled, view = self.mirror.policy_with_view(
+                pod.namespace, policy_name
+            )
+            if compiled is None or compiled.scheduleonmetric_row < 0:
+                continue
+            if self.mirror.metric_host_only(compiled.scheduleonmetric_metric):
+                continue
+            compiled_rows.append(
+                (key, compiled.scheduleonmetric_row, compiled.scheduleonmetric_op)
+            )
+        if not compiled_rows or view is None:
+            with self._lock:
+                self._plan = {}
+            return 0
+        n_cap = view.node_capacity
+        p = len(compiled_rows)
+        metric_row = np.array([r for _, r, _ in compiled_rows], dtype=np.int32)
+        op_id = np.array([o for _, _, o in compiled_rows], dtype=np.int32)
+        candidates = np.zeros((p, n_cap), dtype=bool)
+        candidates[:, : len(view.node_names)] = True
+        # dontschedule filtering happens inside scheduling_step; here every
+        # known node is a candidate (kube-scheduler's own predicates will
+        # re-check its side)
+        dontschedule = self._merged_dontschedule(pods)
+        state = ClusterState(
+            metric_values=view.values,
+            metric_present=view.present,
+            dontschedule=dontschedule,
+            capacity=jnp.full(n_cap, self.node_capacity, dtype=jnp.int32),
+        )
+        batch = PendingPods(
+            metric_row=jnp.asarray(metric_row),
+            op_id=jnp.asarray(op_id),
+            candidates=jnp.asarray(candidates),
+        )
+        out = scheduling_step(state, batch)
+        assigned = np.asarray(out.assignment.node_for_pod)
+        plan: Dict[str, Tuple[str, int]] = {}
+        for i, (key, _row, _op) in enumerate(compiled_rows):
+            node_idx = int(assigned[i])
+            if 0 <= node_idx < len(view.node_names):
+                plan[key] = (view.node_names[node_idx], view.version)
+        with self._lock:
+            self._plan = plan
+            self._plan_version = view.version
+        klog.v(4).info_s(
+            f"batch plan: {len(plan)}/{p} pods assigned", component="planner"
+        )
+        return len(plan)
+
+    def _merged_dontschedule(self, pods) -> RuleSet:
+        """Union of the pending pods' dontschedule rules (deduped)."""
+        seen = set()
+        rows, ops, targets = [], [], []
+        for _key, pod in pods:
+            policy_name = pod.get_labels().get(TAS_POLICY_LABEL)
+            try:
+                policy = self.cache.read_policy(pod.namespace, policy_name)
+            except CacheMissError:
+                continue
+            strat = policy.strategies.get("dontschedule")
+            compiled, _ = self.mirror.policy_with_view(pod.namespace, policy_name)
+            if strat is None or compiled is None or compiled.dontschedule is None:
+                continue
+            rs = compiled.dontschedule
+            if rs.host_only:
+                continue
+            for i, name in enumerate(rs.metric_names):
+                sig = (int(rs.metric_rows[i]), int(rs.op_ids[i]), int(rs.targets[i]))
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                rows.append(sig[0])
+                ops.append(sig[1])
+                targets.append(sig[2])
+        pad = max(8, -(-max(len(rows), 1) // 8) * 8)
+        metric_rows = np.zeros(pad, dtype=np.int32)
+        op_ids = np.zeros(pad, dtype=np.int32)
+        t = np.zeros(pad, dtype=np.int64)
+        active = np.zeros(pad, dtype=bool)
+        for i, (r, o, tgt) in enumerate(zip(rows, ops, targets)):
+            metric_rows[i], op_ids[i], t[i], active[i] = r, o, tgt, True
+        t_hi, t_lo = i64.split_int64_np(t)
+        return RuleSet(
+            metric_row=jnp.asarray(metric_rows),
+            op_id=jnp.asarray(op_ids),
+            target=i64.I64(hi=jnp.asarray(t_hi), lo=jnp.asarray(t_lo)),
+            active=jnp.asarray(active),
+        )
+
+    # -- serving --------------------------------------------------------------
+
+    def planned_node(self, pod: Pod) -> Optional[str]:
+        """The batch-assigned node for this pod, if the plan is current
+        against the mirror (otherwise None -> per-request path)."""
+        with self._lock:
+            entry = self._plan.get(object_key(pod))
+        if entry is None:
+            return None
+        node, version = entry
+        if version != self.mirror.version:
+            return None  # cluster state moved since the solve
+        return node
+
+    # -- pending-pod feed -------------------------------------------------------
+
+    def watch(self, kube_client):
+        """Informer over pods feeding the pending set (labelled, unbound,
+        not completed)."""
+        from platform_aware_scheduling_tpu.kube.informer import (
+            DeletedFinalStateUnknown,
+            Informer,
+            ListWatch,
+        )
+
+        def on_event(pod: Pod) -> None:
+            if TAS_POLICY_LABEL not in pod.get_labels():
+                return
+            if pod.spec_node_name or pod.phase in ("Succeeded", "Failed"):
+                self.pod_removed(pod)
+            else:
+                self.pod_added(pod)
+
+        def on_delete(obj) -> None:
+            if isinstance(obj, DeletedFinalStateUnknown):
+                obj = obj.obj
+            if isinstance(obj, Pod):
+                self.pod_removed(obj)
+
+        informer = Informer(
+            ListWatch(
+                lambda: (kube_client.list_pods(), ""),
+                lambda rv: (
+                    (etype, Pod(raw)) for etype, raw in kube_client.watch_pods()
+                ),
+                object_key,
+            ),
+            on_add=on_event,
+            on_update=lambda _old, new: on_event(new),
+            on_delete=on_delete,
+        )
+        informer.start()
+        return informer
+
+    # -- background loop -------------------------------------------------------
+
+    def start(self, period_seconds: float) -> threading.Event:
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(period_seconds):
+                try:
+                    self.replan()
+                except Exception as exc:
+                    klog.error("replan failed: %s", exc)
+
+        threading.Thread(target=loop, daemon=True).start()
+        return stop

@@ -73,10 +73,14 @@ class MetricsExtender:
         cache: AutoUpdatingCache,
         mirror: Optional[TensorStateMirror] = None,
         recorder: Optional[LatencyRecorder] = None,
+        planner=None,
     ):
         self.cache = cache
         self.mirror = mirror
         self.recorder = recorder or LatencyRecorder()
+        # opt-in tas.planner.BatchPlanner: prioritize answers steer planned
+        # pods onto their batch-assigned node (see planner module doc)
+        self.planner = planner
 
     # -- verbs ----------------------------------------------------------------
 
@@ -163,10 +167,31 @@ class MetricsExtender:
         compiled, view = self._device_policy(policy)
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
-                return self._prioritize_device(compiled, view, names)
+                result = self._prioritize_device(compiled, view, names)
             except Exception as exc:  # device trouble must never fail the verb
                 klog.error("device prioritize failed, host fallback: %s", exc)
-        return self._prioritize_host(rule, names)
+                result = self._prioritize_host(rule, names)
+        else:
+            result = self._prioritize_host(rule, names)
+        return self._apply_plan(args.pod, result)
+
+    def _apply_plan(
+        self, pod: Pod, result: List[HostPriority]
+    ) -> List[HostPriority]:
+        """Promote the batch-planned node (if any, current, and among the
+        scored candidates) to rank 1; scores stay ordinal 10-i."""
+        if self.planner is None or not result:
+            return result
+        planned = self.planner.planned_node(pod)
+        if planned is None:
+            return result
+        hosts = [hp.host for hp in result]
+        if planned not in hosts:
+            return result
+        reordered = [planned] + [h for h in hosts if h != planned]
+        return [
+            HostPriority(host=h, score=10 - i) for i, h in enumerate(reordered)
+        ]
 
     def _prioritize_device(
         self,

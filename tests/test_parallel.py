@@ -291,3 +291,35 @@ class TestPallasAssign:
         np.testing.assert_array_equal(
             np.asarray(got.node_for_pod), np.asarray(want.node_for_pod)
         )
+
+
+class TestRingPrioritize:
+    """Ring-pass ranking must equal both the all_gather sharded form and
+    the single-device sort."""
+
+    @pytest.mark.parametrize("op", [OP_LESS_THAN, OP_GREATER_THAN, 2])
+    def test_matches_all_gather_and_single(self, op):
+        from platform_aware_scheduling_tpu.parallel.sharded import (
+            sharded_prioritize_ring,
+        )
+
+        rng = np.random.default_rng(21)
+        mesh = make_mesh(n_node_shards=8)
+        vals = rand_i64(rng, 64)
+        vals[3] = vals[40]  # cross-shard tie
+        valid = rng.random(64) > 0.25
+        value = i64.from_int64(vals)
+        single = ordinal_scores(value, jnp.asarray(valid), jnp.int32(op))
+        gather_scores, _ = sharded_prioritize(
+            mesh, value, jnp.asarray(valid), jnp.int32(op)
+        )
+        ring_scores, ring_valid = sharded_prioritize_ring(
+            mesh, value, jnp.asarray(valid), jnp.int32(op)
+        )
+        s_single = np.asarray(single.scores)
+        s_gather = np.asarray(gather_scores)
+        s_ring = np.asarray(ring_scores)
+        np.testing.assert_array_equal(np.asarray(ring_valid), valid)
+        for i in range(64):
+            if valid[i]:
+                assert s_ring[i] == s_single[i] == s_gather[i], i
