@@ -58,6 +58,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--batchPlanner", action="store_true",
                         help="solve the whole pending set each sync period "
                         "and steer pods onto their batch-assigned nodes")
+    parser.add_argument("--batchSolver", default="greedy",
+                        choices=["greedy", "sinkhorn"],
+                        help="batch planner solver: greedy (sequential-"
+                        "equivalent) or sinkhorn (globally coordinated)")
     return parser
 
 
@@ -67,6 +71,7 @@ def assemble(
     sync_period_s: float,
     enable_device_path: bool = True,
     enable_batch_planner: bool = False,
+    batch_solver: str = "greedy",
 ):
     """Wire cache + mirror + extender + controller + enforcer (the body of
     ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
@@ -80,7 +85,7 @@ def assemble(
     if enable_batch_planner and mirror is not None:
         from platform_aware_scheduling_tpu.tas.planner import BatchPlanner
 
-        planner = BatchPlanner(cache, mirror)
+        planner = BatchPlanner(cache, mirror, solver=batch_solver)
     extender = MetricsExtender(cache, mirror=mirror, planner=planner)
 
     enforcer = core.MetricEnforcer(kube_client, mirror=mirror)
@@ -115,6 +120,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         metrics_client,
         sync_period_s,
         enable_batch_planner=args.batchPlanner,
+        batch_solver=args.batchSolver,
     )
 
     server = Server(extender, metrics_provider=extender.recorder.prometheus_text)

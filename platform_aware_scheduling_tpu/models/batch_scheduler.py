@@ -60,6 +60,7 @@ class ScheduleOutput(NamedTuple):
     assignment: AssignResult
     violating: jax.Array  # bool [N]
     score: i64.I64  # [P, N] keys used (larger = better)
+    eligible: jax.Array  # bool [P, N] — candidates ∩ present ∩ ¬violating
 
 
 def _score_keys(values: i64.I64, present, metric_row, op_id) -> i64.I64:
@@ -105,7 +106,9 @@ def scheduling_step(state: ClusterState, pods: PendingPods) -> ScheduleOutput:
         assignment = greedy_assign_pallas(score, eligible, state.capacity)
     else:
         assignment = greedy_assign_kernel(score, eligible, state.capacity)
-    return ScheduleOutput(assignment=assignment, violating=violating, score=score)
+    return ScheduleOutput(
+        assignment=assignment, violating=violating, score=score, eligible=eligible
+    )
 
 
 def example_inputs(

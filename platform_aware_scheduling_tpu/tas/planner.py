@@ -48,10 +48,15 @@ class BatchPlanner:
         cache: AutoUpdatingCache,
         mirror: TensorStateMirror,
         node_capacity: int = DEFAULT_NODE_CAPACITY,
+        solver: str = "greedy",
     ):
+        """``solver``: "greedy" reproduces what the sequential scheduler
+        would do; "sinkhorn" globally coordinates the batch
+        (ops/sinkhorn.py) — strictly an enhancement over the reference."""
         self.cache = cache
         self.mirror = mirror
         self.node_capacity = node_capacity
+        self.solver = solver
         self._lock = threading.Lock()
         self._pending: Dict[str, Pod] = {}
         # pod key -> (assigned node name, mirror version it was solved at)
@@ -129,7 +134,17 @@ class BatchPlanner:
             candidates=jnp.asarray(candidates),
         )
         out = scheduling_step(state, batch)
-        assigned = np.asarray(out.assignment.node_for_pod)
+        if self.solver == "sinkhorn":
+            from platform_aware_scheduling_tpu.ops.sinkhorn import (
+                sinkhorn_assign_kernel,
+            )
+
+            sink = sinkhorn_assign_kernel(
+                out.score, out.eligible, state.capacity
+            )
+            assigned = np.asarray(sink.assignment.node_for_pod)
+        else:
+            assigned = np.asarray(out.assignment.node_for_pod)
         plan: Dict[str, Tuple[str, int]] = {}
         for i, (key, _row, _op) in enumerate(compiled_rows):
             node_idx = int(assigned[i])

@@ -159,3 +159,24 @@ class TestWatchFeed:
             assert planner.pending_count() == 0
         finally:
             informer.stop()
+
+
+class TestSinkhornPlanner:
+    def test_sinkhorn_solver_coordinates(self):
+        cache = AutoUpdatingCache()
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+        planner = BatchPlanner(cache, mirror, node_capacity=1,
+                               solver="sinkhorn")
+        cache.write_policy(
+            "default", "plan-pol",
+            TASPolicy.from_obj(make_policy("plan-pol", strategies={
+                "scheduleonmetric": [rule("m", "GreaterThan", 0)]})),
+        )
+        cache.write_metric("m", metric_info(n1=100, n2=99))
+        planner.pod_added(pending_pod("p0"))
+        planner.pod_added(pending_pod("p1"))
+        assert planner.replan() == 2
+        placed = {planner.planned_node(pending_pod("p0")),
+                  planner.planned_node(pending_pod("p1"))}
+        assert placed == {"n1", "n2"}
