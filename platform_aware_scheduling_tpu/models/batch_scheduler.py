@@ -80,8 +80,10 @@ def _score_keys(values: i64.I64, present, metric_row, op_id) -> i64.I64:
 
 
 @jax.jit
-def scheduling_step(state: ClusterState, pods: PendingPods) -> ScheduleOutput:
-    """One full solve over the pending set."""
+def score_and_filter(state: ClusterState, pods: PendingPods):
+    """The non-assignment half of the solve: (violating, score, eligible).
+    Separable so alternative assignment solvers (ops/sinkhorn.py) don't pay
+    for a greedy solve they discard."""
     violating = violated_nodes(
         state.metric_values, state.metric_present, state.dontschedule
     )
@@ -90,6 +92,13 @@ def scheduling_step(state: ClusterState, pods: PendingPods) -> ScheduleOutput:
     )
     present = state.metric_present[pods.metric_row]  # [P, N]
     eligible = pods.candidates & present & ~violating[None, :]
+    return violating, score, eligible
+
+
+@jax.jit
+def scheduling_step(state: ClusterState, pods: PendingPods) -> ScheduleOutput:
+    """One full solve over the pending set."""
+    violating, score, eligible = score_and_filter(state, pods)
     # All three assignment kernels are exact greedy-in-order.  Measured on
     # v5e at 1k x 10k: the Pallas kernel (~6 ms; capacity resident in VMEM,
     # one launch) beats the XLA scan (~12 ms; P dispatch-bound steps), which

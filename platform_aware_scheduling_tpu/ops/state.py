@@ -212,17 +212,34 @@ class TensorStateMirror:
                 if self._values.shape != shape_before:
                     self._version += 1
                 return
-            self._present[row, :] = False
+            # stage the new row, then bump the version only on real change:
+            # the periodic refresh re-writes every metric each sync period
+            # (autoupdating.go:37-59) and steady-state values must not
+            # invalidate snapshots/plans or force device re-uploads
             host_only = False
+            staged: Dict[int, int] = {}
             for node_name, metric in info.items():
                 col = self._intern_node(node_name)
                 milli, exact = metric.value.milli_value_exact()
                 if not exact:
                     host_only = True
-                self._values[row, col] = milli
-                self._present[row, col] = True
+                staged[col] = milli
+            grew = self._values.shape != shape_before
+            new_values = np.zeros(self._values.shape[1], dtype=np.int64)
+            new_present = np.zeros(self._values.shape[1], dtype=bool)
+            for col, milli in staged.items():
+                new_values[col] = milli
+                new_present[col] = True
+            changed = (
+                grew
+                or not np.array_equal(self._present[row], new_present)
+                or not np.array_equal(self._values[row], new_values)
+            )
             self._host_only_metrics[metric_name] = host_only
-            self._version += 1
+            if changed:
+                self._values[row] = new_values
+                self._present[row] = new_present
+                self._version += 1
 
     def on_metric_delete(self, metric_name: str) -> None:
         with self._lock:

@@ -29,6 +29,7 @@ from platform_aware_scheduling_tpu.models.batch_scheduler import (
     ClusterState,
     PendingPods,
     scheduling_step,
+    score_and_filter,
 )
 from platform_aware_scheduling_tpu.ops import i64
 from platform_aware_scheduling_tpu.ops.rules import OP_IDS, RuleSet
@@ -133,17 +134,16 @@ class BatchPlanner:
             op_id=jnp.asarray(op_id),
             candidates=jnp.asarray(candidates),
         )
-        out = scheduling_step(state, batch)
         if self.solver == "sinkhorn":
             from platform_aware_scheduling_tpu.ops.sinkhorn import (
                 sinkhorn_assign_kernel,
             )
 
-            sink = sinkhorn_assign_kernel(
-                out.score, out.eligible, state.capacity
-            )
+            _violating, score, eligible = score_and_filter(state, batch)
+            sink = sinkhorn_assign_kernel(score, eligible, state.capacity)
             assigned = np.asarray(sink.assignment.node_for_pod)
         else:
+            out = scheduling_step(state, batch)
             assigned = np.asarray(out.assignment.node_for_pod)
         plan: Dict[str, Tuple[str, int]] = {}
         for i, (key, _row, _op) in enumerate(compiled_rows):

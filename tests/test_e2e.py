@@ -30,7 +30,7 @@ from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
 SYNC_PERIOD_S = 0.05
 
 
-def wait_until(pred, timeout=8.0):
+def wait_until(pred, timeout=30.0):  # generous: suite runs compile JAX concurrently
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if pred():

@@ -1,0 +1,195 @@
+"""Failure-path behavior (SURVEY §5.3): annotate conflict-retry, bind
+rollback, watch-stream breakage recovery, metrics-client outages."""
+
+import json
+import time
+
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.gas.cache import Cache, get_key
+from platform_aware_scheduling_tpu.gas.scheduler import GASExtender
+from platform_aware_scheduling_tpu.kube.client import KubeError
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.controller import TelemetryPolicyController
+from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
+from platform_aware_scheduling_tpu.testing.builders import (
+    make_node,
+    make_policy,
+    make_pod,
+    rule,
+)
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def post(obj) -> HTTPRequest:
+    return HTTPRequest("POST", "/x", {"Content-Type": "application/json"},
+                       json.dumps(obj).encode())
+
+
+def gpu_setup():
+    kube = FakeKubeClient()
+    kube.add_node(make_node(
+        "n1",
+        labels={"gpu.intel.com/cards": "card0"},
+        allocatable={"gpu.intel.com/i915": "4",
+                     "gpu.intel.com/millicores": "4000"},
+    ))
+    pod = make_pod("p", container_requests=[
+        {"gpu.intel.com/i915": "1", "gpu.intel.com/millicores": "100"}])
+    kube.add_pod(pod)
+    cache = Cache(kube, start=False)
+    ext = GASExtender(kube, cache=cache, use_device=False)
+    cache.start()
+    return kube, cache, ext, pod
+
+
+def bind_req(pod):
+    return post({"PodName": pod.name, "PodNamespace": "default",
+                 "PodUID": pod.uid, "Node": "n1"})
+
+
+class TestAnnotateConflictRetry:
+    def test_retries_through_conflicts(self):
+        """4 conflicts < the 5-attempt retry budget -> bind succeeds
+        (reference scheduler.go:90-110)."""
+        kube, cache, ext, pod = gpu_setup()
+        try:
+            kube.update_pod_conflicts_remaining = 4
+            resp = ext.bind(bind_req(pod))
+            assert json.loads(resp.body) == {"Error": ""}
+            assert kube.get_pod("default", "p").get_annotations()[
+                "gas-container-cards"] == "card0"
+        finally:
+            cache.stop()
+
+    def test_exhausted_retries_roll_back(self):
+        kube, cache, ext, pod = gpu_setup()
+        try:
+            kube.update_pod_conflicts_remaining = 10
+            resp = ext.bind(bind_req(pod))
+            assert json.loads(resp.body)["Error"] != ""
+            # booking rolled back, no binding recorded
+            assert cache.get_node_resource_status("n1") in ({}, {"card0": {
+                "gpu.intel.com/i915": 0, "gpu.intel.com/millicores": 0}})
+            assert get_key(pod) not in cache.annotated_pods
+            assert kube.bindings == []
+        finally:
+            cache.stop()
+
+
+class TestBindAPIFailureRollback:
+    def test_bind_subresource_failure_rolls_back(self):
+        """Annotation succeeded but Bind API failed -> resources restored
+        (reference scheduler.go:404-414)."""
+        kube, cache, ext, pod = gpu_setup()
+        try:
+            kube.fail_next_bind = KubeError("apiserver unavailable", status=503)
+            resp = ext.bind(bind_req(pod))
+            assert "apiserver unavailable" in json.loads(resp.body)["Error"]
+            status = cache.get_node_resource_status("n1")
+            booked = sum(
+                rm.get("gpu.intel.com/millicores", 0) for rm in status.values()
+            )
+            assert booked == 0
+            assert get_key(pod) not in cache.annotated_pods
+        finally:
+            cache.stop()
+
+
+class FlakyWatchClient:
+    """Delegates to a FakeKubeClient but breaks the policy watch stream
+    after each event (forcing the informer's relist path every time)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.breaks = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def watch_taspolicies(self, namespace=None, **kw):
+        iterator = self._inner.watch_taspolicies(namespace, **kw)
+
+        def flaky():
+            for event in iterator:
+                yield event
+                self.breaks += 1
+                raise KubeError("watch stream reset", status=500)
+
+        return flaky()
+
+
+class TestWatchBreakRecovery:
+    def test_controller_survives_watch_resets(self):
+        kube = FakeKubeClient()
+        flaky = FlakyWatchClient(kube)
+        cache = AutoUpdatingCache()
+        enforcer = core.MetricEnforcer(kube)
+        enforcer.register_strategy_type(dontschedule.Strategy())
+        controller = TelemetryPolicyController(flaky, cache, enforcer)
+        informer = controller.run()
+        assert informer.wait_for_cache_sync()
+        try:
+            kube.create_taspolicy(make_policy(
+                "flaky-pol",
+                strategies={"dontschedule": [rule("m", "LessThan", 1)]},
+            ))
+            assert wait_until(lambda: _has(cache, "default", "flaky-pol"))
+            kube.delete_taspolicy("default", "flaky-pol")
+            assert wait_until(lambda: not _has(cache, "default", "flaky-pol"))
+        finally:
+            informer.stop()
+
+
+def _has(cache, ns, name):
+    try:
+        cache.read_policy(ns, name)
+        return True
+    except Exception:
+        return False
+
+
+class TestMetricsOutage:
+    def test_periodic_update_survives_client_errors(self):
+        """A failing metrics client must not kill the refresh loop or evict
+        the last good values (autoupdating.go error path)."""
+        from platform_aware_scheduling_tpu.tas.metrics import (
+            DummyMetricsClient,
+            MetricsError,
+        )
+
+        cache = AutoUpdatingCache()
+        from platform_aware_scheduling_tpu.testing.mocks import (
+            test_node_metric_custom_info,
+        )
+
+        good = test_node_metric_custom_info(["a"], [7])
+        cache.write_metric("m", good)
+        cache.write_metric("m")  # register for refresh
+
+        class FlakyMetrics:
+            def __init__(self):
+                self.calls = 0
+
+            def get_node_metric(self, name):
+                self.calls += 1
+                raise MetricsError("custom metrics api down")
+
+        client = FlakyMetrics()
+        stop = cache.start_periodic_update(0.02, client)
+        try:
+            assert wait_until(lambda: client.calls >= 3)
+            # last good value still served
+            assert cache.read_metric("m")["a"].value.cmp_int64(7) == 0
+        finally:
+            stop.set()

@@ -203,3 +203,15 @@ class TestDescheduleDevicePath:
         _, mirror = attach_pair()
         strat = deschedule.Strategy(policy_name="ghost")
         assert strat.violated_device(mirror) is None
+
+
+def test_unchanged_metric_rewrite_keeps_version():
+    """Periodic refresh with identical values must not invalidate the
+    snapshot (plans/device buffers stay valid in steady state)."""
+    cache, mirror = attach_pair()
+    cache.write_metric("m", info(a="1", b="2"))
+    v1 = mirror.device_view()
+    cache.write_metric("m", info(a="1", b="2"))  # same values, new objects
+    assert mirror.device_view() is v1
+    cache.write_metric("m", info(a="1"))  # b vanished -> real change
+    assert mirror.device_view() is not v1

@@ -404,3 +404,15 @@ class TestSinkhornAssign:
         )
         # coordination must never place fewer pods
         assert s_assigned >= g_assigned
+
+
+class TestMultisliceMesh:
+    def test_single_slice_degenerates(self):
+        from platform_aware_scheduling_tpu.parallel.mesh import (
+            make_multislice_mesh,
+        )
+
+        mesh = make_multislice_mesh(n_pod_shards_per_slice=2)
+        shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+        assert shape[POD_AXIS] == 2
+        assert shape[POD_AXIS] * shape[NODE_AXIS] <= len(jax.devices())
