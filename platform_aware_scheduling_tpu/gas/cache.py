@@ -177,14 +177,17 @@ class Cache:
     def on_node_change(self, hook) -> None:
         """Register node add/update/delete callback ``hook(node,
         deleted=False)``; replays the currently-cached nodes so a
-        late-attaching subscriber starts complete."""
-        self._node_hooks.append(hook)
-        for node in self._node_informer.list():
-            hook(node)
+        late-attaching subscriber starts complete.  Registration + replay
+        run serialized against the informer's dispatch, so the replay can
+        neither miss a concurrent event nor resurrect a node whose delete
+        was already delivered."""
 
-    def list_booked_nodes(self):
-        with self._rwmutex:
-            return list(self.node_statuses)
+        def register_and_replay():
+            self._node_hooks.append(hook)
+            for node in self._node_informer.list():
+                hook(node)
+
+        self._node_informer.serialized(register_and_replay)
 
     # -- event plumbing (node_resource_cache.go:146-158, 305-400) --------------
 
@@ -353,5 +356,14 @@ class Cache:
 
     def on_booking_change(self, hook) -> None:
         """Register a callback fired (with the node name, lock held) after a
-        successful booking change — feeds the device usage mirror."""
-        self._mutation_hooks.append(hook)
+        successful booking change — feeds the device usage mirror.
+
+        Replay of already-booked nodes and registration happen under one
+        ``_rwmutex`` hold: hooks always run in cache-lock → subscriber-lock
+        order (both here and from ``adjust_pod_resources``), so a subscriber
+        taking its own lock inside the hook cannot deadlock against the
+        worker, and no booking between replay and registration is missed."""
+        with self._rwmutex:
+            for node_name in self.node_statuses:
+                hook(node_name)
+            self._mutation_hooks.append(hook)

@@ -70,10 +70,11 @@ class GASUsageMirror:
         self._known = np.zeros(n, dtype=bool)
         self._version = 0
         self._device: Optional[Tuple[int, BinpackNodeState]] = None
-        cache.on_booking_change(self.on_booking_change)
         cache.on_node_change(self.on_node_change)  # replays cached nodes
-        for node_name in cache.list_booked_nodes():
-            self.on_booking_change(node_name)
+        # replays booked nodes + registers atomically under the cache lock,
+        # preserving cache→mirror lock order (no ABBA window against the
+        # cache worker firing the hook mid-construction)
+        cache.on_booking_change(self.on_booking_change)
 
     # -- interning -------------------------------------------------------------
 

@@ -63,6 +63,11 @@ class Informer:
         self._filter = filter_func
         self._store: Dict[str, Any] = {}
         self._store_lock = threading.RLock()
+        # client-go delivers all handler calls from one goroutine; the watch
+        # and resync threads here share this lock so handlers never run
+        # concurrently (a resync update racing a delete could transiently
+        # resurrect deleted state in subscribers)
+        self._dispatch_lock = threading.Lock()
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -81,6 +86,15 @@ class Informer:
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
+
+    def serialized(self, fn: Callable[[], Any]) -> Any:
+        """Run ``fn`` under the dispatch lock — no handler runs concurrently
+        with it.  Late subscribers use this to register-then-replay the
+        store atomically against in-flight watch/resync deliveries (a
+        replay outside the lock could resurrect a concurrently-deleted
+        object in the subscriber)."""
+        with self._dispatch_lock:
+            return fn()
 
     def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
         return self._synced.wait(timeout)
@@ -107,16 +121,19 @@ class Informer:
         return self._filter is None or bool(self._filter(obj))
 
     def _dispatch_add(self, obj: Any) -> None:
-        if self._passes(obj):
-            self._on_add(obj)
+        with self._dispatch_lock:
+            if self._passes(obj):
+                self._on_add(obj)
 
     def _dispatch_update(self, old: Any, new: Any) -> None:
-        if self._passes(new):
-            self._on_update(old, new)
+        with self._dispatch_lock:
+            if self._passes(new):
+                self._on_update(old, new)
 
     def _dispatch_delete(self, obj: Any) -> None:
-        if self._passes(obj):
-            self._on_delete(obj)
+        with self._dispatch_lock:
+            if self._passes(obj):
+                self._on_delete(obj)
 
     def _relist(self, initial: bool) -> None:
         objects, rv = self._lw.list()
@@ -139,10 +156,26 @@ class Informer:
 
     def _resync_loop(self) -> None:
         """Re-deliver update(obj, obj) for everything cached, every resync
-        period — the replay that rebuilds GAS state (survey §3.7)."""
+        period — the replay that rebuilds GAS state (survey §3.7).
+
+        Each delivery re-reads the store under the dispatch lock: a key the
+        watch thread removed (or replaced) since the snapshot is skipped (or
+        delivered at its current value), so a resync can never re-deliver an
+        object after its delete and resurrect state in subscribers."""
         while not self._stop.wait(self._resync_period):
-            for cached in self.list():
-                self._dispatch_update(cached, cached)
+            self._resync_once()
+
+    def _resync_once(self) -> None:
+        with self._store_lock:
+            keys = list(self._store.keys())
+        for key in keys:
+            with self._dispatch_lock:
+                with self._store_lock:
+                    current = self._store.get(key)
+                if current is None:
+                    continue
+                if self._passes(current):
+                    self._on_update(current, current)
 
     def _run(self) -> None:
         first = True

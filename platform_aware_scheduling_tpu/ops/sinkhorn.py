@@ -68,12 +68,18 @@ def sinkhorn_assign_kernel(
     utility = _normalize_scores(score, eligible)  # [P, N] in [0, 1]
     logits = jnp.where(eligible, utility / jnp.float32(tau), NEG)
     cap_f = capacity.astype(jnp.float32)
+    # a pod with no eligible node has logits all ≈ NEG; -row_lse would blow
+    # up to ≈ +1e30 and the NEG+1e30 terms cancel to ~0 in col_lse, adding
+    # phantom unit mass to every column — pin such rows at NEG so they carry
+    # no mass (greedy re-masks eligibility, so feasibility never depended on
+    # this, only plan quality for the real pods)
+    has_eligible = jnp.any(eligible, axis=1)
 
     def step(carry, _):
         log_u, log_v = carry
         # rows: each pod places exactly one unit
         row_lse = jax.nn.logsumexp(logits + log_v[None, :], axis=1)
-        log_u = -row_lse
+        log_u = jnp.where(has_eligible, -row_lse, NEG)
         # cols: node absorption bounded by capacity (unbalanced OT:
         # only scale DOWN overloaded columns)
         col_lse = jax.nn.logsumexp(logits + log_u[:, None], axis=0)

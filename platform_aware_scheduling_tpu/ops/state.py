@@ -351,6 +351,21 @@ class TensorStateMirror:
                     return compiled, self._view_locked()
         return None, None
 
+    def policies_with_view(
+        self, keys: Sequence[Tuple[str, str]]
+    ) -> Tuple[Dict[Tuple[str, str], Optional[CompiledPolicy]], DeviceView, frozenset]:
+        """Atomic ({(ns, name): policy}, view, host-only metric names) for a
+        whole batch under ONE lock acquisition — a per-policy loop could
+        straddle a metric delete + row reuse, leaving earlier policies'
+        compiled row indices pointing at a different metric in the view the
+        solve actually uses."""
+        with self._lock:
+            policies = {key: self._policies.get(key) for key in keys}
+            host_only = frozenset(
+                name for name, flag in self._host_only_metrics.items() if flag
+            )
+            return policies, self._view_locked(), host_only
+
     def policy_with_view(
         self, namespace: str, name: str
     ) -> Tuple[Optional[CompiledPolicy], DeviceView]:

@@ -1,0 +1,251 @@
+"""Regression tests for the round-1 advisor findings: mirror-attach lock
+ordering, informer resync serialization, atomic planner snapshots, and
+Sinkhorn handling of fully-ineligible pods."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from platform_aware_scheduling_tpu.gas.cache import ADD, REMOVE, Cache
+from platform_aware_scheduling_tpu.gas.device import DeviceBinpacker
+from platform_aware_scheduling_tpu.kube.informer import Informer, ListWatch
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.planner import BatchPlanner
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.testing.builders import (
+    make_node,
+    make_policy,
+    make_pod,
+    rule,
+)
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+
+def gpu_node(name, cards=2):
+    return make_node(
+        name,
+        labels={"gpu.intel.com/cards": ".".join(f"card{i}" for i in range(cards))},
+        allocatable={
+            "gpu.intel.com/i915": str(cards),
+            "gpu.intel.com/millicores": "2000",
+        },
+    )
+
+
+def gpu_pod(name, node_name=""):
+    return make_pod(
+        name,
+        container_requests=[{
+            "gpu.intel.com/i915": "1",
+            "gpu.intel.com/millicores": "100",
+        }],
+        node_name=node_name,
+    )
+
+
+class TestMirrorAttachLockOrder:
+    def test_attach_replays_existing_bookings(self):
+        """A mirror constructed against a cache that already carries
+        bookings must see them (replay happens inside hook registration)."""
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1"))
+        cache = Cache(kube, start=False)
+        cache.adjust_pod_resources_locked(
+            gpu_pod("p0", node_name="n1"), ADD, "card0", "n1"
+        )
+        packer = DeviceBinpacker(cache, use_mirror=True)
+        mirror = packer.mirror
+        with mirror._lock:
+            row = mirror._node_index["n1"]
+            assert mirror._used[row].sum() > 0
+
+    def test_construction_races_cache_worker_without_deadlock(self):
+        """ABBA regression: constructing a mirror while the cache worker is
+        firing booking hooks must not deadlock (advisor r1, medium).  The
+        old code replayed bookings cache-lock-free after registering the
+        hook — mirror→cache order against the worker's cache→mirror."""
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1"))
+        cache = Cache(kube, start=False)
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                pod = gpu_pod(f"c{i % 4}", node_name="n1")
+                cache.adjust_pod_resources_locked(pod, ADD, "card0", "n1")
+                cache.adjust_pod_resources_locked(pod, REMOVE, "card0", "n1")
+                i += 1
+
+        churner = threading.Thread(target=churn, daemon=True)
+        churner.start()
+        done = threading.Event()
+
+        def construct():
+            for _ in range(20):
+                DeviceBinpacker(cache, use_mirror=True)
+            done.set()
+
+        builder = threading.Thread(target=construct, daemon=True)
+        builder.start()
+        finished = done.wait(timeout=30)
+        stop.set()
+        churner.join(timeout=5)
+        assert finished, "mirror construction deadlocked against cache worker"
+
+
+class TestInformerResyncSerialization:
+    def _informer(self, objects, on_update, on_delete=None):
+        store = {k: v for k, v in objects.items()}
+        return Informer(
+            ListWatch(
+                lambda: (list(store.values()), ""),
+                lambda rv: iter(()),
+                lambda obj: obj["name"],
+            ),
+            on_update=on_update,
+            on_delete=on_delete,
+            resync_period=3600.0,
+        )
+
+    def test_resync_skips_concurrently_deleted_key(self):
+        """A resync pass must not re-deliver update(obj, obj) for an object
+        deleted since its snapshot — that transiently resurrected deleted
+        state in subscribers (advisor r1)."""
+        a, b = {"name": "a"}, {"name": "b"}
+        delivered = []
+
+        def on_update(old, new):
+            delivered.append(new["name"])
+            if new["name"] == "a":
+                # simulate the watch thread deleting b mid-resync: the
+                # dispatch lock serializes us, so the store mutation lands
+                # before the resync pass reaches b
+                with informer._store_lock:
+                    informer._store.pop("b", None)
+
+        informer = self._informer({"a": a, "b": b}, on_update)
+        informer._relist(initial=True)
+        informer._resync_once()
+        assert delivered == ["a"]
+
+    def test_resync_delivers_current_object_not_snapshot(self):
+        """An object replaced since the resync snapshot is re-delivered at
+        its current value, never the stale one."""
+        a_old = {"name": "a", "v": 1}
+        a_new = {"name": "a", "v": 2}
+        seen = []
+        informer = self._informer({"a": a_old}, lambda old, new: seen.append(new))
+        informer._relist(initial=True)
+        with informer._store_lock:
+            informer._store["a"] = a_new
+        informer._resync_once()
+        assert seen == [a_new]
+
+
+class TestPlannerAtomicSnapshot:
+    def _build(self):
+        cache = AutoUpdatingCache()
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+        planner = BatchPlanner(cache, mirror, node_capacity=5)
+        cache.write_policy(
+            "default",
+            "plan-pol",
+            TASPolicy.from_obj(
+                make_policy(
+                    "plan-pol",
+                    strategies={
+                        "scheduleonmetric": [rule("m", "GreaterThan", 0)],
+                        "dontschedule": [rule("m", "GreaterThan", 900)],
+                    },
+                )
+            ),
+        )
+        cache.write_metric(
+            "m",
+            {n: NodeMetric(value=Quantity(str(v)))
+             for n, v in {"n1": 100, "n2": 50}.items()},
+        )
+        return cache, mirror, planner
+
+    def test_replan_takes_one_snapshot(self):
+        """replan resolves every pod against ONE (policies, view) snapshot —
+        the per-pod policy_with_view loop is gone (advisor r1)."""
+        cache, mirror, planner = self._build()
+        calls = []
+        original = mirror.policies_with_view
+
+        def counting(keys):
+            calls.append(tuple(keys))
+            return original(keys)
+
+        mirror.policies_with_view = counting
+        mirror.policy_with_view = None  # any per-pod fallback would crash
+        for i in range(3):
+            planner.pod_added(
+                make_pod(f"p{i}", labels={"telemetry-policy": "plan-pol"})
+            )
+        assert planner.replan() == 3
+        assert len(calls) == 1
+
+    def test_snapshot_is_immune_to_concurrent_metric_delete(self):
+        """Mutating the mirror after the snapshot is taken must not change
+        what the snapshot resolves to."""
+        cache, mirror, planner = self._build()
+        policies, view, host_only = mirror.policies_with_view(
+            [("default", "plan-pol")]
+        )
+        compiled = policies[("default", "plan-pol")]
+        row_before = compiled.scheduleonmetric_row
+        values_before = np.asarray(view.values.lo).copy()
+        cache.delete_metric("m")
+        cache.write_metric(
+            "other", {"n1": NodeMetric(value=Quantity("7"))}
+        )
+        assert compiled.scheduleonmetric_row == row_before
+        assert np.array_equal(np.asarray(view.values.lo), values_before)
+
+
+class TestSinkhornIneligiblePods:
+    def test_ineligible_pod_carries_no_phantom_mass(self):
+        """A pod with no eligible node must not add phantom unit mass to
+        every column and skew the plan for real pods (advisor r1)."""
+        import jax.numpy as jnp
+
+        from platform_aware_scheduling_tpu.ops import i64
+        from platform_aware_scheduling_tpu.ops.sinkhorn import (
+            sinkhorn_assign_kernel,
+        )
+
+        scores = np.array([[30, 20, 10], [30, 20, 10], [5, 5, 5]],
+                          dtype=np.int64)
+        hi, lo = i64.split_int64_np(scores)
+        score = i64.I64(hi=jnp.asarray(hi), lo=jnp.asarray(lo))
+        capacity = jnp.asarray(np.array([1, 1, 1], dtype=np.int32))
+
+        eligible_all = jnp.asarray(
+            np.array([[1, 1, 1], [1, 1, 1], [0, 0, 0]], dtype=bool)
+        )
+        with_dead = sinkhorn_assign_kernel(score, eligible_all, capacity)
+        # the dead row holds no mass anywhere
+        assert float(jnp.sum(with_dead.plan[2])) == pytest.approx(0.0, abs=1e-6)
+        assert int(with_dead.assignment.node_for_pod[2]) == -1
+
+        # and the real pods' plan matches the 2-pod problem (no skew)
+        two = sinkhorn_assign_kernel(
+            i64.I64(hi=jnp.asarray(hi[:2]), lo=jnp.asarray(lo[:2])),
+            eligible_all[:2],
+            capacity,
+        )
+        np.testing.assert_allclose(
+            np.asarray(with_dead.plan[:2]), np.asarray(two.plan), atol=1e-5
+        )
+        assert list(np.asarray(with_dead.assignment.node_for_pod[:2])) == list(
+            np.asarray(two.assignment.node_for_pod)
+        )

@@ -161,6 +161,62 @@ class TestWatchFeed:
             informer.stop()
 
 
+class TestCapacityFidelity:
+    def test_full_node_stops_receiving_assignments(self):
+        """A node with no remaining pod slots (allocatable − bound == 0) must
+        not receive plan assignments, however well it scores."""
+        _, _, planner = build(node_capacity=5)
+        from platform_aware_scheduling_tpu.testing.builders import make_node
+
+        planner.node_changed(make_node("n1", allocatable={"pods": "2"}))
+        planner.pod_observed(make_pod("b0", node_name="n1"))
+        planner.pod_observed(make_pod("b1", node_name="n1"))
+        planner.pod_added(pending_pod("p0"))
+        assert planner.replan() == 1
+        assert planner.planned_node(pending_pod("p0")) == "n2"
+
+    def test_terminated_pod_frees_its_slot(self):
+        _, _, planner = build(node_capacity=5)
+        from platform_aware_scheduling_tpu.testing.builders import make_node
+
+        planner.node_changed(make_node("n1", allocatable={"pods": "1"}))
+        bound = make_pod("b0", node_name="n1")
+        planner.pod_observed(bound)
+        planner.pod_added(pending_pod("p0"))
+        planner.replan()
+        assert planner.planned_node(pending_pod("p0")) == "n2"
+        done = make_pod("b0", node_name="n1", phase="Succeeded")
+        planner.pod_observed(done)
+        planner.replan()
+        assert planner.planned_node(pending_pod("p0")) == "n1"
+
+    def test_unobserved_nodes_fall_back_to_default(self):
+        """Nodes with no observed allocatable keep the kubelet-default
+        fallback, so behavior without informers matches round 1."""
+        _, _, planner = build(node_capacity=1)
+        for i in range(3):
+            planner.pod_added(pending_pod(f"p{i}"))
+        assert planner.replan() == 3
+
+    def test_node_informer_feeds_allocatable(self):
+        from platform_aware_scheduling_tpu.testing.builders import make_node
+
+        cache, mirror, planner = build(node_capacity=5)
+        kube = FakeKubeClient()
+        kube.add_node(make_node("n1", allocatable={"pods": "0"}))
+        handle = planner.watch(kube)
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and "n1" not in planner._node_alloc:
+                time.sleep(0.02)
+            assert planner._node_alloc.get("n1") == 0
+            planner.pod_added(pending_pod("p0"))
+            planner.replan()
+            assert planner.planned_node(pending_pod("p0")) == "n2"
+        finally:
+            handle.stop()
+
+
 class TestSinkhornPlanner:
     def test_sinkhorn_solver_coordinates(self):
         cache = AutoUpdatingCache()
