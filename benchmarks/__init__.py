@@ -1,0 +1,1 @@
+"""Benchmark harnesses for the BASELINE.md configs (driven by bench.py)."""

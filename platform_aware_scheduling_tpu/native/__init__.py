@@ -1,0 +1,89 @@
+"""Native (C) components: build-on-demand loader.
+
+The reference has no native code (SURVEY: 100% Go, zero C++/CUDA), but this
+framework's runtime keeps its wire tails native: ``_wirec`` removes the
+per-request JSON-object churn at 10k-node scale (see wirec.c).  The module
+is compiled on first use with the toolchain baked into the image (g++/cc);
+everything degrades gracefully to the pure-Python paths when no compiler
+is available (``get_wirec() -> None``).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "wirec.c")
+_SO = os.path.join(_DIR, "_wirec.so")
+
+_lock = threading.Lock()
+_loaded = False
+_module = None
+
+
+def _build() -> bool:
+    cc = os.environ.get("CC", "cc")
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        cc,
+        "-O2",
+        "-fPIC",
+        "-shared",
+        f"-I{include}",
+        _SRC,
+        "-o",
+        _SO + ".tmp",
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        import sys
+
+        print(f"_wirec build failed:\n{proc.stderr}", file=sys.stderr)
+        return False
+    os.replace(_SO + ".tmp", _SO)
+    return True
+
+
+def _stale() -> bool:
+    try:
+        return os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    except OSError:
+        return True
+
+
+def get_wirec(allow_build: bool = True):
+    """The ``_wirec`` extension module, or None when unavailable.
+
+    Set ``PAS_TPU_NO_NATIVE=1`` to force the pure-Python paths (used by the
+    test matrix to keep both variants covered)."""
+    global _loaded, _module
+    if os.environ.get("PAS_TPU_NO_NATIVE") == "1":
+        return None
+    if _loaded:
+        return _module
+    with _lock:
+        if _loaded:
+            return _module
+        if _stale() and (not allow_build or not _build()):
+            _loaded = True
+            _module = None
+            return None
+        try:
+            spec = importlib.util.spec_from_file_location("_wirec", _SO)
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+        except Exception:
+            module = None
+        _loaded = True
+        _module = module
+        return _module

@@ -1,0 +1,951 @@
+/* _wirec: native fast path for the scheduler-extender wire protocol.
+ *
+ * The per-request hot cost at 10k nodes is NOT the scheduling math (that
+ * is precomputed per state version, tas/fastpath.py) but the wire tails:
+ * json-decoding an Args body into ~10k Python dicts/objects and re-encoding
+ * ~10k HostPriority entries.  This module removes both:
+ *
+ *   parse_prioritize(body)        -> ParsedArgs (pod meta + node-name
+ *                                    slices captured zero-copy; no per-node
+ *                                    Python objects)
+ *   build_table(node_names)       -> NameTable (FNV-1a open-addressing
+ *                                    name->row map + pre-rendered per-row
+ *                                    JSON fragments), built once per state
+ *                                    version
+ *   select_encode(parsed, table, ranked, planned_row)
+ *                                 -> response bytes: global rank order
+ *                                    restricted to the request's candidate
+ *                                    set, ordinal 10-i scores, optional
+ *                                    batch-plan promotion to rank 1
+ *
+ * The JSON scanner is strict: any structural surprise raises ValueError and
+ * the caller falls back to the exact Python path (which reproduces every
+ * reference quirk).  Semantics mirror tas/fastpath.py byte-for-byte; the
+ * equivalence is pinned by tests/test_wirec.py.
+ *
+ * Reference for the wire shape: extender/types.go:26-64 (Args,
+ * HostPriorityList); scoring semantics telemetryscheduler.go:128-149.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+/* ------------------------------------------------------------------ */
+/* growable byte buffer                                                */
+
+typedef struct {
+    char *data;
+    size_t len;
+    size_t cap;
+} Buf;
+
+static int buf_init(Buf *b, size_t cap) {
+    b->data = malloc(cap ? cap : 64);
+    if (!b->data) return -1;
+    b->len = 0;
+    b->cap = cap ? cap : 64;
+    return 0;
+}
+
+static void buf_free(Buf *b) {
+    free(b->data);
+    b->data = NULL;
+}
+
+static int buf_reserve(Buf *b, size_t extra) {
+    if (b->len + extra <= b->cap) return 0;
+    size_t ncap = b->cap * 2;
+    while (ncap < b->len + extra) ncap *= 2;
+    char *nd = realloc(b->data, ncap);
+    if (!nd) return -1;
+    b->data = nd;
+    b->cap = ncap;
+    return 0;
+}
+
+static int buf_put(Buf *b, const char *src, size_t n) {
+    if (buf_reserve(b, n) < 0) return -1;
+    memcpy(b->data + b->len, src, n);
+    b->len += n;
+    return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* JSON scanner over a byte body                                       */
+
+typedef struct {
+    const char *s;
+    Py_ssize_t n;
+    Py_ssize_t i;
+    const char *err;  /* static message; raised as ValueError by the caller
+                         (lets the scan run without the GIL) */
+} Scan;
+
+typedef struct {
+    Py_ssize_t off;   /* offset of first char INSIDE the quotes */
+    Py_ssize_t len;   /* raw length inside the quotes */
+    int escaped;      /* contains backslash escapes */
+    int present;
+} StrSlice;
+
+static void skip_ws(Scan *sc) {
+    while (sc->i < sc->n) {
+        char c = sc->s[sc->i];
+        if (c == ' ' || c == '\t' || c == '\n' || c == '\r') sc->i++;
+        else break;
+    }
+}
+
+/* record the first error on the scan state; raised as ValueError by the
+ * entry point after the GIL is re-acquired */
+static int fail_raw(Scan *sc, const char *msg) {
+    if (!sc->err) sc->err = msg;
+    return -1;
+}
+
+#define fail(msg) fail_raw(sc, msg)
+
+/* scan a JSON string starting at the opening quote; record the slice */
+static int scan_string(Scan *sc, StrSlice *out) {
+    if (sc->i >= sc->n || sc->s[sc->i] != '"') return fail("expected string");
+    sc->i++;
+    Py_ssize_t start = sc->i;
+    int escaped = 0;
+    while (sc->i < sc->n) {
+        char c = sc->s[sc->i];
+        if (c == '\\') {
+            escaped = 1;
+            sc->i += 2;
+            continue;
+        }
+        if (c == '"') {
+            if (out) {
+                out->off = start;
+                out->len = sc->i - start;
+                out->escaped = escaped;
+                out->present = 1;
+            }
+            sc->i++;
+            return 0;
+        }
+        if ((unsigned char)c < 0x20) return fail("control char in string");
+        sc->i++;
+    }
+    return fail("unterminated string");
+}
+
+static int skip_value(Scan *sc);
+
+static int skip_object(Scan *sc) {
+    sc->i++; /* '{' */
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; return 0; }
+    for (;;) {
+        skip_ws(sc);
+        if (scan_string(sc, NULL) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        if (skip_value(sc) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated object");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; return 0; }
+        return fail("bad object");
+    }
+}
+
+static int skip_array(Scan *sc) {
+    sc->i++; /* '[' */
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == ']') { sc->i++; return 0; }
+    for (;;) {
+        if (skip_value(sc) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated array");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == ']') { sc->i++; return 0; }
+        return fail("bad array");
+    }
+}
+
+static int skip_number(Scan *sc) {
+    if (sc->i < sc->n && sc->s[sc->i] == '-') sc->i++;
+    /* strict like json.loads: no leading zeros */
+    if (sc->i >= sc->n) return fail("bad number");
+    if (sc->s[sc->i] == '0') {
+        sc->i++;
+        if (sc->i < sc->n && sc->s[sc->i] >= '0' && sc->s[sc->i] <= '9')
+            return fail("leading zero");
+    } else if (sc->s[sc->i] >= '1' && sc->s[sc->i] <= '9') {
+        while (sc->i < sc->n && sc->s[sc->i] >= '0' && sc->s[sc->i] <= '9')
+            sc->i++;
+    } else {
+        return fail("bad number");
+    }
+    int digits;
+    if (sc->i < sc->n && sc->s[sc->i] == '.') {
+        sc->i++;
+        digits = 0;
+        while (sc->i < sc->n && sc->s[sc->i] >= '0' && sc->s[sc->i] <= '9') {
+            digits = 1; sc->i++;
+        }
+        if (!digits) return fail("bad number");
+    }
+    if (sc->i < sc->n && (sc->s[sc->i] == 'e' || sc->s[sc->i] == 'E')) {
+        sc->i++;
+        if (sc->i < sc->n && (sc->s[sc->i] == '+' || sc->s[sc->i] == '-')) sc->i++;
+        digits = 0;
+        while (sc->i < sc->n && sc->s[sc->i] >= '0' && sc->s[sc->i] <= '9') {
+            digits = 1; sc->i++;
+        }
+        if (!digits) return fail("bad number");
+    }
+    return 0;
+}
+
+static int skip_literal(Scan *sc, const char *lit, Py_ssize_t len) {
+    if (sc->i + len > sc->n || memcmp(sc->s + sc->i, lit, len) != 0)
+        return fail("bad literal");
+    sc->i += len;
+    return 0;
+}
+
+static int skip_value(Scan *sc) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("unexpected end");
+    switch (sc->s[sc->i]) {
+    case '{': return skip_object(sc);
+    case '[': return skip_array(sc);
+    case '"': return scan_string(sc, NULL);
+    case 't': return skip_literal(sc, "true", 4);
+    case 'f': return skip_literal(sc, "false", 5);
+    case 'n': return skip_literal(sc, "null", 4);
+    default:  return skip_number(sc);
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* ParsedArgs object                                                   */
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *body;        /* the bytes object; slices point into it */
+    StrSlice pod_name;
+    StrSlice pod_namespace;
+    StrSlice policy_label; /* labels["telemetry-policy"] */
+    int has_label;
+    int nodes_present;     /* "Nodes" was a non-null object with items */
+    StrSlice *names;       /* node name slices */
+    Py_ssize_t num_names;
+} ParsedArgs;
+
+static void ParsedArgs_dealloc(ParsedArgs *self) {
+    Py_XDECREF(self->body);
+    free(self->names);  /* raw-allocated: grown while the GIL is released */
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *slice_to_unicode(PyObject *body, const StrSlice *sl) {
+    if (!sl->present) Py_RETURN_NONE;
+    const char *base = PyBytes_AS_STRING(body);
+    if (!sl->escaped)
+        return PyUnicode_DecodeUTF8(base + sl->off, sl->len, "strict");
+    /* rare: route through the json module for exact escape handling */
+    PyObject *json_mod = PyImport_ImportModule("json");
+    if (!json_mod) return NULL;
+    PyObject *raw = PyUnicode_DecodeUTF8(base + sl->off - 1, sl->len + 2, "strict");
+    if (!raw) { Py_DECREF(json_mod); return NULL; }
+    PyObject *res = PyObject_CallMethod(json_mod, "loads", "O", raw);
+    Py_DECREF(raw);
+    Py_DECREF(json_mod);
+    return res;
+}
+
+static PyObject *ParsedArgs_get(ParsedArgs *self, void *closure) {
+    const char *which = (const char *)closure;
+    if (strcmp(which, "pod_name") == 0)
+        return slice_to_unicode(self->body, &self->pod_name);
+    if (strcmp(which, "pod_namespace") == 0)
+        return slice_to_unicode(self->body, &self->pod_namespace);
+    if (strcmp(which, "policy_label") == 0) {
+        if (!self->has_label) Py_RETURN_NONE;
+        return slice_to_unicode(self->body, &self->policy_label);
+    }
+    if (strcmp(which, "nodes_present") == 0)
+        return PyBool_FromLong(self->nodes_present);
+    if (strcmp(which, "num_nodes") == 0)
+        return PyLong_FromSsize_t(self->num_names);
+    Py_RETURN_NONE;
+}
+
+static PyObject *ParsedArgs_node_names(ParsedArgs *self, PyObject *noargs) {
+    PyObject *list = PyList_New(self->num_names);
+    if (!list) return NULL;
+    for (Py_ssize_t k = 0; k < self->num_names; k++) {
+        PyObject *u = slice_to_unicode(self->body, &self->names[k]);
+        if (!u) { Py_DECREF(list); return NULL; }
+        PyList_SET_ITEM(list, k, u);
+    }
+    return list;
+}
+
+static PyGetSetDef ParsedArgs_getset[] = {
+    {"pod_name", (getter)ParsedArgs_get, NULL, NULL, "pod_name"},
+    {"pod_namespace", (getter)ParsedArgs_get, NULL, NULL, "pod_namespace"},
+    {"policy_label", (getter)ParsedArgs_get, NULL, NULL, "policy_label"},
+    {"nodes_present", (getter)ParsedArgs_get, NULL, NULL, "nodes_present"},
+    {"num_nodes", (getter)ParsedArgs_get, NULL, NULL, "num_nodes"},
+    {NULL},
+};
+
+static PyMethodDef ParsedArgs_methods[] = {
+    {"node_names", (PyCFunction)ParsedArgs_node_names, METH_NOARGS,
+     "Materialize the node-name list (slow path / debugging)."},
+    {NULL},
+};
+
+static PyTypeObject ParsedArgs_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_wirec.ParsedArgs",
+    .tp_basicsize = sizeof(ParsedArgs),
+    .tp_dealloc = (destructor)ParsedArgs_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_getset = ParsedArgs_getset,
+    .tp_methods = ParsedArgs_methods,
+};
+
+/* -- Args-shaped scanning ------------------------------------------- */
+
+#define NAME_CHUNK 1024
+
+static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("eof in metadata");
+    if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
+    if (sc->s[sc->i] != '{') return fail("metadata not object");
+    sc->i++;
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; return 0; }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice key;
+        if (scan_string(sc, &key) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        skip_ws(sc);
+        const char *kp = sc->s + key.off;
+        if (!key.escaped && key.len == 4 && memcmp(kp, "name", 4) == 0) {
+            if (sc->i < sc->n && sc->s[sc->i] == '"') {
+                if (scan_string(sc, &pa->pod_name) < 0) return -1;
+            } else if (skip_value(sc) < 0) return -1;
+        } else if (!key.escaped && key.len == 9 &&
+                   memcmp(kp, "namespace", 9) == 0) {
+            if (sc->i < sc->n && sc->s[sc->i] == '"') {
+                if (scan_string(sc, &pa->pod_namespace) < 0) return -1;
+            } else if (skip_value(sc) < 0) return -1;
+        } else if (!key.escaped && key.len == 6 && memcmp(kp, "labels", 6) == 0) {
+            /* scan the labels object for "telemetry-policy" */
+            skip_ws(sc);
+            if (sc->i < sc->n && sc->s[sc->i] == '{') {
+                sc->i++;
+                skip_ws(sc);
+                if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; }
+                else for (;;) {
+                    skip_ws(sc);
+                    StrSlice lkey;
+                    if (scan_string(sc, &lkey) < 0) return -1;
+                    skip_ws(sc);
+                    if (sc->i >= sc->n || sc->s[sc->i] != ':')
+                        return fail("expected ':'");
+                    sc->i++;
+                    skip_ws(sc);
+                    if (!lkey.escaped && lkey.len == 16 &&
+                        memcmp(sc->s + lkey.off, "telemetry-policy", 16) == 0) {
+                        /* non-string label values take the exact Python
+                         * path (status-code parity on absurd input) */
+                        if (sc->i >= sc->n || sc->s[sc->i] != '"')
+                            return fail("label not string");
+                        if (scan_string(sc, &pa->policy_label) < 0) return -1;
+                        pa->has_label = 1;
+                    } else if (skip_value(sc) < 0) return -1;
+                    skip_ws(sc);
+                    if (sc->i >= sc->n) return fail("unterminated labels");
+                    if (sc->s[sc->i] == ',') { sc->i++; continue; }
+                    if (sc->s[sc->i] == '}') { sc->i++; break; }
+                    return fail("bad labels");
+                }
+            } else if (skip_value(sc) < 0) return -1;
+        } else {
+            if (skip_value(sc) < 0) return -1;
+        }
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated metadata");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; return 0; }
+        return fail("bad metadata");
+    }
+}
+
+static int scan_pod(Scan *sc, ParsedArgs *pa) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("eof in Pod");
+    if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
+    if (sc->s[sc->i] != '{') return fail("Pod not object");
+    sc->i++;
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; return 0; }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice key;
+        if (scan_string(sc, &key) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        if (!key.escaped && key.len == 8 &&
+            memcmp(sc->s + key.off, "metadata", 8) == 0) {
+            if (scan_pod_metadata(sc, pa) < 0) return -1;
+        } else {
+            if (skip_value(sc) < 0) return -1;
+        }
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated Pod");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; return 0; }
+        return fail("bad Pod");
+    }
+}
+
+static int push_name(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap,
+                     const StrSlice *sl) {
+    if (pa->num_names == *cap) {
+        Py_ssize_t ncap = *cap ? *cap * 2 : NAME_CHUNK;
+        StrSlice *nn = realloc(pa->names, ncap * sizeof(StrSlice));
+        if (!nn) return fail("out of memory");
+        pa->names = nn;
+        *cap = ncap;
+    }
+    pa->names[pa->num_names++] = *sl;
+    return 0;
+}
+
+static int scan_node_item(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
+    /* one Nodes.items entry: capture metadata.name, skip the rest */
+    skip_ws(sc);
+    if (sc->i >= sc->n || sc->s[sc->i] != '{') return fail("node not object");
+    sc->i++;
+    skip_ws(sc);
+    StrSlice name = {0, 0, 0, 0};
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; goto done; }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice key;
+        if (scan_string(sc, &key) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        if (!key.escaped && key.len == 8 &&
+            memcmp(sc->s + key.off, "metadata", 8) == 0) {
+            skip_ws(sc);
+            if (sc->i >= sc->n) return fail("eof in node metadata");
+            if (sc->s[sc->i] == '{') {
+                sc->i++;
+                skip_ws(sc);
+                if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; }
+                else for (;;) {
+                    skip_ws(sc);
+                    StrSlice mkey;
+                    if (scan_string(sc, &mkey) < 0) return -1;
+                    skip_ws(sc);
+                    if (sc->i >= sc->n || sc->s[sc->i] != ':')
+                        return fail("expected ':'");
+                    sc->i++;
+                    skip_ws(sc);
+                    if (!mkey.escaped && mkey.len == 4 &&
+                        memcmp(sc->s + mkey.off, "name", 4) == 0 &&
+                        sc->i < sc->n && sc->s[sc->i] == '"') {
+                        if (scan_string(sc, &name) < 0) return -1;
+                    } else if (skip_value(sc) < 0) return -1;
+                    skip_ws(sc);
+                    if (sc->i >= sc->n) return fail("unterminated node metadata");
+                    if (sc->s[sc->i] == ',') { sc->i++; continue; }
+                    if (sc->s[sc->i] == '}') { sc->i++; break; }
+                    return fail("bad node metadata");
+                }
+            } else if (skip_value(sc) < 0) return -1;
+        } else {
+            if (skip_value(sc) < 0) return -1;
+        }
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated node");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; break; }
+        return fail("bad node");
+    }
+done:
+    /* missing metadata.name encodes as empty slice at offset 0 */
+    return push_name(sc, pa, cap, &name);
+}
+
+static int scan_nodes(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("eof in Nodes");
+    if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
+    if (sc->s[sc->i] != '{') return fail("Nodes not object");
+    sc->i++;
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; return 0; }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice key;
+        if (scan_string(sc, &key) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        if (!key.escaped && key.len == 5 &&
+            memcmp(sc->s + key.off, "items", 5) == 0) {
+            skip_ws(sc);
+            if (sc->i < sc->n && sc->s[sc->i] == 'n') {
+                if (skip_literal(sc, "null", 4) < 0) return -1;
+                pa->nodes_present = 1;  /* Nodes object exists, items null */
+            } else if (sc->i < sc->n && sc->s[sc->i] == '[') {
+                pa->nodes_present = 1;
+                /* duplicate "items" keys: last wins like json.loads */
+                pa->num_names = 0;
+                sc->i++;
+                skip_ws(sc);
+                if (sc->i < sc->n && sc->s[sc->i] == ']') { sc->i++; }
+                else for (;;) {
+                    if (scan_node_item(sc, pa, cap) < 0) return -1;
+                    skip_ws(sc);
+                    if (sc->i >= sc->n) return fail("unterminated items");
+                    if (sc->s[sc->i] == ',') { sc->i++; continue; }
+                    if (sc->s[sc->i] == ']') { sc->i++; break; }
+                    return fail("bad items");
+                }
+            } else {
+                return fail("items not array");
+            }
+        } else {
+            if (skip_value(sc) < 0) return -1;
+        }
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated Nodes");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; return 0; }
+        return fail("bad Nodes");
+    }
+}
+
+static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
+    if (!PyBytes_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "body must be bytes");
+        return NULL;
+    }
+    ParsedArgs *pa = PyObject_New(ParsedArgs, &ParsedArgs_Type);
+    if (!pa) return NULL;
+    Py_INCREF(arg);
+    pa->body = arg;
+    memset(&pa->pod_name, 0, sizeof(StrSlice));
+    memset(&pa->pod_namespace, 0, sizeof(StrSlice));
+    memset(&pa->policy_label, 0, sizeof(StrSlice));
+    pa->has_label = 0;
+    pa->nodes_present = 0;
+    pa->names = NULL;
+    pa->num_names = 0;
+    Py_ssize_t cap = 0;
+
+    Scan scan_state = {PyBytes_AS_STRING(arg), PyBytes_GET_SIZE(arg), 0, NULL};
+    Scan *sc = &scan_state;
+    int ok = 1;
+    /* the scan touches only raw body bytes + raw-allocated name slices, so
+     * it runs without the GIL: concurrent requests parse in parallel */
+    Py_BEGIN_ALLOW_THREADS
+    skip_ws(sc);
+    if (sc->i >= sc->n || sc->s[sc->i] != '{') {
+        fail("body not a JSON object");
+        ok = 0;
+    } else {
+        sc->i++;
+        skip_ws(sc);
+        if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; }
+        else for (;;) {
+            skip_ws(sc);
+            StrSlice key;
+            if (scan_string(sc, &key) < 0) { ok = 0; break; }
+            skip_ws(sc);
+            if (sc->i >= sc->n || sc->s[sc->i] != ':') {
+                fail("expected ':'");
+                ok = 0;
+                break;
+            }
+            sc->i++;
+            const char *kp = sc->s + key.off;
+            int handled = 0;
+            if (!key.escaped && key.len == 3 && memcmp(kp, "Pod", 3) == 0) {
+                if (scan_pod(sc, pa) < 0) { ok = 0; break; }
+                handled = 1;
+            } else if (!key.escaped && key.len == 5 &&
+                       memcmp(kp, "Nodes", 5) == 0) {
+                pa->nodes_present = 0;
+                pa->num_names = 0;
+                if (scan_nodes(sc, pa, &cap) < 0) { ok = 0; break; }
+                handled = 1;
+            }
+            if (!handled && skip_value(sc) < 0) { ok = 0; break; }
+            skip_ws(sc);
+            if (sc->i >= sc->n) { fail("unterminated body"); ok = 0; break; }
+            if (sc->s[sc->i] == ',') { sc->i++; continue; }
+            if (sc->s[sc->i] == '}') { sc->i++; break; }
+            fail("bad body");
+            ok = 0;
+            break;
+        }
+        if (ok) {
+            skip_ws(sc);
+            if (sc->i != sc->n) { fail("trailing data"); ok = 0; }
+        }
+    }
+    Py_END_ALLOW_THREADS
+    if (!ok) {
+        Py_DECREF(pa);
+        PyErr_SetString(PyExc_ValueError, sc->err ? sc->err : "parse error");
+        return NULL;
+    }
+    return (PyObject *)pa;
+}
+
+/* ------------------------------------------------------------------ */
+/* NameTable: name -> row hash map + response fragments                */
+
+typedef struct {
+    PyObject_HEAD
+    Py_ssize_t n_rows;
+    /* open addressing table of 2^bits slots, each slot = row+1 (0=empty) */
+    uint32_t *slots;
+    uint32_t mask;
+    /* interned copies of names (concatenated) for collision verification */
+    char *name_bytes;
+    Py_ssize_t *name_off;  /* n_rows + 1 offsets */
+    /* pre-rendered fragments: {"Host": "<name>", "Score":  */
+    char *frag_bytes;
+    Py_ssize_t *frag_off;  /* n_rows + 1 offsets */
+} NameTable;
+
+static void NameTable_dealloc(NameTable *self) {
+    PyMem_Free(self->slots);
+    PyMem_Free(self->name_bytes);
+    PyMem_Free(self->name_off);
+    PyMem_Free(self->frag_bytes);
+    PyMem_Free(self->frag_off);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static uint64_t fnv1a(const char *s, Py_ssize_t n) {
+    uint64_t h = 1469598103934665603ULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        h ^= (unsigned char)s[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+/* row lookup by raw (unescaped) name bytes; -1 if absent */
+static Py_ssize_t table_lookup(NameTable *t, const char *s, Py_ssize_t n) {
+    uint64_t h = fnv1a(s, n);
+    uint32_t idx = (uint32_t)h & t->mask;
+    for (;;) {
+        uint32_t slot = t->slots[idx];
+        if (slot == 0) return -1;
+        Py_ssize_t row = (Py_ssize_t)slot - 1;
+        Py_ssize_t off = t->name_off[row];
+        Py_ssize_t len = t->name_off[row + 1] - off;
+        if (len == n && memcmp(t->name_bytes + off, s, n) == 0) return row;
+        idx = (idx + 1) & t->mask;
+    }
+}
+
+static PyTypeObject NameTable_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_wirec.NameTable",
+    .tp_basicsize = sizeof(NameTable),
+    .tp_dealloc = (destructor)NameTable_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+};
+
+static PyObject *wirec_build_table(PyObject *mod, PyObject *arg) {
+    /* arg: sequence of str node names in row order; fragments use
+     * json-exact escaping via json.dumps for non-ASCII-simple names */
+    PyObject *seq = PySequence_Fast(arg, "expected a sequence of names");
+    if (!seq) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    NameTable *t = PyObject_New(NameTable, &NameTable_Type);
+    if (!t) { Py_DECREF(seq); return NULL; }
+    t->n_rows = n;
+    t->slots = NULL;
+    t->name_bytes = NULL;
+    t->name_off = NULL;
+    t->frag_bytes = NULL;
+    t->frag_off = NULL;
+
+    uint32_t bits = 3;
+    while ((1u << bits) < (uint32_t)(n * 2 + 4)) bits++;
+    uint32_t size = 1u << bits;
+    t->mask = size - 1;
+    t->slots = PyMem_Calloc(size, sizeof(uint32_t));
+    t->name_off = PyMem_Malloc((n + 1) * sizeof(Py_ssize_t));
+    t->frag_off = PyMem_Malloc((n + 1) * sizeof(Py_ssize_t));
+    if (!t->slots || !t->name_off || !t->frag_off) {
+        PyErr_NoMemory();
+        goto error;
+    }
+
+    Buf names_buf, frag_buf;
+    if (buf_init(&names_buf, 64 * (n + 1)) < 0) { PyErr_NoMemory(); goto error; }
+    if (buf_init(&frag_buf, 96 * (n + 1)) < 0) {
+        buf_free(&names_buf);
+        PyErr_NoMemory();
+        goto error;
+    }
+
+    PyObject *json_mod = NULL;
+    for (Py_ssize_t row = 0; row < n; row++) {
+        PyObject *name = PySequence_Fast_GET_ITEM(seq, row);
+        Py_ssize_t nlen;
+        const char *ns = PyUnicode_AsUTF8AndSize(name, &nlen);
+        if (!ns) goto error_bufs;
+        t->name_off[row] = (Py_ssize_t)names_buf.len;
+        if (buf_put(&names_buf, ns, nlen) < 0) goto error_bufs;
+
+        /* fragment */
+        t->frag_off[row] = (Py_ssize_t)frag_buf.len;
+        int needs_escape = 0;
+        for (Py_ssize_t k = 0; k < nlen; k++) {
+            unsigned char c = (unsigned char)ns[k];
+            if (c == '"' || c == '\\' || c < 0x20 || c >= 0x7f) {
+                needs_escape = 1;
+                break;
+            }
+        }
+        if (buf_put(&frag_buf, "{\"Host\": ", 9) < 0) goto error_bufs;
+        if (!needs_escape) {
+            if (buf_put(&frag_buf, "\"", 1) < 0) goto error_bufs;
+            if (buf_put(&frag_buf, ns, nlen) < 0) goto error_bufs;
+            if (buf_put(&frag_buf, "\"", 1) < 0) goto error_bufs;
+        } else {
+            if (!json_mod) {
+                json_mod = PyImport_ImportModule("json");
+                if (!json_mod) goto error_bufs;
+            }
+            PyObject *enc = PyObject_CallMethod(json_mod, "dumps", "O", name);
+            if (!enc) goto error_bufs;
+            Py_ssize_t elen;
+            const char *es = PyUnicode_AsUTF8AndSize(enc, &elen);
+            if (!es || buf_put(&frag_buf, es, elen) < 0) {
+                Py_DECREF(enc);
+                goto error_bufs;
+            }
+            Py_DECREF(enc);
+        }
+        if (buf_put(&frag_buf, ", \"Score\": ", 11) < 0) goto error_bufs;
+    }
+    t->name_off[n] = (Py_ssize_t)names_buf.len;
+    t->frag_off[n] = (Py_ssize_t)frag_buf.len;
+    Py_XDECREF(json_mod);
+    json_mod = NULL;
+
+    t->name_bytes = names_buf.data;  /* ownership moves */
+    t->frag_bytes = frag_buf.data;
+
+    /* populate hash slots (first writer wins; duplicate names share the
+     * earlier row, which matches dict interning order semantics) */
+    for (Py_ssize_t row = 0; row < n; row++) {
+        Py_ssize_t off = t->name_off[row];
+        Py_ssize_t len = t->name_off[row + 1] - off;
+        uint64_t h = fnv1a(t->name_bytes + off, len);
+        uint32_t idx = (uint32_t)h & t->mask;
+        for (;;) {
+            if (t->slots[idx] == 0) {
+                t->slots[idx] = (uint32_t)(row + 1);
+                break;
+            }
+            Py_ssize_t prow = (Py_ssize_t)t->slots[idx] - 1;
+            Py_ssize_t poff = t->name_off[prow];
+            Py_ssize_t plen = t->name_off[prow + 1] - poff;
+            if (plen == len &&
+                memcmp(t->name_bytes + poff, t->name_bytes + off, len) == 0)
+                break;  /* duplicate name: keep first row */
+            idx = (idx + 1) & t->mask;
+        }
+    }
+    Py_DECREF(seq);
+    return (PyObject *)t;
+
+error_bufs:
+    Py_XDECREF(json_mod);
+    buf_free(&names_buf);
+    buf_free(&frag_buf);
+error:
+    Py_DECREF(seq);
+    Py_DECREF(t);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
+/* select_encode                                                       */
+
+static int put_score(Buf *b, long score) {
+    char tmp[16];
+    int len = snprintf(tmp, sizeof(tmp), "%ld}", score);
+    return buf_put(b, tmp, (size_t)len);
+}
+
+static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
+    PyObject *parsed_obj, *table_obj, *ranked_obj;
+    Py_ssize_t planned_row = -1;
+    if (!PyArg_ParseTuple(args, "OOO|n", &parsed_obj, &table_obj, &ranked_obj,
+                          &planned_row))
+        return NULL;
+    if (!PyObject_TypeCheck(parsed_obj, &ParsedArgs_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected ParsedArgs");
+        return NULL;
+    }
+    if (!PyObject_TypeCheck(table_obj, &NameTable_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected NameTable");
+        return NULL;
+    }
+    ParsedArgs *pa = (ParsedArgs *)parsed_obj;
+    NameTable *t = (NameTable *)table_obj;
+
+    Py_buffer ranked;
+    if (PyObject_GetBuffer(ranked_obj, &ranked, PyBUF_SIMPLE) < 0)
+        return NULL;
+    if (ranked.len % sizeof(int64_t) != 0) {
+        PyBuffer_Release(&ranked);
+        PyErr_SetString(PyExc_ValueError, "ranked must be int64 buffer");
+        return NULL;
+    }
+    const int64_t *order = (const int64_t *)ranked.buf;
+    Py_ssize_t n_ranked = ranked.len / sizeof(int64_t);
+
+    /* candidate mask over rows; escaped names (rare) resolve under the
+     * GIL first, everything else runs GIL-free below */
+    uint8_t *mask = calloc((size_t)t->n_rows + 1, 1);
+    if (!mask) {
+        PyBuffer_Release(&ranked);
+        return PyErr_NoMemory();
+    }
+    for (Py_ssize_t k = 0; k < pa->num_names; k++) {
+        StrSlice *sl = &pa->names[k];
+        if (sl->present && sl->escaped) {
+            PyObject *u = slice_to_unicode(pa->body, sl);
+            if (!u) goto error;
+            Py_ssize_t ulen;
+            const char *us = PyUnicode_AsUTF8AndSize(u, &ulen);
+            if (!us) { Py_DECREF(u); goto error; }
+            Py_ssize_t row = table_lookup(t, us, ulen);
+            Py_DECREF(u);
+            if (row >= 0) mask[row] = 1;
+        }
+    }
+
+    const char *body = PyBytes_AS_STRING(pa->body);
+    Buf out;
+    int oom = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t k = 0; k < pa->num_names; k++) {
+        StrSlice *sl = &pa->names[k];
+        if (!sl->present || sl->escaped) continue;
+        Py_ssize_t row = table_lookup(t, body + sl->off, sl->len);
+        if (row >= 0) mask[row] = 1;
+    }
+
+    /* size the output exactly: masked fragments + score/separator slack */
+    size_t est = 8;
+    for (Py_ssize_t row = 0; row < t->n_rows; row++)
+        if (mask[row])
+            est += (size_t)(t->frag_off[row + 1] - t->frag_off[row]) + 16;
+    if (buf_init(&out, est) < 0) oom = 1;
+
+    if (!oom) {
+        int promote = 0;
+        if (planned_row >= 0 && planned_row < t->n_rows && mask[planned_row]) {
+            /* planned node goes first iff it appears in the ranked order */
+            for (Py_ssize_t k = 0; k < n_ranked; k++) {
+                if (order[k] == planned_row) { promote = 1; break; }
+            }
+        }
+        long rank = 0;
+        int first = 1;
+        if (buf_put(&out, "[", 1) < 0) oom = 1;
+        if (!oom && promote) {
+            Py_ssize_t off = t->frag_off[planned_row];
+            if (buf_put(&out, t->frag_bytes + off,
+                        (size_t)(t->frag_off[planned_row + 1] - off)) < 0 ||
+                put_score(&out, 10) < 0)
+                oom = 1;
+            rank = 1;
+            first = 0;
+        }
+        for (Py_ssize_t k = 0; !oom && k < n_ranked; k++) {
+            int64_t row = order[k];
+            if (row < 0 || row >= t->n_rows || !mask[row]) continue;
+            if (promote && row == planned_row) continue;
+            if (!first && buf_put(&out, ", ", 2) < 0) { oom = 1; break; }
+            first = 0;
+            Py_ssize_t off = t->frag_off[row];
+            if (buf_put(&out, t->frag_bytes + off,
+                        (size_t)(t->frag_off[row + 1] - off)) < 0 ||
+                put_score(&out, 10 - rank) < 0) {
+                oom = 1;
+                break;
+            }
+            rank++;
+        }
+        if (!oom && buf_put(&out, "]\n", 2) < 0) oom = 1;
+    }
+    Py_END_ALLOW_THREADS
+
+    free(mask);
+    PyBuffer_Release(&ranked);
+    if (oom) {
+        buf_free(&out);
+        return PyErr_NoMemory();
+    }
+    PyObject *res = PyBytes_FromStringAndSize(out.data, (Py_ssize_t)out.len);
+    buf_free(&out);
+    return res;
+
+error:
+    free(mask);
+    PyBuffer_Release(&ranked);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
+
+static PyMethodDef wirec_methods[] = {
+    {"parse_prioritize", wirec_parse_prioritize, METH_O,
+     "Strict zero-copy scan of a scheduler-extender Args body."},
+    {"build_table", wirec_build_table, METH_O,
+     "Build a name->row table + response fragments for one state version."},
+    {"select_encode", wirec_select_encode, METH_VARARGS,
+     "Assemble the Prioritize response bytes from a parsed body, a name "
+     "table, and the global rank order (optional planned row promotion)."},
+    {NULL},
+};
+
+static struct PyModuleDef wirec_module = {
+    PyModuleDef_HEAD_INIT, "_wirec",
+    "Native wire-protocol fast path for the TPU scheduler extender.",
+    -1, wirec_methods,
+};
+
+PyMODINIT_FUNC PyInit__wirec(void) {
+    if (PyType_Ready(&ParsedArgs_Type) < 0) return NULL;
+    if (PyType_Ready(&NameTable_Type) < 0) return NULL;
+    return PyModule_Create(&wirec_module);
+}

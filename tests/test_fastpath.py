@@ -1,0 +1,187 @@
+"""PrioritizeFastPath: byte parity with the per-request paths, subset
+consistency against the per-request kernel, cache invalidation."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.extender.types import (
+    HostPriority,
+    encode_host_priority_list,
+)
+from platform_aware_scheduling_tpu.ops.scoring import prioritize_kernel
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.fastpath import PrioritizeFastPath
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
+from platform_aware_scheduling_tpu.testing.builders import make_policy, rule
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+
+def build(op="GreaterThan", values=None):
+    values = values or {"n1": 100, "n2": 50, "n3": 10, "n4": 70}
+    cache = AutoUpdatingCache()
+    mirror = TensorStateMirror()
+    mirror.attach(cache)
+    cache.write_policy(
+        "default",
+        "pol",
+        TASPolicy.from_obj(
+            make_policy("pol", strategies={"scheduleonmetric": [rule("m", op, 0)]})
+        ),
+    )
+    cache.write_metric(
+        "m", {n: NodeMetric(value=Quantity(str(v))) for n, v in values.items()}
+    )
+    return cache, mirror
+
+
+def prioritize_request(names, pod_name="p"):
+    return HTTPRequest(
+        method="POST",
+        path="/scheduler/prioritize",
+        headers={"Content-Type": "application/json"},
+        body=json.dumps(
+            {
+                "Pod": {
+                    "metadata": {
+                        "name": pod_name,
+                        "namespace": "default",
+                        "labels": {"telemetry-policy": "pol"},
+                    }
+                },
+                "Nodes": {"items": [{"metadata": {"name": n}} for n in names]},
+            }
+        ).encode(),
+    )
+
+
+class TestByteParity:
+    @pytest.mark.parametrize("op", ["GreaterThan", "LessThan"])
+    def test_device_bytes_equal_host_bytes(self, op):
+        """With distinct metric values the fast path emits byte-identical
+        output to the exact host path."""
+        cache, mirror = build(op=op)
+        device = MetricsExtender(cache, mirror=mirror)
+        host = MetricsExtender(cache, mirror=None)
+        for names in (
+            ["n1", "n2", "n3", "n4"],
+            ["n3", "n1"],
+            ["n2"],
+            ["n1", "ghost", "n4"],
+            ["ghost"],
+            [],
+        ):
+            req = prioritize_request(names)
+            out_device = device.prioritize(req)
+            out_host = host.prioritize(req)
+            assert out_device.body == out_host.body, (op, names)
+            assert out_device.status == out_host.status
+
+    def test_escaped_names_roundtrip(self):
+        """Node names needing JSON escaping encode exactly like json.dumps."""
+        cache, mirror = build(values={'we"ird\\name': 5, "plain": 3})
+        device = MetricsExtender(cache, mirror=mirror)
+        out = device.prioritize(prioritize_request(['we"ird\\name', "plain"]))
+        assert json.loads(out.body) == [
+            {"Host": 'we"ird\\name', "Score": 10},
+            {"Host": "plain", "Score": 9},
+        ]
+
+    def test_scores_go_negative_past_rank_10(self):
+        values = {f"n{i:03d}": 1000 - i for i in range(15)}
+        cache, mirror = build(values=values)
+        device = MetricsExtender(cache, mirror=mirror)
+        out = json.loads(
+            device.prioritize(prioritize_request(sorted(values))).body
+        )
+        assert [e["Score"] for e in out] == [10 - i for i in range(15)]
+
+
+class TestSubsetConsistency:
+    def test_subset_of_global_order_matches_per_request_kernel(self):
+        """Restricting the global ranking to a candidate set must equal
+        running the kernel with that candidate mask (incl. ties, which
+        break by node interning index)."""
+        rng = np.random.default_rng(7)
+        values = {f"n{i:04d}": int(rng.integers(0, 50)) for i in range(200)}
+        cache, mirror = build(values=values)
+        compiled, view = mirror.policy_with_view("default", "pol")
+        fast = PrioritizeFastPath()
+        for trial in range(5):
+            names = list(
+                rng.choice(sorted(values), size=60, replace=False)
+            )
+            body = fast.prioritize_bytes(compiled, view, names)
+            got = [e["Host"] for e in json.loads(body)]
+            mask_np = np.zeros(view.node_capacity, dtype=bool)
+            for n in names:
+                mask_np[view.node_index[n]] = True
+            res = prioritize_kernel(
+                view.values,
+                view.present,
+                jnp.int32(compiled.scheduleonmetric_row),
+                jnp.int32(compiled.scheduleonmetric_op),
+                jnp.asarray(mask_np),
+            )
+            perm = np.asarray(res.perm)[: int(res.valid_count)]
+            expected = [view.node_names[i] for i in perm]
+            assert got == expected
+
+
+class TestPlanPromotion:
+    def test_planned_node_promoted_to_rank_one(self):
+        cache, mirror = build()
+        compiled, view = mirror.policy_with_view("default", "pol")
+        fast = PrioritizeFastPath()
+        body = fast.prioritize_bytes(
+            compiled, view, ["n1", "n2", "n3"], planned="n3"
+        )
+        assert json.loads(body) == [
+            {"Host": "n3", "Score": 10},
+            {"Host": "n1", "Score": 9},
+            {"Host": "n2", "Score": 8},
+        ]
+
+    def test_planned_node_outside_candidates_ignored(self):
+        cache, mirror = build()
+        compiled, view = mirror.policy_with_view("default", "pol")
+        fast = PrioritizeFastPath()
+        body = fast.prioritize_bytes(
+            compiled, view, ["n1", "n2"], planned="n4"
+        )
+        assert [e["Host"] for e in json.loads(body)] == ["n1", "n2"]
+
+
+class TestCacheInvalidation:
+    def test_metric_update_invalidates_ranking(self):
+        cache, mirror = build()
+        device = MetricsExtender(cache, mirror=mirror)
+        req = prioritize_request(["n1", "n2", "n3"])
+        assert json.loads(device.prioritize(req).body)[0]["Host"] == "n1"
+        cache.write_metric(
+            "m",
+            {n: NodeMetric(value=Quantity(str(v)))
+             for n, v in {"n1": 1, "n2": 50, "n3": 10}.items()},
+        )
+        assert json.loads(device.prioritize(req).body)[0]["Host"] == "n2"
+
+    def test_rankings_cached_within_version(self):
+        cache, mirror = build()
+        fast = PrioritizeFastPath()
+        compiled, view = mirror.policy_with_view("default", "pol")
+        fast.prioritize_bytes(compiled, view, ["n1"])
+        key = (
+            view.version,
+            compiled.scheduleonmetric_row,
+            compiled.scheduleonmetric_op,
+        )
+        ranked = fast._rank[key]
+        fast.prioritize_bytes(compiled, view, ["n2", "n3"])
+        assert fast._rank[key] is ranked  # same array object, no recompute

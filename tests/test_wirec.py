@@ -1,0 +1,188 @@
+"""_wirec native wire path: byte parity with the pure-Python paths across
+request shapes, and scanner strictness (fallback on any surprise)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.native import get_wirec
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
+from platform_aware_scheduling_tpu.testing.builders import make_policy, rule
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+wirec = get_wirec()
+pytestmark = pytest.mark.skipif(
+    wirec is None, reason="no C toolchain for _wirec"
+)
+
+
+def build_extender(values=None, op="GreaterThan"):
+    values = values or {"n1": 100, "n2": 50, "n3": 10, "n4": 70}
+    cache = AutoUpdatingCache()
+    mirror = TensorStateMirror()
+    mirror.attach(cache)
+    cache.write_policy(
+        "default",
+        "pol",
+        TASPolicy.from_obj(
+            make_policy("pol", strategies={"scheduleonmetric": [rule("m", op, 0)]})
+        ),
+    )
+    cache.write_metric(
+        "m", {n: NodeMetric(value=Quantity(str(v))) for n, v in values.items()}
+    )
+    return MetricsExtender(cache, mirror=mirror)
+
+
+def request_from(body: bytes) -> HTTPRequest:
+    return HTTPRequest(
+        method="POST",
+        path="/scheduler/prioritize",
+        headers={"Content-Type": "application/json"},
+        body=body,
+    )
+
+
+def args_body(names, labels=None, pod_extra=None, namespace="default") -> bytes:
+    pod = {
+        "metadata": {"name": "p", "namespace": namespace},
+        "spec": {"containers": [{"name": "c", "resources": {}}]},
+    }
+    if labels is not None:
+        pod["metadata"]["labels"] = labels
+    if pod_extra:
+        pod.update(pod_extra)
+    return json.dumps(
+        {
+            "Pod": pod,
+            "Nodes": {"items": [{"metadata": {"name": n}} for n in names]},
+        }
+    ).encode()
+
+
+BODIES = [
+    args_body(["n1", "n2", "n3", "n4"], labels={"telemetry-policy": "pol"}),
+    args_body(["n3", "n1"], labels={"telemetry-policy": "pol"}),
+    args_body(["n1", "ghost", "n4"], labels={"telemetry-policy": "pol"}),
+    args_body(["n1"], labels=None),  # no labels at all -> 400 + []
+    args_body(["n1"], labels={"other": "x"}),  # label absent -> 400 + []
+    args_body(["n1"], labels={"telemetry-policy": "nope"}),  # unknown policy
+    args_body([], labels={"telemetry-policy": "pol"}),  # empty items
+    args_body(["n1", "n1", "n2"], labels={"telemetry-policy": "pol"}),  # dups
+    args_body(["n2"], labels={"telemetry-policy": "pol"}, namespace="other"),
+    # extra unknown fields everywhere; nested arrays/objects skipped
+    args_body(
+        ["n1", "n2"],
+        labels={"telemetry-policy": "pol", "zz": "y"},
+        pod_extra={"status": {"conditions": [{"a": [1, 2.5, -3e2, True, None]}]}},
+    ),
+    b'{"Pod": null, "Nodes": {"items": [{"metadata": {"name": "n1"}}]}}',
+    b'{"Nodes": {"items": [{"metadata": {"name": "n1"}}]}}',
+    b'{"Pod": {}, "Nodes": {"items": [{"spec": {}}]}}',  # node without name
+    b'{"Pod": {}, "Nodes": null}',
+    b'{"Pod": {}, "Nodes": {"items": null}}',
+    b'{"Pod": {}}',
+    b"",
+    b"not json",
+    b'[1, 2, 3]',
+    b'{"Pod": {"metadata": {"labels": {"telemetry-policy": "pol"}}}, "NodeNames": ["n1"]}',
+]
+
+
+class TestParityWithPython:
+    @pytest.mark.parametrize("body_idx", range(len(BODIES)))
+    def test_native_equals_python(self, body_idx, monkeypatch):
+        body = BODIES[body_idx]
+        ext = build_extender()
+        native = ext.prioritize(request_from(body))
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.prioritize(request_from(body))
+        assert native.status == python.status, body
+        assert native.body == python.body, body
+
+    def test_escaped_and_unicode_names(self, monkeypatch):
+        names = ['we"ird\\name', "uniécode", "plain", "tab\tname"]
+        values = {n: i + 1 for i, n in enumerate(names)}
+        ext = build_extender(values=values)
+        body = args_body(names, labels={"telemetry-policy": "pol"})
+        native = ext.prioritize(request_from(body))
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.prioritize(request_from(body))
+        assert native.body == python.body
+        assert json.loads(native.body)[0]["Host"] == "tab\tname"
+
+    def test_parity_at_scale_with_random_subsets(self, monkeypatch):
+        rng = np.random.default_rng(11)
+        names = [f"node-{i:04d}" for i in range(500)]
+        values = {n: int(rng.integers(0, 100)) for n in names}  # many ties
+        ext = build_extender(values=values)
+        for _ in range(5):
+            subset = list(rng.choice(names, size=120, replace=False))
+            body = args_body(subset, labels={"telemetry-policy": "pol"})
+            native = ext.prioritize(request_from(body))
+            monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+            python = ext.prioritize(request_from(body))
+            monkeypatch.delenv("PAS_TPU_NO_NATIVE")
+            assert native.body == python.body
+
+    def test_planned_promotion_parity(self, monkeypatch):
+        ext = build_extender()
+
+        class StubPlanner:
+            def planned_node(self, pod):
+                return "n3"
+
+        ext.planner = StubPlanner()
+        body = args_body(["n1", "n2", "n3"], labels={"telemetry-policy": "pol"})
+        native = ext.prioritize(request_from(body))
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.prioritize(request_from(body))
+        assert json.loads(native.body)[0]["Host"] == "n3"
+        assert native.body == python.body
+
+
+class TestScannerStrictness:
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            b'{"Pod": {,}}',
+            b'{"Pod": {}} trailing',
+            b'{"Pod": {"metadata": {"labels": {"telemetry-policy": 5}}}, "Nodes": {"items": []}}',
+            b'{"Nodes": {"items": [{}',
+            b'{"Nodes": {"items": 7}}',
+            b'{"a": 01}',
+            b'{"a": truthy}',
+            b'{"a": "\x01"}',
+        ],
+    )
+    def test_surprises_raise(self, bad):
+        with pytest.raises((ValueError, TypeError)):
+            wirec.parse_prioritize(bad)
+
+    def test_whitespace_tolerated(self):
+        body = b' \n\t{ "Pod" : { "metadata" : { "name" : "p" } } , "Nodes" : { "items" : [ { "metadata" : { "name" : "n1" } } ] } } \n'
+        parsed = wirec.parse_prioritize(body)
+        assert parsed.pod_name == "p"
+        assert parsed.node_names() == ["n1"]
+
+    def test_last_duplicate_key_wins(self):
+        body = (
+            b'{"Nodes": {"items": [{"metadata": {"name": "a"}}]},'
+            b' "Nodes": {"items": [{"metadata": {"name": "b"}}]}}'
+        )
+        parsed = wirec.parse_prioritize(body)
+        assert parsed.node_names() == ["b"]
+
+    def test_select_encode_empty_selection(self):
+        parsed = wirec.parse_prioritize(
+            b'{"Nodes": {"items": [{"metadata": {"name": "ghost"}}]}}'
+        )
+        table = wirec.build_table(["n1", "n2"])
+        ranked = np.array([0, 1], dtype=np.int64)
+        assert wirec.select_encode(parsed, table, ranked) == b"[]\n"
