@@ -86,7 +86,7 @@ typedef struct {
 typedef struct {
     Py_ssize_t off;   /* offset of first char INSIDE the quotes */
     Py_ssize_t len;   /* raw length inside the quotes */
-    int escaped;      /* contains backslash escapes */
+    int escaped;      /* contains backslash escapes (slow-path materialize) */
     int present;
 } StrSlice;
 
@@ -107,17 +107,39 @@ static int fail_raw(Scan *sc, const char *msg) {
 
 #define fail(msg) fail_raw(sc, msg)
 
-/* scan a JSON string starting at the opening quote; record the slice */
+/* scan a JSON string starting at the opening quote; record the slice.
+ *
+ * Escape sequences and UTF-8 well-formedness are validated HERE, exactly
+ * as strictly as json.loads over bytes (which UTF-8-decodes first): a body
+ * that json.loads would reject must fail the native parse too, so the
+ * exact Python path owns the response for it — never a silent divergence
+ * or a deferred exception at slice-materialization time. */
 static int scan_string(Scan *sc, StrSlice *out) {
     if (sc->i >= sc->n || sc->s[sc->i] != '"') return fail("expected string");
     sc->i++;
     Py_ssize_t start = sc->i;
     int escaped = 0;
     while (sc->i < sc->n) {
-        char c = sc->s[sc->i];
+        unsigned char c = (unsigned char)sc->s[sc->i];
         if (c == '\\') {
             escaped = 1;
-            sc->i += 2;
+            if (sc->i + 1 >= sc->n) return fail("bad escape");
+            char e = sc->s[sc->i + 1];
+            if (e == 'u') {
+                if (sc->i + 5 >= sc->n) return fail("bad \\u escape");
+                for (int k = 2; k <= 5; k++) {
+                    char h = sc->s[sc->i + k];
+                    if (!((h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
+                          (h >= 'A' && h <= 'F')))
+                        return fail("bad \\u escape");
+                }
+                sc->i += 6;
+            } else if (e == '"' || e == '\\' || e == '/' || e == 'b' ||
+                       e == 'f' || e == 'n' || e == 'r' || e == 't') {
+                sc->i += 2;
+            } else {
+                return fail("bad escape");
+            }
             continue;
         }
         if (c == '"') {
@@ -130,7 +152,38 @@ static int scan_string(Scan *sc, StrSlice *out) {
             sc->i++;
             return 0;
         }
-        if ((unsigned char)c < 0x20) return fail("control char in string");
+        if (c < 0x20) return fail("control char in string");
+        if (c >= 0x80) {
+            /* strict UTF-8: reject bad lead/continuation bytes, overlong
+             * forms, surrogates, and code points past U+10FFFF — the same
+             * set CPython's strict utf-8 decoder rejects */
+            const unsigned char *p = (const unsigned char *)sc->s + sc->i;
+            Py_ssize_t left = sc->n - sc->i;
+            int len;
+            if ((p[0] & 0xE0) == 0xC0) {
+                if (p[0] < 0xC2) return fail("invalid UTF-8");
+                len = 2;
+            } else if ((p[0] & 0xF0) == 0xE0) {
+                len = 3;
+            } else if ((p[0] & 0xF8) == 0xF0) {
+                if (p[0] > 0xF4) return fail("invalid UTF-8");
+                len = 4;
+            } else {
+                return fail("invalid UTF-8");
+            }
+            if (left < len) return fail("invalid UTF-8");
+            for (int k = 1; k < len; k++)
+                if ((p[k] & 0xC0) != 0x80) return fail("invalid UTF-8");
+            if (len == 3) {
+                if (p[0] == 0xE0 && p[1] < 0xA0) return fail("invalid UTF-8");
+                if (p[0] == 0xED && p[1] >= 0xA0) return fail("invalid UTF-8");
+            } else if (len == 4) {
+                if (p[0] == 0xF0 && p[1] < 0x90) return fail("invalid UTF-8");
+                if (p[0] == 0xF4 && p[1] >= 0x90) return fail("invalid UTF-8");
+            }
+            sc->i += len;
+            continue;
+        }
         sc->i++;
     }
     return fail("unterminated string");
@@ -324,6 +377,12 @@ static PyTypeObject ParsedArgs_Type = {
 static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
     skip_ws(sc);
     if (sc->i >= sc->n) return fail("eof in metadata");
+    /* duplicate "metadata" keys: last wins like json.loads — the new value
+     * (object or null) fully replaces fields from an earlier occurrence */
+    memset(&pa->pod_name, 0, sizeof(StrSlice));
+    memset(&pa->pod_namespace, 0, sizeof(StrSlice));
+    memset(&pa->policy_label, 0, sizeof(StrSlice));
+    pa->has_label = 0;
     if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
     if (sc->s[sc->i] != '{') return fail("metadata not object");
     sc->i++;
@@ -333,22 +392,33 @@ static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
         skip_ws(sc);
         StrSlice key;
         if (scan_string(sc, &key) < 0) return -1;
+        if (key.escaped) return fail("escaped key");
         skip_ws(sc);
         if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
         sc->i++;
         skip_ws(sc);
         const char *kp = sc->s + key.off;
-        if (!key.escaped && key.len == 4 && memcmp(kp, "name", 4) == 0) {
+        if (key.len == 4 && memcmp(kp, "name", 4) == 0) {
             if (sc->i < sc->n && sc->s[sc->i] == '"') {
                 if (scan_string(sc, &pa->pod_name) < 0) return -1;
-            } else if (skip_value(sc) < 0) return -1;
-        } else if (!key.escaped && key.len == 9 &&
-                   memcmp(kp, "namespace", 9) == 0) {
+            } else {
+                /* last wins: a repeated key with a non-string value
+                 * replaces (clears) an earlier captured string */
+                memset(&pa->pod_name, 0, sizeof(StrSlice));
+                if (skip_value(sc) < 0) return -1;
+            }
+        } else if (key.len == 9 && memcmp(kp, "namespace", 9) == 0) {
             if (sc->i < sc->n && sc->s[sc->i] == '"') {
                 if (scan_string(sc, &pa->pod_namespace) < 0) return -1;
-            } else if (skip_value(sc) < 0) return -1;
-        } else if (!key.escaped && key.len == 6 && memcmp(kp, "labels", 6) == 0) {
-            /* scan the labels object for "telemetry-policy" */
+            } else {
+                memset(&pa->pod_namespace, 0, sizeof(StrSlice));
+                if (skip_value(sc) < 0) return -1;
+            }
+        } else if (key.len == 6 && memcmp(kp, "labels", 6) == 0) {
+            /* scan the labels object for "telemetry-policy"; a repeated
+             * "labels" key replaces any label from an earlier occurrence */
+            memset(&pa->policy_label, 0, sizeof(StrSlice));
+            pa->has_label = 0;
             skip_ws(sc);
             if (sc->i < sc->n && sc->s[sc->i] == '{') {
                 sc->i++;
@@ -358,12 +428,13 @@ static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
                     skip_ws(sc);
                     StrSlice lkey;
                     if (scan_string(sc, &lkey) < 0) return -1;
+                    if (lkey.escaped) return fail("escaped key");
                     skip_ws(sc);
                     if (sc->i >= sc->n || sc->s[sc->i] != ':')
                         return fail("expected ':'");
                     sc->i++;
                     skip_ws(sc);
-                    if (!lkey.escaped && lkey.len == 16 &&
+                    if (lkey.len == 16 &&
                         memcmp(sc->s + lkey.off, "telemetry-policy", 16) == 0) {
                         /* non-string label values take the exact Python
                          * path (status-code parity on absurd input) */
@@ -393,6 +464,12 @@ static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
 static int scan_pod(Scan *sc, ParsedArgs *pa) {
     skip_ws(sc);
     if (sc->i >= sc->n) return fail("eof in Pod");
+    /* duplicate top-level "Pod" keys: last wins like json.loads (mirrors
+     * the "Nodes" reset in wirec_parse_prioritize) */
+    memset(&pa->pod_name, 0, sizeof(StrSlice));
+    memset(&pa->pod_namespace, 0, sizeof(StrSlice));
+    memset(&pa->policy_label, 0, sizeof(StrSlice));
+    pa->has_label = 0;
     if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
     if (sc->s[sc->i] != '{') return fail("Pod not object");
     sc->i++;
@@ -402,10 +479,11 @@ static int scan_pod(Scan *sc, ParsedArgs *pa) {
         skip_ws(sc);
         StrSlice key;
         if (scan_string(sc, &key) < 0) return -1;
+        if (key.escaped) return fail("escaped key");
         skip_ws(sc);
         if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
         sc->i++;
-        if (!key.escaped && key.len == 8 &&
+        if (key.len == 8 &&
             memcmp(sc->s + key.off, "metadata", 8) == 0) {
             if (scan_pod_metadata(sc, pa) < 0) return -1;
         } else {
@@ -444,13 +522,17 @@ static int scan_node_item(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
         skip_ws(sc);
         StrSlice key;
         if (scan_string(sc, &key) < 0) return -1;
+        if (key.escaped) return fail("escaped key");
         skip_ws(sc);
         if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
         sc->i++;
-        if (!key.escaped && key.len == 8 &&
+        if (key.len == 8 &&
             memcmp(sc->s + key.off, "metadata", 8) == 0) {
             skip_ws(sc);
             if (sc->i >= sc->n) return fail("eof in node metadata");
+            /* repeated "metadata" key: last wins — the new value replaces
+             * any name captured from an earlier occurrence */
+            memset(&name, 0, sizeof(StrSlice));
             if (sc->s[sc->i] == '{') {
                 sc->i++;
                 skip_ws(sc);
@@ -459,15 +541,20 @@ static int scan_node_item(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
                     skip_ws(sc);
                     StrSlice mkey;
                     if (scan_string(sc, &mkey) < 0) return -1;
+                    if (mkey.escaped) return fail("escaped key");
                     skip_ws(sc);
                     if (sc->i >= sc->n || sc->s[sc->i] != ':')
                         return fail("expected ':'");
                     sc->i++;
                     skip_ws(sc);
-                    if (!mkey.escaped && mkey.len == 4 &&
-                        memcmp(sc->s + mkey.off, "name", 4) == 0 &&
-                        sc->i < sc->n && sc->s[sc->i] == '"') {
-                        if (scan_string(sc, &name) < 0) return -1;
+                    if (mkey.len == 4 &&
+                        memcmp(sc->s + mkey.off, "name", 4) == 0) {
+                        if (sc->i < sc->n && sc->s[sc->i] == '"') {
+                            if (scan_string(sc, &name) < 0) return -1;
+                        } else {
+                            memset(&name, 0, sizeof(StrSlice));
+                            if (skip_value(sc) < 0) return -1;
+                        }
                     } else if (skip_value(sc) < 0) return -1;
                     skip_ws(sc);
                     if (sc->i >= sc->n) return fail("unterminated node metadata");
@@ -502,15 +589,17 @@ static int scan_nodes(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
         skip_ws(sc);
         StrSlice key;
         if (scan_string(sc, &key) < 0) return -1;
+        if (key.escaped) return fail("escaped key");
         skip_ws(sc);
         if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
         sc->i++;
-        if (!key.escaped && key.len == 5 &&
+        if (key.len == 5 &&
             memcmp(sc->s + key.off, "items", 5) == 0) {
             skip_ws(sc);
             if (sc->i < sc->n && sc->s[sc->i] == 'n') {
                 if (skip_literal(sc, "null", 4) < 0) return -1;
                 pa->nodes_present = 1;  /* Nodes object exists, items null */
+                pa->num_names = 0;      /* last-wins: null replaces any array */
             } else if (sc->i < sc->n && sc->s[sc->i] == '[') {
                 pa->nodes_present = 1;
                 /* duplicate "items" keys: last wins like json.loads */
@@ -576,6 +665,7 @@ static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
             skip_ws(sc);
             StrSlice key;
             if (scan_string(sc, &key) < 0) { ok = 0; break; }
+            if (key.escaped) { fail("escaped key"); ok = 0; break; }
             skip_ws(sc);
             if (sc->i >= sc->n || sc->s[sc->i] != ':') {
                 fail("expected ':'");
@@ -585,10 +675,10 @@ static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
             sc->i++;
             const char *kp = sc->s + key.off;
             int handled = 0;
-            if (!key.escaped && key.len == 3 && memcmp(kp, "Pod", 3) == 0) {
+            if (key.len == 3 && memcmp(kp, "Pod", 3) == 0) {
                 if (scan_pod(sc, pa) < 0) { ok = 0; break; }
                 handled = 1;
-            } else if (!key.escaped && key.len == 5 &&
+            } else if (key.len == 5 &&
                        memcmp(kp, "Nodes", 5) == 0) {
                 pa->nodes_present = 0;
                 pa->num_names = 0;
@@ -637,9 +727,11 @@ typedef struct {
 
 static void NameTable_dealloc(NameTable *self) {
     PyMem_Free(self->slots);
-    PyMem_Free(self->name_bytes);
+    /* name_bytes/frag_bytes are Buf storage (malloc) — free with free();
+     * mixing allocators is undefined behavior under PYTHONMALLOC=debug */
+    free(self->name_bytes);
     PyMem_Free(self->name_off);
-    PyMem_Free(self->frag_bytes);
+    free(self->frag_bytes);
     PyMem_Free(self->frag_off);
     Py_TYPE(self)->tp_free((PyObject *)self);
 }
@@ -798,8 +890,10 @@ error:
 /* select_encode                                                       */
 
 static int put_score(Buf *b, long score) {
-    char tmp[16];
+    char tmp[24];
     int len = snprintf(tmp, sizeof(tmp), "%ld}", score);
+    if (len < 0) return -1;
+    if (len >= (int)sizeof(tmp)) len = (int)sizeof(tmp) - 1;  /* truncated */
     return buf_put(b, tmp, (size_t)len);
 }
 

@@ -135,16 +135,32 @@ class MetricsExtender:
         """Serve Prioritize through the _wirec zero-copy scanner when the
         body has the common well-formed shape; None -> exact Python path
         (which owns every decode-failure/empty-list wire quirk).  Byte
-        parity between the two is pinned by tests/test_wirec.py."""
+        parity between the two is pinned by tests/test_wirec.py.
+
+        The whole native body is guarded by ValueError (which covers
+        JSONDecodeError, UnicodeDecodeError, and UnicodeEncodeError): the
+        scanner validates escapes/UTF-8 at parse time (wirec.c
+        scan_string), so most malformed bodies fail the parse up front —
+        but slice materialization can still raise on inputs the scan
+        cannot reject, e.g. a ``\\u``-escaped lone surrogate whose
+        materialized str cannot UTF-8-encode for the name-table lookup.
+        Either way the request must fall back to the exact path, never
+        drop the connection (round-2 advisor finding)."""
         if self.fastpath is None:
             return None
         wirec = get_wirec()
         if wirec is None:
             return None
         try:
-            parsed = wirec.parse_prioritize(request.body)
+            return self._prioritize_native_inner(wirec, request)
         except (ValueError, TypeError):
             return None
+
+    def _prioritize_native_inner(
+        self, wirec, request: HTTPRequest
+    ) -> Optional[HTTPResponse]:
+        # parse errors (ValueError/TypeError) propagate to the outer guard
+        parsed = wirec.parse_prioritize(request.body)
         if not parsed.nodes_present or parsed.num_nodes == 0:
             return None  # empty-200 quirks belong to the exact path
         status = 200

@@ -186,3 +186,235 @@ class TestScannerStrictness:
         table = wirec.build_table(["n1", "n2"])
         ranked = np.array([0, 1], dtype=np.int64)
         assert wirec.select_encode(parsed, table, ranked) == b"[]\n"
+
+
+class TestAdvisorFindings:
+    """Round-2 advisor findings: malformed-string fallback, duplicate-key
+    last-wins for Pod/metadata/labels, allocator hygiene."""
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            # invalid JSON escape inside the policy label value
+            b'{"Pod": {"metadata": {"namespace": "default", "labels": '
+            b'{"telemetry-policy": "\\q"}}}, '
+            b'"Nodes": {"items": [{"metadata": {"name": "n1"}}]}}',
+            # invalid UTF-8 inside the policy label value
+            b'{"Pod": {"metadata": {"namespace": "default", "labels": '
+            b'{"telemetry-policy": "\xff\xfe"}}}, '
+            b'"Nodes": {"items": [{"metadata": {"name": "n1"}}]}}',
+            # invalid UTF-8 inside a node name
+            b'{"Pod": {"metadata": {"namespace": "default", "labels": '
+            b'{"telemetry-policy": "pol"}}}, '
+            b'"Nodes": {"items": [{"metadata": {"name": "n\xff1"}}]}}',
+            # invalid escape inside the pod namespace
+            b'{"Pod": {"metadata": {"namespace": "\\z", "labels": '
+            b'{"telemetry-policy": "pol"}}}, '
+            b'"Nodes": {"items": [{"metadata": {"name": "n1"}}]}}',
+        ],
+    )
+    def test_malformed_string_bodies_answer_like_python(self, body, monkeypatch):
+        # the verb must produce the same response as the exact Python path
+        # (json.loads rejects these bodies -> empty 200), never an unhandled
+        # exception / dropped connection
+        ext = build_extender()
+        native = ext.prioritize(request_from(body))
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.prioritize(request_from(body))
+        assert native.status == python.status
+        assert native.body == python.body
+
+    def test_duplicate_pod_key_last_wins(self):
+        body = (
+            b'{"Pod": {"metadata": {"name": "first", "namespace": "ns1", '
+            b'"labels": {"telemetry-policy": "pol"}}}, '
+            b'"Pod": {"metadata": {"name": "second"}}, '
+            b'"Nodes": {"items": []}}'
+        )
+        parsed = wirec.parse_prioritize(body)
+        obj = json.loads(body)  # python dict building is also last-wins
+        assert parsed.pod_name == obj["Pod"]["metadata"]["name"] == "second"
+        assert parsed.pod_namespace is None
+        assert parsed.policy_label is None
+
+    def test_duplicate_metadata_key_last_wins(self):
+        body = (
+            b'{"Pod": {"metadata": {"name": "first", '
+            b'"labels": {"telemetry-policy": "pol"}}, '
+            b'"metadata": {"namespace": "ns2"}}, "Nodes": {"items": []}}'
+        )
+        parsed = wirec.parse_prioritize(body)
+        assert parsed.pod_name is None
+        assert parsed.pod_namespace == "ns2"
+        assert parsed.policy_label is None
+
+    def test_duplicate_labels_key_last_wins(self):
+        body = (
+            b'{"Pod": {"metadata": {"labels": {"telemetry-policy": "old"}, '
+            b'"labels": {"other": "x"}}}, "Nodes": {"items": []}}'
+        )
+        parsed = wirec.parse_prioritize(body)
+        assert parsed.policy_label is None
+        body2 = (
+            b'{"Pod": {"metadata": {"labels": {"other": "x"}, '
+            b'"labels": {"telemetry-policy": "new"}}}, "Nodes": {"items": []}}'
+        )
+        assert wirec.parse_prioritize(body2).policy_label == "new"
+
+    def test_pod_null_after_object_clears_fields(self):
+        body = (
+            b'{"Pod": {"metadata": {"name": "first", '
+            b'"labels": {"telemetry-policy": "pol"}}}, '
+            b'"Pod": null, "Nodes": {"items": []}}'
+        )
+        parsed = wirec.parse_prioritize(body)
+        assert parsed.pod_name is None
+        assert parsed.policy_label is None
+
+    def test_allocator_hygiene_under_debug_malloc(self):
+        # NameTable mixes Buf (malloc) and PyMem storage; the dealloc must
+        # free each with the matching allocator or PYTHONMALLOC=debug aborts
+        import os
+        import subprocess
+        import sys
+
+        code = (
+            "from platform_aware_scheduling_tpu.native import get_wirec\n"
+            "w = get_wirec()\n"
+            "assert w is not None\n"
+            "import numpy as np\n"
+            "for _ in range(3):\n"
+            "    t = w.build_table(['n%d' % i for i in range(500)])\n"
+            "    p = w.parse_prioritize(b'{\"Nodes\": {\"items\": "
+            "[{\"metadata\": {\"name\": \"n1\"}}]}}')\n"
+            "    w.select_encode(p, t, np.arange(500, dtype=np.int64))\n"
+            "    del t, p\n"
+            "print('OK')\n"
+        )
+        env = dict(os.environ, PYTHONMALLOC="debug")
+        env.pop("PAS_TPU_NO_NATIVE", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "OK" in proc.stdout
+
+    def test_items_null_after_array_last_wins(self, monkeypatch):
+        # {"items": [...], "items": null} -> json.loads keeps null; the
+        # native parse must agree (and the verb must match the exact path)
+        body = (
+            b'{"Pod": {"metadata": {"namespace": "default", "labels": '
+            b'{"telemetry-policy": "pol"}}}, '
+            b'"Nodes": {"items": [{"metadata": {"name": "n1"}}], '
+            b'"items": null}}'
+        )
+        parsed = wirec.parse_prioritize(body)
+        assert parsed.num_nodes == 0
+        ext = build_extender()
+        native = ext.prioritize(request_from(body))
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.prioritize(request_from(body))
+        assert native.status == python.status
+        assert native.body == python.body
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            # \u-escaped "Pod" alias: last-wins would pick the second, the
+            # scanner cannot see that -> must fail and fall back
+            b'{"Pod": {"metadata": {"name": "a"}}, '
+            b'"\\u0050od": {"metadata": {"name": "b"}}, "Nodes": {"items": []}}',
+            # escaped "metadata" inside Pod
+            b'{"Pod": {"\\u006detadata": {"name": "x"}}, "Nodes": {"items": []}}',
+            # escaped "items" inside Nodes
+            b'{"Nodes": {"\\u0069tems": [{"metadata": {"name": "n"}}]}}',
+        ],
+    )
+    def test_escaped_keys_fail_parse(self, body):
+        with pytest.raises(ValueError):
+            wirec.parse_prioritize(body)
+
+    def test_scalar_key_last_wins_non_string(self, monkeypatch):
+        # {"namespace": "default", "namespace": null}: json.loads keeps
+        # null; the native parse must clear the earlier slice (and the verb
+        # must answer exactly like the Python path, which misses the policy)
+        body = (
+            b'{"Pod": {"metadata": {"namespace": "default", "namespace": null, '
+            b'"labels": {"telemetry-policy": "pol"}}}, '
+            b'"Nodes": {"items": [{"metadata": {"name": "n1"}}]}}'
+        )
+        assert wirec.parse_prioritize(body).pod_namespace is None
+        ext = build_extender()
+        native = ext.prioritize(request_from(body))
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.prioritize(request_from(body))
+        assert native.status == python.status
+        assert native.body == python.body
+
+    def test_duplicate_node_metadata_last_wins(self):
+        body = (
+            b'{"Nodes": {"items": [{"metadata": {"name": "n1"}, '
+            b'"metadata": {}}]}}'
+        )
+        parsed = wirec.parse_prioritize(body)
+        # last-wins: the second metadata object has no name (None, the
+        # same encoding scan_node_item uses for a missing name)
+        assert parsed.node_names() == [None]
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            b'{"a": "\\q"}',          # invalid escape
+            b'{"a": "\\u12zz"}',      # bad \u hex
+            b'{"a": "\xff"}',         # invalid UTF-8 lead byte
+            b'{"a": "\xc0\xaf"}',     # overlong encoding
+            b'{"a": "\xf5\x80\x80\x80"}',  # > U+10FFFF
+            b'{"a": "\xc3"}',         # truncated sequence at end of string
+        ],
+    )
+    def test_strings_validated_like_json_loads(self, bad):
+        # every body here is also rejected by json.loads on bytes
+        with pytest.raises(ValueError):
+            json.loads(bad)
+        with pytest.raises(ValueError):
+            wirec.parse_prioritize(bad)
+
+    def test_valid_unicode_zero_copy(self):
+        # valid non-ASCII stays on the zero-copy path (escaped=0) and
+        # round-trips through name lookup byte-exactly
+        name = "nodé-ü"
+        body = json.dumps(
+            {"Nodes": {"items": [{"metadata": {"name": name}}]}},
+            ensure_ascii=False,
+        ).encode()
+        parsed = wirec.parse_prioritize(body)
+        assert parsed.node_names() == [name]
+        table = wirec.build_table([name])
+        out = wirec.select_encode(parsed, table, np.array([0], dtype=np.int64))
+        assert json.loads(out) == [{"Host": name, "Score": 10}]
+
+    def test_surrogate_bytes_fall_back_with_parity(self, monkeypatch):
+        # json.loads(bytes) decodes with surrogatepass, so a UTF-8-encoded
+        # lone surrogate is ACCEPTED by the Python path; the scanner
+        # rejects it (-> fallback), which is parity-safe because the exact
+        # path then owns the whole answer
+        body = (
+            b'{"Pod": {"metadata": {"namespace": "default", "labels": '
+            b'{"telemetry-policy": "pol"}}}, '
+            b'"Nodes": {"items": [{"metadata": {"name": "n1"}}, '
+            b'{"metadata": {"name": "s\xed\xa0\x80x"}}]}}'
+        )
+        json.loads(body)  # accepted by the Python decoder
+        with pytest.raises(ValueError):
+            wirec.parse_prioritize(body)
+        ext = build_extender()
+        native = ext.prioritize(request_from(body))
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.prioritize(request_from(body))
+        assert native.status == python.status
+        assert native.body == python.body
