@@ -32,10 +32,13 @@ _module = None
 
 
 def _so_path() -> str:
-    """Build artifact path keyed by source content hash."""
+    """Build artifact path keyed by source content hash AND the
+    interpreter ABI — a checkout shared between Python versions must not
+    load an extension compiled against another interpreter's headers."""
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(_DIR, f"_wirec-{digest}.so")
+    soabi = sysconfig.get_config_var("SOABI") or "unknown-abi"
+    return os.path.join(_DIR, f"_wirec-{digest}-{soabi}.so")
 
 
 def _build(so_path: str) -> bool:

@@ -89,7 +89,20 @@ class CompiledPolicy:
 
 class DeviceView:
     """An immutable snapshot handed to kernels: the split metric matrix, the
-    presence mask, and the interning tables it was built against."""
+    presence mask, and the interning tables it was built against.
+
+    Besides the global ``version``, the view carries fine-grained change
+    counters so per-version caches invalidate only what actually changed
+    under metric churn (every sync period rewrites every metric,
+    autoupdating.go:37-59):
+
+      * ``row_versions[r]`` bumps only when metric row ``r``'s content
+        changes — a ranking for (row, op) stays valid across other rows'
+        updates;
+      * ``intern_version`` bumps only when the node interning (and thus
+        the name list / response fragments) changes — the encode table
+        survives pure value churn.
+    """
 
     def __init__(
         self,
@@ -98,12 +111,19 @@ class DeviceView:
         node_names: List[str],
         node_index: Dict[str, int],
         version: int,
+        row_versions: Tuple[int, ...] = (),
+        intern_version: int = 0,
     ):
         self.values = values
         self.present = present
         self.node_names = node_names
         self.node_index = node_index
         self.version = version
+        self.row_versions = row_versions
+        self.intern_version = intern_version
+
+    def row_version(self, row: int) -> int:
+        return self.row_versions[row] if row < len(self.row_versions) else 0
 
     @property
     def node_capacity(self) -> int:
@@ -140,6 +160,9 @@ class TensorStateMirror:
         self._free_metric_rows: List[int] = []
         self._values = np.zeros((metric_capacity, node_capacity), dtype=np.int64)
         self._present = np.zeros((metric_capacity, node_capacity), dtype=bool)
+        # fine-grained change counters (see DeviceView doc)
+        self._row_versions: Dict[int, int] = {}
+        self._intern_version = 0
         self._host_only_metrics: Dict[str, bool] = {}
         self._policies: Dict[Tuple[str, str], CompiledPolicy] = {}
         # sources kept so policies can be recompiled when a freed metric row
@@ -150,6 +173,12 @@ class TensorStateMirror:
         # metric-matrix re-upload
         self._version = 0
         self._view: Optional[DeviceView] = None
+        # post-publish callbacks, fired OUTSIDE the lock after a mutation
+        # that changed the device snapshot or the compiled-policy set; the
+        # extender's fastpath warmer subscribes here so the device ranking
+        # pass runs in the state-refresh thread, never on a request
+        # (reference refresh loop: cmd/main.go:76-78)
+        self.on_state_change: List = []
 
     # -- wiring ---------------------------------------------------------------
 
@@ -177,6 +206,7 @@ class TensorStateMirror:
             )
         self._node_index[name] = row
         self._node_names.append(name)
+        self._intern_version += 1
         return row
 
     def _intern_metric(self, name: str) -> int:
@@ -198,20 +228,38 @@ class TensorStateMirror:
         self._metric_index[name] = row
         self._values[row, :] = 0
         self._present[row, :] = False
+        self._row_versions[row] = self._row_versions.get(row, 0) + 1
         return row
 
     # -- cache hooks ----------------------------------------------------------
 
+    def _notify(self) -> None:
+        """Run the post-publish callbacks; never let a subscriber break the
+        writer (the cache refresh loop must keep ticking)."""
+        for callback in list(self.on_state_change):
+            try:
+                callback()
+            except Exception:  # noqa: BLE001 — subscriber errors are theirs
+                from platform_aware_scheduling_tpu.utils import klog
+
+                klog.error("state-change subscriber failed", exc_info=True)
+
     def on_metric_write(self, metric_name: str, info) -> None:
         """info: NodeMetricsInfo (node -> NodeMetric) or None (registration
         only, autoupdating.go:105-122)."""
+        changed = self._metric_write_locked(metric_name, info)
+        if changed:
+            self._notify()
+
+    def _metric_write_locked(self, metric_name: str, info) -> bool:
         with self._lock:
             shape_before = self._values.shape
             row = self._intern_metric(metric_name)
             if info is None:
                 if self._values.shape != shape_before:
                     self._version += 1
-                return
+                    return True
+                return False
             # stage the new row, then bump the version only on real change:
             # the periodic refresh re-writes every metric each sync period
             # (autoupdating.go:37-59) and steady-state values must not
@@ -240,20 +288,27 @@ class TensorStateMirror:
                 self._values[row] = new_values
                 self._present[row] = new_present
                 self._version += 1
+                self._row_versions[row] = self._row_versions.get(row, 0) + 1
+            return changed
 
     def on_metric_delete(self, metric_name: str) -> None:
+        deleted = False
         with self._lock:
             row = self._metric_index.pop(metric_name, None)
             self._host_only_metrics.pop(metric_name, None)
             if row is not None:
+                deleted = True
                 self._present[row, :] = False
                 self._free_metric_rows.append(row)
                 self._version += 1
+                self._row_versions[row] = self._row_versions.get(row, 0) + 1
                 # compiled rule tensors may reference the freed row; if it is
                 # later reused for another metric they would silently read the
                 # wrong values — recompile every policy against live rows
                 for key, source in self._policy_sources.items():
                     self._policies[key] = self._compile_policy(source)
+        if deleted:
+            self._notify()
 
     def on_policy_write(self, namespace: str, name: str, policy: TASPolicy) -> None:
         with self._lock:
@@ -262,6 +317,9 @@ class TensorStateMirror:
             self._policies[(namespace, name)] = self._compile_policy(policy)
             if self._values.shape != shape_before:  # rule interned a new metric
                 self._version += 1
+        # fire even without a version bump: a new policy can introduce new
+        # (metric row, op) pairs that need warming at the current version
+        self._notify()
 
     def on_policy_delete(self, namespace: str, name: str) -> None:
         with self._lock:
@@ -366,6 +424,19 @@ class TensorStateMirror:
             )
             return policies, self._view_locked(), host_only
 
+    def policies_snapshot(
+        self,
+    ) -> Tuple[List[CompiledPolicy], DeviceView, Dict[str, bool]]:
+        """Atomic (all compiled policies, view, host-only metric map) under
+        one lock acquisition — for the fastpath warmer, which must see a
+        policy set consistent with the view it precomputes against."""
+        with self._lock:
+            return (
+                list(self._policies.values()),
+                self._view_locked(),
+                dict(self._host_only_metrics),
+            )
+
     def policy_with_view(
         self, namespace: str, name: str
     ) -> Tuple[Optional[CompiledPolicy], DeviceView]:
@@ -380,11 +451,16 @@ class TensorStateMirror:
         if self._view is not None and self._view.version == self._version:
             return self._view
         hi, lo = i64.split_int64_np(self._values)
+        rows = self._values.shape[0]
         self._view = DeviceView(
             values=i64.I64(hi=jnp.asarray(hi), lo=jnp.asarray(lo)),
             present=jnp.asarray(self._present.copy()),
             node_names=list(self._node_names),
             node_index=dict(self._node_index),
             version=self._version,
+            row_versions=tuple(
+                self._row_versions.get(r, 0) for r in range(rows)
+            ),
+            intern_version=self._intern_version,
         )
         return self._view

@@ -55,8 +55,11 @@ def _score_suffixes(n: int) -> List[bytes]:
 
 
 class _ViewTable:
-    """Per-view-version request-time tables: name->row index, pre-rendered
-    JSON fragments (Python path), and the native NameTable (_wirec path).
+    """Per-interning-version request-time tables: name->row index,
+    pre-rendered JSON fragments (Python path), and the native NameTable
+    (_wirec path).  Keyed by the view's ``intern_version`` — pure metric
+    value churn does not invalidate name tables/fragments, so the encode
+    table survives every sync period until a new node actually appears.
     Both table kinds build lazily — only the serving variant in use pays."""
 
     __slots__ = (
@@ -69,7 +72,7 @@ class _ViewTable:
     )
 
     def __init__(self, view: DeviceView):
-        self.version = view.version
+        self.version = view.intern_version
         self.node_index = view.node_index  # immutable snapshot dict
         self.node_names = view.node_names
         self.node_capacity = view.node_capacity
@@ -103,40 +106,42 @@ class PrioritizeFastPath:
     def __init__(self):
         self._lock = threading.Lock()
         self._table: Optional[_ViewTable] = None
-        # (version, metric_row, op) -> int32 np [valid_count] global order
+        # (row_content_version, metric_row, op) -> int64 np global order
         self._rank: Dict[Tuple[int, int, int], np.ndarray] = {}
-        # (version, ruleset signature) -> frozenset of violating row indices
+        # (row-version tuple, rows, ruleset tensors) -> frozenset of
+        # violating row indices
         self._violations: Dict[Tuple, frozenset] = {}
 
     # -- table/cache maintenance ----------------------------------------------
 
     def _table_for(self, view: DeviceView) -> _ViewTable:
+        """The encode table for this view's interning.  Forward-only: a
+        stale in-flight request (view older than the installed table) gets
+        a throwaway table and must never displace the warmed current one
+        — otherwise one slow request would make the next request pay the
+        rebuild the warmer already did."""
         table = self._table
-        if table is None or table.version != view.version:
-            table = _ViewTable(view)
-            with self._lock:
-                if self._table is None or self._table.version != view.version:
-                    self._table = table
-                    # rankings/violations of older versions are dead weight
-                    self._rank = {
-                        k: v for k, v in self._rank.items() if k[0] == view.version
-                    }
-                    self._violations = {
-                        k: v
-                        for k, v in self._violations.items()
-                        if k[0] == view.version
-                    }
-                else:
-                    table = self._table
-        return table
+        if table is not None and table.version == view.intern_version:
+            return table
+        if table is not None and view.intern_version < table.version:
+            return _ViewTable(view)
+        with self._lock:
+            current = self._table
+            if current is None or current.version < view.intern_version:
+                current = _ViewTable(view)
+                self._table = current
+            elif current.version > view.intern_version:  # raced past us
+                return _ViewTable(view)
+            return current
 
     def _ranking(self, view: DeviceView, row: int, op: int) -> np.ndarray:
-        key = (view.version, row, op)
+        # keyed by the ROW's content version: metric churn on other rows
+        # (or node interning alone) leaves this ranking valid
+        key = (view.row_version(row), row, op)
         ranked = self._rank.get(key)
         if ranked is None:
-            # ONE device pass ranks all nodes; every request until the next
-            # state change reuses it (the recompute runs at most once per
-            # version per rule — off the steady-state request path)
+            # ONE device pass ranks all nodes; every request until this
+            # row's next content change reuses it
             res = prioritize_kernel(
                 view.values,
                 view.present,
@@ -150,12 +155,34 @@ class PrioritizeFastPath:
                 self._rank[key] = ranked
         return ranked
 
-    def precompute(self, view: DeviceView, pairs) -> None:
-        """Warm the ranking cache for (metric_row, op) pairs — called from
-        state-refresh threads so requests never pay the device pass."""
-        self._table_for(view)
+    def precompute(self, view: DeviceView, pairs, wirec=None) -> None:
+        """Warm the request-time state for (metric_row, op) pairs: the
+        ranking cache (one device pass per pair whose row actually
+        changed), plus the response table for whichever encoder will serve
+        (native NameTable when ``wirec`` is given, fragments otherwise).
+
+        Called from state-refresh threads via the mirror's post-publish
+        hook (TensorStateMirror.on_state_change) so steady-state requests
+        never pay a device pass or a table build.  Also prunes cache
+        entries whose row content (or interning) has moved on."""
+        table = self._table_for(view)
+        if wirec is not None:
+            table.native(wirec)
+        else:
+            table.fragments
         for row, op in pairs:
             self._ranking(view, int(row), int(op))
+        with self._lock:
+            self._rank = {
+                k: v
+                for k, v in self._rank.items()
+                if k[0] == view.row_version(k[1])
+            }
+            self._violations = {
+                k: v
+                for k, v in self._violations.items()
+                if k[0] == tuple(view.row_version(r) for r in k[1])
+            }
 
     # -- prioritize ------------------------------------------------------------
 
@@ -232,9 +259,12 @@ class PrioritizeFastPath:
         rules = compiled.dontschedule
         if rules is None:
             return None
+        # keyed by the rule rows' content versions (not the global state
+        # version): churn on unrelated metrics keeps this set warm
+        rule_rows = tuple(int(r) for r in rules.metric_rows[rules.active])
         sig = (
-            view.version,
-            rules.metric_rows.tobytes(),
+            tuple(view.row_version(r) for r in rule_rows),
+            rule_rows,
             rules.op_ids.tobytes(),
             rules.targets.tobytes(),
             rules.active.tobytes(),

@@ -84,6 +84,43 @@ class MetricsExtender:
         # encoder (tas/fastpath.py) — the per-request device dispatch and
         # per-node Python objects the round-1 verdict flagged are gone
         self.fastpath = PrioritizeFastPath() if mirror is not None else None
+        if mirror is not None:
+            # warm the fastpath from the state-refresh threads: every
+            # mirror publish precomputes rankings/violations/tables for the
+            # new version, so under metric churn (2-5 s syncPeriod,
+            # tas-deployment.yaml) no request pays the device dispatch
+            mirror.on_state_change.append(self.warm_fastpath)
+            self.warm_fastpath()  # cover state written before construction
+
+    # -- fastpath warming ------------------------------------------------------
+
+    def warm_fastpath(self) -> None:
+        """Precompute the request-time caches for the mirror's current
+        state: one ranking pass per in-use (metric row, op) pair, the
+        dontschedule violation sets, and the response-encode table.  Runs
+        in whatever thread published the state change (the metric-refresh
+        loop in production, reference cmd/main.go:76-78), keeping the
+        device dispatch off the request path entirely."""
+        fastpath = self.fastpath
+        if fastpath is None:
+            return
+        try:
+            policies, view, host_only_map = self.mirror.policies_snapshot()
+
+            def host_only(name: str) -> bool:
+                return host_only_map.get(name, False)
+
+            pairs = {
+                (compiled.scheduleonmetric_row, compiled.scheduleonmetric_op)
+                for compiled in policies
+                if self._prioritize_device_eligible(compiled, host_only)
+            }
+            fastpath.precompute(view, pairs, wirec=get_wirec())
+            for compiled in policies:
+                if self._filter_device_eligible(compiled, host_only):
+                    fastpath.violating_names(compiled, view)
+        except Exception as exc:  # warming must never break the writer
+            klog.error("fastpath warm failed: %s", exc)
 
     # -- verbs ----------------------------------------------------------------
 
@@ -377,17 +414,32 @@ class MetricsExtender:
             return None, None
         return self.mirror.policy_with_view(policy.namespace, policy.name)
 
-    def _device_prioritize_ok(
-        self, compiled: CompiledPolicy, rule: TASPolicyRule
-    ) -> bool:
-        return compiled.scheduleonmetric_row >= 0 and not self.mirror.metric_host_only(
-            rule.metricname
+    # the single source of truth for "can the device fastpath serve this
+    # policy", shared between the request path (host_only = live mirror
+    # lookup) and the warmer (host_only = snapshotted map) so the warmed
+    # set can never drift from what requests actually use
+
+    @staticmethod
+    def _prioritize_device_eligible(compiled: CompiledPolicy, host_only) -> bool:
+        return compiled.scheduleonmetric_row >= 0 and not host_only(
+            compiled.scheduleonmetric_metric
         )
 
-    def _device_filter_ok(self, compiled: CompiledPolicy) -> bool:
+    @staticmethod
+    def _filter_device_eligible(compiled: CompiledPolicy, host_only) -> bool:
         rules = compiled.dontschedule
         if rules is None or rules.host_only or not rules.active.any():
             return False
-        return not any(
-            self.mirror.metric_host_only(name) for name in rules.metric_names
+        return not any(host_only(name) for name in rules.metric_names)
+
+    def _device_prioritize_ok(
+        self, compiled: CompiledPolicy, rule: TASPolicyRule
+    ) -> bool:
+        return self._prioritize_device_eligible(
+            compiled, self.mirror.metric_host_only
+        )
+
+    def _device_filter_ok(self, compiled: CompiledPolicy) -> bool:
+        return self._filter_device_eligible(
+            compiled, self.mirror.metric_host_only
         )

@@ -178,10 +178,180 @@ class TestCacheInvalidation:
         compiled, view = mirror.policy_with_view("default", "pol")
         fast.prioritize_bytes(compiled, view, ["n1"])
         key = (
-            view.version,
+            view.row_version(compiled.scheduleonmetric_row),
             compiled.scheduleonmetric_row,
             compiled.scheduleonmetric_op,
         )
         ranked = fast._rank[key]
         fast.prioritize_bytes(compiled, view, ["n2", "n3"])
         assert fast._rank[key] is ranked  # same array object, no recompute
+
+
+class TestPrecomputeWiring:
+    """VERDICT r2 #3: the mirror's post-publish hook must warm the
+    fastpath so requests never pay the device pass under metric churn."""
+
+    def _counting(self, monkeypatch):
+        import platform_aware_scheduling_tpu.tas.fastpath as fp_mod
+
+        counts = {"prioritize": 0, "filter": 0}
+        real_prioritize = fp_mod.prioritize_kernel
+        real_filter = fp_mod.filter_kernel
+
+        def count_prioritize(*a, **k):
+            counts["prioritize"] += 1
+            return real_prioritize(*a, **k)
+
+        def count_filter(*a, **k):
+            counts["filter"] += 1
+            return real_filter(*a, **k)
+
+        monkeypatch.setattr(fp_mod, "prioritize_kernel", count_prioritize)
+        monkeypatch.setattr(fp_mod, "filter_kernel", count_filter)
+        return counts
+
+    def _write_metrics(self, cache, values):
+        cache.write_metric(
+            "m", {n: NodeMetric(value=Quantity(str(v))) for n, v in values.items()}
+        )
+
+    def test_requests_never_pay_device_pass_under_churn(self, monkeypatch):
+        counts = self._counting(monkeypatch)
+        cache = AutoUpdatingCache()
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+        cache.write_policy(
+            "default",
+            "pol",
+            TASPolicy.from_obj(
+                make_policy(
+                    "pol",
+                    strategies={
+                        "scheduleonmetric": [rule("m", "GreaterThan", 0)],
+                        "dontschedule": [rule("m", "GreaterThan", 1000)],
+                    },
+                )
+            ),
+        )
+        ext = MetricsExtender(cache, mirror=mirror)
+        rng = np.random.default_rng(7)
+        names = [f"node-{i:03d}" for i in range(50)]
+        for round_idx in range(5):
+            # churn: every metric value changes -> new state version,
+            # warmed synchronously in this (the writer's) thread
+            values = {n: int(rng.integers(0, 10_000)) for n in names}
+            self._write_metrics(cache, values)
+            warmed = dict(counts)
+            for _ in range(4):
+                resp = ext.prioritize(prioritize_request(names))
+                assert resp.status == 200
+                scored = json.loads(resp.body)
+                assert len(scored) == len(names)
+                freq = HTTPRequest(
+                    method="POST",
+                    path="/scheduler/filter",
+                    headers={"Content-Type": "application/json"},
+                    body=prioritize_request(names).body,
+                )
+                assert ext.filter(freq).status == 200
+            assert counts == warmed, (
+                f"round {round_idx}: a request paid a device pass "
+                f"(warmed={warmed}, after={counts})"
+            )
+            # the churn rounds themselves must each have re-warmed
+            assert counts["prioritize"] >= round_idx + 1
+
+    def test_response_table_warmed_not_built_on_request(self, monkeypatch):
+        cache, mirror = build()
+        ext = MetricsExtender(cache, mirror=mirror)
+        # after the write above, the current view's table must already
+        # carry whichever encoder variant serves
+        table = ext.fastpath._table
+        assert table is not None
+        from platform_aware_scheduling_tpu.native import get_wirec
+
+        if get_wirec() is not None:
+            assert table._native is not None
+        else:
+            assert table._fragments is not None
+
+    def test_warm_failure_never_breaks_writer(self, monkeypatch):
+        cache, mirror = build()
+        ext = MetricsExtender(cache, mirror=mirror)
+
+        def boom(*a, **k):
+            raise RuntimeError("warm explosion")
+
+        monkeypatch.setattr(ext.fastpath, "precompute", boom)
+        # the metric write (and its hook chain) must survive
+        self._write_metrics(cache, {"n1": 1, "n2": 2})
+        resp = ext.prioritize(prioritize_request(["n1", "n2"]))
+        assert resp.status == 200
+
+    def test_new_policy_warms_at_current_version(self, monkeypatch):
+        counts = self._counting(monkeypatch)
+        cache, mirror = build()
+        ext = MetricsExtender(cache, mirror=mirror)
+        before = dict(counts)
+        # a second policy on the same metric, opposite op: registering it
+        # must warm the new (row, op) pair without any metric write
+        cache.write_policy(
+            "default",
+            "pol2",
+            TASPolicy.from_obj(
+                make_policy(
+                    "pol2",
+                    strategies={"scheduleonmetric": [rule("m", "LessThan", 0)]},
+                )
+            ),
+        )
+        assert counts["prioritize"] == before["prioritize"] + 1
+        resp = ext.prioritize(prioritize_request(["n1", "n2"], pod_name="q"))
+        assert resp.status == 200
+        assert counts["prioritize"] == before["prioritize"] + 1  # no request pass
+
+    def test_value_churn_keeps_table_and_unrelated_rankings(self, monkeypatch):
+        counts = self._counting(monkeypatch)
+        cache = AutoUpdatingCache()
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+        for pol, metric in (("pa", "ma"), ("pb", "mb")):
+            cache.write_policy(
+                "default",
+                pol,
+                TASPolicy.from_obj(
+                    make_policy(
+                        pol,
+                        strategies={
+                            "scheduleonmetric": [rule(metric, "GreaterThan", 0)]
+                        },
+                    )
+                ),
+            )
+        names = [f"n{i}" for i in range(20)]
+        for m in ("ma", "mb"):
+            cache.write_metric(
+                m, {n: NodeMetric(value=Quantity(str(i))) for i, n in enumerate(names)}
+            )
+        ext = MetricsExtender(cache, mirror=mirror)
+        table_before = ext.fastpath._table
+        assert table_before is not None
+        passes_before = counts["prioritize"]
+        # churn ONLY metric "ma": mb's ranking must stay cached (keyed by
+        # row content version, not global version) and the encode table
+        # must survive (keyed by interning version)
+        cache.write_metric(
+            "ma",
+            {n: NodeMetric(value=Quantity(str(100 - i))) for i, n in enumerate(names)},
+        )
+        assert counts["prioritize"] == passes_before + 1  # only ma re-ranked
+        assert ext.fastpath._table is table_before  # no table rebuild
+        # a brand-new node invalidates the table but not via value churn
+        cache.write_metric(
+            "ma",
+            {
+                n: NodeMetric(value=Quantity(str(i)))
+                for i, n in enumerate(names + ["brand-new"])
+            },
+        )
+        assert ext.fastpath._table is not table_before
