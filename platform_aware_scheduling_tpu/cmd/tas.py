@@ -62,6 +62,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         choices=["greedy", "sinkhorn"],
                         help="batch planner solver: greedy (sequential-"
                         "equivalent) or sinkhorn (globally coordinated)")
+    parser.add_argument("--nodeCacheCapable", action="store_true",
+                        help="serve Prioritize/Filter from Args.NodeNames "
+                        "(register the extender nodeCacheCapable: true); "
+                        "large clusters avoid shipping full node objects")
     return parser
 
 
@@ -72,6 +76,7 @@ def assemble(
     enable_device_path: bool = True,
     enable_batch_planner: bool = False,
     batch_solver: str = "greedy",
+    node_cache_capable: bool = False,
 ):
     """Wire cache + mirror + extender + controller + enforcer (the body of
     ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
@@ -86,7 +91,12 @@ def assemble(
         from platform_aware_scheduling_tpu.tas.planner import BatchPlanner
 
         planner = BatchPlanner(cache, mirror, solver=batch_solver)
-    extender = MetricsExtender(cache, mirror=mirror, planner=planner)
+    extender = MetricsExtender(
+        cache,
+        mirror=mirror,
+        planner=planner,
+        node_cache_capable=node_cache_capable,
+    )
 
     enforcer = core.MetricEnforcer(kube_client, mirror=mirror)
     enforcer.register_strategy_type(deschedule.Strategy())
@@ -121,6 +131,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         sync_period_s,
         enable_batch_planner=args.batchPlanner,
         batch_solver=args.batchSolver,
+        node_cache_capable=args.nodeCacheCapable,
     )
 
     server = Server(extender, metrics_provider=extender.recorder.prometheus_text)

@@ -14,10 +14,11 @@ Route and middleware parity with the reference (extender/scheduler.go):
 
 from __future__ import annotations
 
+import socket
+import socketserver
 import ssl
 import threading
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, TYPE_CHECKING
 
 from platform_aware_scheduling_tpu.utils import klog
@@ -81,6 +82,143 @@ def apply_middleware(handler, request: HTTPRequest) -> HTTPResponse:
     return handler(request)
 
 
+_STATUS_REASON = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    500: "Internal Server Error",
+}
+
+
+class _FastHTTPHandler(socketserver.BaseRequestHandler):
+    """Minimal HTTP/1.1 connection handler for the extender hot path.
+
+    Reads each request with a single rolling buffer (no per-line reads),
+    dispatches through ``route`` (set by the enclosing Server), and writes
+    status line + headers + body with one ``sendall``.  Supports
+    keep-alive, pipelined requests, and ``Expect: 100-continue``.  Read
+    and write timeouts mirror the reference server's 5 s / 10 s
+    (scheduler.go:136-137)."""
+
+    route = staticmethod(lambda request: HTTPResponse(status=500))
+    rbufsize = 1 << 16
+
+    def handle(self) -> None:  # noqa: C901 — one tight loop, deliberately
+        sock = self.request
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        buf = bytearray()
+        while True:
+            # -- read the request head --------------------------------------
+            sock.settimeout(READ_HEADER_TIMEOUT_S)
+            head_end = buf.find(b"\r\n\r\n")
+            while head_end < 0:
+                try:
+                    chunk = sock.recv(self.rbufsize)
+                except (TimeoutError, OSError):
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                head_end = buf.find(b"\r\n\r\n")
+            head = bytes(buf[:head_end])
+            del buf[: head_end + 4]
+            lines = head.split(b"\r\n")
+            parts = lines[0].split(b" ")
+            if len(parts) != 3:
+                self._send_simple(sock, 400, close=True)
+                return
+            try:
+                method = parts[0].decode("ascii")
+                path = parts[1].decode("ascii")
+                version = parts[2].decode("ascii")
+            except UnicodeDecodeError:
+                self._send_simple(sock, 400, close=True)
+                return
+            headers: Dict[str, str] = {}
+            for line in lines[1:]:
+                name, sep, value = line.partition(b":")
+                if sep:
+                    headers[name.decode("latin-1")] = value.strip().decode(
+                        "latin-1"
+                    )
+            lowered = {k.lower(): v for k, v in headers.items()}
+            try:
+                length = int(lowered.get("content-length") or 0)
+            except ValueError:
+                self._send_simple(sock, 400, close=True)
+                return
+            if length < 0:  # negative framing would desync the buffer
+                self._send_simple(sock, 400, close=True)
+                return
+            if length > MAX_CONTENT_LENGTH:
+                # parity with the ContentLength middleware check: refuse to
+                # slurp oversized bodies
+                self._send_simple(sock, 500, close=True)
+                return
+            if lowered.get("expect", "").lower() == "100-continue":
+                try:
+                    sock.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+                except OSError:
+                    return
+            # -- read the body ----------------------------------------------
+            while len(buf) < length:
+                try:
+                    chunk = sock.recv(self.rbufsize)
+                except (TimeoutError, OSError):
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+            body = bytes(buf[:length])
+            del buf[:length]
+            # -- dispatch + respond ------------------------------------------
+            request = HTTPRequest(
+                method=method, path=path, headers=headers, body=body
+            )
+            try:
+                response = type(self).route(request)
+            except Exception as exc:
+                klog.error("handler raised: %r", exc)
+                response = HTTPResponse(status=500)
+            close = (
+                version == "HTTP/1.0"
+                or lowered.get("connection", "").lower() == "close"
+            )
+            reason = _STATUS_REASON.get(response.status, "Unknown")
+            out = [f"HTTP/1.1 {response.status} {reason}\r\n".encode("ascii")]
+            for k, v in response.headers.items():
+                out.append(f"{k}: {v}\r\n".encode("latin-1"))
+            out.append(f"Content-Length: {len(response.body)}\r\n".encode())
+            if close:
+                out.append(b"Connection: close\r\n")
+            out.append(b"\r\n")
+            out.append(response.body)
+            sock.settimeout(WRITE_TIMEOUT_S)
+            try:
+                sock.sendall(b"".join(out))
+            except OSError:
+                return
+            if close:
+                return
+
+    @staticmethod
+    def _send_simple(sock, status: int, close: bool = False) -> None:
+        reason = _STATUS_REASON.get(status, "Unknown")
+        extra = b"Connection: close\r\n" if close else b""
+        try:
+            sock.sendall(
+                f"HTTP/1.1 {status} {reason}\r\nContent-Length: 0\r\n".encode()
+                + extra
+                + b"\r\n"
+            )
+        except OSError:
+            pass
+
+
 class Server:
     """Wraps a Scheduler implementation with the HTTP(S) extender endpoint
     (reference extender/types.go:18-20, scheduler.go:86-143)."""
@@ -132,46 +270,26 @@ class Server:
 
         With ``unsafe=True`` serves plain HTTP; otherwise mutual-TLS with the
         pinned configuration.  ``block=False`` serves on a daemon thread
-        (callers use :meth:`wait_ready` / :meth:`shutdown`)."""
+        (callers use :meth:`wait_ready` / :meth:`shutdown`).
+
+        The connection loop is a slim hand-rolled HTTP/1.1 handler
+        (keep-alive, single-buffer header parse, one sendall per response,
+        TCP_NODELAY) rather than http.server's per-line machinery — at 10k
+        nodes this layer runs on every request and its cost lands straight
+        in p99 (the Go reference gets the equivalent from net/http's
+        optimized server for free)."""
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            timeout = READ_HEADER_TIMEOUT_S
+        class Handler(_FastHTTPHandler):
+            route = staticmethod(server.route)
 
-            def _handle(self) -> None:
-                length = int(self.headers.get("Content-Length") or 0)
-                if length > MAX_CONTENT_LENGTH:
-                    # refuse to slurp oversized bodies; parity with the
-                    # ContentLength middleware check
-                    self.send_response(500)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                body = self.rfile.read(length) if length > 0 else b""
-                request = HTTPRequest(
-                    method=self.command,
-                    path=self.path,
-                    headers=dict(self.headers.items()),
-                    body=body,
-                )
-                response = server.route(request)
-                self.send_response(response.status)
-                for k, v in response.headers.items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(response.body)))
-                self.end_headers()
-                if response.body:
-                    self.wfile.write(response.body)
-
-            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _handle
-
-            def log_message(self, fmt, *args):  # route through klog instead
-                klog.v(5).infof("http: " + fmt, *args)
-
-        httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        httpd = socketserver.ThreadingTCPServer(
+            (host, int(port)), Handler, bind_and_activate=False
+        )
+        httpd.allow_reuse_address = True
         httpd.daemon_threads = True
-        httpd.timeout = WRITE_TIMEOUT_S
+        httpd.server_bind()
+        httpd.server_activate()
 
         if unsafe:
             klog.v(2).info_s(f"Extender Listening on HTTP {port}", component="extender")

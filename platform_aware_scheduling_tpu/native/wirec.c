@@ -291,13 +291,22 @@ typedef struct {
     StrSlice policy_label; /* labels["telemetry-policy"] */
     int has_label;
     int nodes_present;     /* "Nodes" was a non-null object with items */
-    StrSlice *names;       /* node name slices */
+    StrSlice *names;       /* node name slices (Nodes.items[].metadata.name) */
     Py_ssize_t num_names;
+    int node_names_present; /* "NodeNames" was a non-null array */
+    StrSlice *nn_names;     /* NodeNames[] string slices */
+    Py_ssize_t num_nn_names;
+    /* raw byte span [start, end) of the candidate-list JSON values —
+     * identical spans mean identical candidate sets, the key of the
+     * response-reuse cache (tas/fastpath.py); -1 when absent */
+    Py_ssize_t nodes_span_start, nodes_span_end;
+    Py_ssize_t nn_span_start, nn_span_end;
 } ParsedArgs;
 
 static void ParsedArgs_dealloc(ParsedArgs *self) {
     Py_XDECREF(self->body);
     free(self->names);  /* raw-allocated: grown while the GIL is released */
+    free(self->nn_names);
     Py_TYPE(self)->tp_free((PyObject *)self);
 }
 
@@ -331,18 +340,73 @@ static PyObject *ParsedArgs_get(ParsedArgs *self, void *closure) {
         return PyBool_FromLong(self->nodes_present);
     if (strcmp(which, "num_nodes") == 0)
         return PyLong_FromSsize_t(self->num_names);
+    if (strcmp(which, "node_names_present") == 0)
+        return PyBool_FromLong(self->node_names_present);
+    if (strcmp(which, "num_node_names") == 0)
+        return PyLong_FromSsize_t(self->num_nn_names);
     Py_RETURN_NONE;
 }
 
-static PyObject *ParsedArgs_node_names(ParsedArgs *self, PyObject *noargs) {
-    PyObject *list = PyList_New(self->num_names);
+static PyObject *materialize_names(PyObject *body, const StrSlice *slices,
+                                   Py_ssize_t count) {
+    PyObject *list = PyList_New(count);
     if (!list) return NULL;
-    for (Py_ssize_t k = 0; k < self->num_names; k++) {
-        PyObject *u = slice_to_unicode(self->body, &self->names[k]);
+    for (Py_ssize_t k = 0; k < count; k++) {
+        PyObject *u = slice_to_unicode(body, &slices[k]);
         if (!u) { Py_DECREF(list); return NULL; }
         PyList_SET_ITEM(list, k, u);
     }
     return list;
+}
+
+static PyObject *ParsedArgs_node_names(ParsedArgs *self, PyObject *noargs) {
+    return materialize_names(self->body, self->names, self->num_names);
+}
+
+static PyObject *ParsedArgs_node_names_list(ParsedArgs *self, PyObject *noargs) {
+    return materialize_names(self->body, self->nn_names, self->num_nn_names);
+}
+
+static PyObject *span_copy(ParsedArgs *self, Py_ssize_t start, Py_ssize_t end) {
+    if (start < 0) Py_RETURN_NONE;
+    return PyBytes_FromStringAndSize(
+        PyBytes_AS_STRING(self->body) + start, end - start);
+}
+
+static PyObject *ParsedArgs_nodes_span(ParsedArgs *self, PyObject *noargs) {
+    return span_copy(self, self->nodes_span_start, self->nodes_span_end);
+}
+
+static PyObject *ParsedArgs_nn_span(ParsedArgs *self, PyObject *noargs) {
+    return span_copy(self, self->nn_span_start, self->nn_span_end);
+}
+
+static PyObject *ParsedArgs_span_matches(ParsedArgs *self, PyObject *args) {
+    /* span_matches(use_node_names, candidate: bytes) -> bool
+     * memcmp of the raw candidate-list span against a cached span — the
+     * zero-false-positive verify of the response-reuse cache, without
+     * materializing the span (memoryview __eq__ is per-byte-slow and
+     * bytes() would copy ~hundreds of KB per probe). */
+    int use_node_names;
+    PyObject *cand;
+    if (!PyArg_ParseTuple(args, "pO", &use_node_names, &cand)) return NULL;
+    if (!PyBytes_Check(cand)) {
+        PyErr_SetString(PyExc_TypeError, "candidate span must be bytes");
+        return NULL;
+    }
+    Py_ssize_t start = use_node_names ? self->nn_span_start
+                                      : self->nodes_span_start;
+    Py_ssize_t end = use_node_names ? self->nn_span_end : self->nodes_span_end;
+    if (start < 0) Py_RETURN_FALSE;
+    Py_ssize_t len = end - start;
+    if (len != PyBytes_GET_SIZE(cand)) Py_RETURN_FALSE;
+    int equal;
+    const char *a = PyBytes_AS_STRING(self->body) + start;
+    const char *b = PyBytes_AS_STRING(cand);
+    Py_BEGIN_ALLOW_THREADS
+    equal = memcmp(a, b, (size_t)len) == 0;
+    Py_END_ALLOW_THREADS
+    return PyBool_FromLong(equal);
 }
 
 static PyGetSetDef ParsedArgs_getset[] = {
@@ -351,12 +415,23 @@ static PyGetSetDef ParsedArgs_getset[] = {
     {"policy_label", (getter)ParsedArgs_get, NULL, NULL, "policy_label"},
     {"nodes_present", (getter)ParsedArgs_get, NULL, NULL, "nodes_present"},
     {"num_nodes", (getter)ParsedArgs_get, NULL, NULL, "num_nodes"},
+    {"node_names_present", (getter)ParsedArgs_get, NULL, NULL,
+     "node_names_present"},
+    {"num_node_names", (getter)ParsedArgs_get, NULL, NULL, "num_node_names"},
     {NULL},
 };
 
 static PyMethodDef ParsedArgs_methods[] = {
     {"node_names", (PyCFunction)ParsedArgs_node_names, METH_NOARGS,
-     "Materialize the node-name list (slow path / debugging)."},
+     "Materialize the Nodes.items name list (slow path / debugging)."},
+    {"node_names_list", (PyCFunction)ParsedArgs_node_names_list, METH_NOARGS,
+     "Materialize the NodeNames list (nodeCacheCapable mode)."},
+    {"nodes_span", (PyCFunction)ParsedArgs_nodes_span, METH_NOARGS,
+     "Copy of the raw Nodes JSON value bytes, or None."},
+    {"node_names_span", (PyCFunction)ParsedArgs_nn_span, METH_NOARGS,
+     "Copy of the raw NodeNames JSON value bytes, or None."},
+    {"span_matches", (PyCFunction)ParsedArgs_span_matches, METH_VARARGS,
+     "memcmp the request's candidate span against cached span bytes."},
     {NULL},
 };
 
@@ -577,14 +652,70 @@ done:
     return push_name(sc, pa, cap, &name);
 }
 
+/* "NodeNames": null | array of strings (nodeCacheCapable mode,
+ * extender/types.go:44-49); strict: non-string elements fail the parse */
+static int scan_node_names(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("eof in NodeNames");
+    /* duplicate "NodeNames" keys: last wins */
+    pa->node_names_present = 0;
+    pa->num_nn_names = 0;
+    pa->nn_span_start = sc->i;
+    if (sc->s[sc->i] == 'n') {
+        if (skip_literal(sc, "null", 4) < 0) return -1;
+        pa->nn_span_end = sc->i;
+        return 0;
+    }
+    if (sc->s[sc->i] != '[') return fail("NodeNames not array");
+    pa->node_names_present = 1;
+    sc->i++;
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == ']') {
+        sc->i++;
+        pa->nn_span_end = sc->i;
+        return 0;
+    }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice name;
+        if (scan_string(sc, &name) < 0) return -1;
+        if (pa->num_nn_names == *cap) {
+            Py_ssize_t ncap = *cap ? *cap * 2 : NAME_CHUNK;
+            StrSlice *nn = realloc(pa->nn_names, ncap * sizeof(StrSlice));
+            if (!nn) return fail("out of memory");
+            pa->nn_names = nn;
+            *cap = ncap;
+        }
+        pa->nn_names[pa->num_nn_names++] = name;
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated NodeNames");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == ']') {
+            sc->i++;
+            pa->nn_span_end = sc->i;
+            return 0;
+        }
+        return fail("bad NodeNames");
+    }
+}
+
 static int scan_nodes(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
     skip_ws(sc);
     if (sc->i >= sc->n) return fail("eof in Nodes");
-    if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
+    pa->nodes_span_start = sc->i;
+    if (sc->s[sc->i] == 'n') {
+        int rc = skip_literal(sc, "null", 4);
+        pa->nodes_span_end = sc->i;
+        return rc;
+    }
     if (sc->s[sc->i] != '{') return fail("Nodes not object");
     sc->i++;
     skip_ws(sc);
-    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; return 0; }
+    if (sc->i < sc->n && sc->s[sc->i] == '}') {
+        sc->i++;
+        pa->nodes_span_end = sc->i;
+        return 0;
+    }
     for (;;) {
         skip_ws(sc);
         StrSlice key;
@@ -624,7 +755,11 @@ static int scan_nodes(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
         skip_ws(sc);
         if (sc->i >= sc->n) return fail("unterminated Nodes");
         if (sc->s[sc->i] == ',') { sc->i++; continue; }
-        if (sc->s[sc->i] == '}') { sc->i++; return 0; }
+        if (sc->s[sc->i] == '}') {
+            sc->i++;
+            pa->nodes_span_end = sc->i;
+            return 0;
+        }
         return fail("bad Nodes");
     }
 }
@@ -645,7 +780,13 @@ static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
     pa->nodes_present = 0;
     pa->names = NULL;
     pa->num_names = 0;
+    pa->node_names_present = 0;
+    pa->nn_names = NULL;
+    pa->num_nn_names = 0;
+    pa->nodes_span_start = pa->nodes_span_end = -1;
+    pa->nn_span_start = pa->nn_span_end = -1;
     Py_ssize_t cap = 0;
+    Py_ssize_t nn_cap = 0;
 
     Scan scan_state = {PyBytes_AS_STRING(arg), PyBytes_GET_SIZE(arg), 0, NULL};
     Scan *sc = &scan_state;
@@ -682,7 +823,12 @@ static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
                        memcmp(kp, "Nodes", 5) == 0) {
                 pa->nodes_present = 0;
                 pa->num_names = 0;
+                pa->nodes_span_start = pa->nodes_span_end = -1;
                 if (scan_nodes(sc, pa, &cap) < 0) { ok = 0; break; }
+                handled = 1;
+            } else if (key.len == 9 &&
+                       memcmp(kp, "NodeNames", 9) == 0) {
+                if (scan_node_names(sc, pa, &nn_cap) < 0) { ok = 0; break; }
                 handled = 1;
             }
             if (!handled && skip_value(sc) < 0) { ok = 0; break; }
@@ -900,8 +1046,9 @@ static int put_score(Buf *b, long score) {
 static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
     PyObject *parsed_obj, *table_obj, *ranked_obj;
     Py_ssize_t planned_row = -1;
-    if (!PyArg_ParseTuple(args, "OOO|n", &parsed_obj, &table_obj, &ranked_obj,
-                          &planned_row))
+    int use_node_names = 0;
+    if (!PyArg_ParseTuple(args, "OOO|np", &parsed_obj, &table_obj, &ranked_obj,
+                          &planned_row, &use_node_names))
         return NULL;
     if (!PyObject_TypeCheck(parsed_obj, &ParsedArgs_Type)) {
         PyErr_SetString(PyExc_TypeError, "expected ParsedArgs");
@@ -925,6 +1072,11 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
     const int64_t *order = (const int64_t *)ranked.buf;
     Py_ssize_t n_ranked = ranked.len / sizeof(int64_t);
 
+    /* candidate source: Nodes.items names, or the NodeNames array in
+     * nodeCacheCapable mode */
+    const StrSlice *cand = use_node_names ? pa->nn_names : pa->names;
+    Py_ssize_t num_cand = use_node_names ? pa->num_nn_names : pa->num_names;
+
     /* candidate mask over rows; escaped names (rare) resolve under the
      * GIL first, everything else runs GIL-free below */
     uint8_t *mask = calloc((size_t)t->n_rows + 1, 1);
@@ -932,8 +1084,8 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
         PyBuffer_Release(&ranked);
         return PyErr_NoMemory();
     }
-    for (Py_ssize_t k = 0; k < pa->num_names; k++) {
-        StrSlice *sl = &pa->names[k];
+    for (Py_ssize_t k = 0; k < num_cand; k++) {
+        const StrSlice *sl = &cand[k];
         if (sl->present && sl->escaped) {
             PyObject *u = slice_to_unicode(pa->body, sl);
             if (!u) goto error;
@@ -950,8 +1102,8 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
     Buf out;
     int oom = 0;
     Py_BEGIN_ALLOW_THREADS
-    for (Py_ssize_t k = 0; k < pa->num_names; k++) {
-        StrSlice *sl = &pa->names[k];
+    for (Py_ssize_t k = 0; k < num_cand; k++) {
+        const StrSlice *sl = &cand[k];
         if (!sl->present || sl->escaped) continue;
         Py_ssize_t row = table_lookup(t, body + sl->off, sl->len);
         if (row >= 0) mask[row] = 1;

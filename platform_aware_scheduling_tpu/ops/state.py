@@ -239,10 +239,10 @@ class TensorStateMirror:
         for callback in list(self.on_state_change):
             try:
                 callback()
-            except Exception:  # noqa: BLE001 — subscriber errors are theirs
+            except Exception as exc:  # noqa: BLE001 — subscriber errors are theirs
                 from platform_aware_scheduling_tpu.utils import klog
 
-                klog.error("state-change subscriber failed", exc_info=True)
+                klog.error("state-change subscriber failed: %r", exc)
 
     def on_metric_write(self, metric_name: str, info) -> None:
         """info: NodeMetricsInfo (node -> NodeMetric) or None (registration

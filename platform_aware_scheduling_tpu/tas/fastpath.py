@@ -103,6 +103,11 @@ class PrioritizeFastPath:
     """Caches global rankings + violation sets per state version and
     answers verbs with numpy selections over them."""
 
+    # response-reuse entries kept per fastpath (each ~ request span +
+    # response bytes; 8 covers the common case of a handful of concurrent
+    # policies/filter results at a given instant)
+    RESPONSE_CACHE_SIZE = 8
+
     def __init__(self):
         self._lock = threading.Lock()
         self._table: Optional[_ViewTable] = None
@@ -111,6 +116,17 @@ class PrioritizeFastPath:
         # (row-version tuple, rows, ruleset tensors) -> frozenset of
         # violating row indices
         self._violations: Dict[Tuple, frozenset] = {}
+        # response-reuse cache: the kube-scheduler prioritizes every
+        # pending pod against the same filter result, so consecutive
+        # requests carry byte-identical candidate lists; entries are keyed
+        # by (ranking identity, table identity, planned row) and VERIFIED
+        # by comparing the raw candidate-span bytes — identical span +
+        # identical ranking implies a byte-identical response, with zero
+        # false positives (no hashing trust).  List of
+        # [ranked, table, planned_row, span_bytes, response], MRU first.
+        self._responses: List[list] = []
+        # same idea for Filter: [violation_set, use_nn, span_bytes, body]
+        self._filter_responses: List[list] = []
 
     # -- table/cache maintenance ----------------------------------------------
 
@@ -193,10 +209,14 @@ class PrioritizeFastPath:
         view: DeviceView,
         parsed,
         planned: Optional[str] = None,
+        use_node_names: bool = False,
     ) -> bytes:
         """Native variant: candidate lookup + selection + byte assembly all
         happen in ``_wirec.select_encode`` over the parsed body's zero-copy
-        name slices — no per-node Python objects at any point."""
+        name slices — no per-node Python objects at any point.  When the
+        request's raw candidate span matches a cached one under the same
+        ranking/table/plan, the stored response is returned without any
+        selection or encoding at all (see _responses)."""
         table = self._table_for(view)
         ranked = self._ranking(
             view, compiled.scheduleonmetric_row, compiled.scheduleonmetric_op
@@ -204,7 +224,30 @@ class PrioritizeFastPath:
         planned_row = -1
         if planned is not None:
             planned_row = table.node_index.get(planned, -1)
-        return wirec.select_encode(parsed, table.native(wirec), ranked, planned_row)
+        with self._lock:
+            responses = self._responses
+            for idx, entry in enumerate(responses):
+                if (
+                    entry[0] is ranked
+                    and entry[1] is table
+                    and entry[2] == planned_row
+                    and parsed.span_matches(use_node_names, entry[3])
+                ):
+                    if idx:  # move to front (MRU)
+                        responses.insert(0, responses.pop(idx))
+                    return entry[4]
+        response = wirec.select_encode(
+            parsed, table.native(wirec), ranked, planned_row, use_node_names
+        )
+        span = (
+            parsed.node_names_span() if use_node_names else parsed.nodes_span()
+        )
+        if span is not None:
+            entry = [ranked, table, planned_row, span, response]
+            with self._lock:
+                self._responses.insert(0, entry)
+                del self._responses[self.RESPONSE_CACHE_SIZE :]
+        return response
 
     def prioritize_bytes(
         self,
@@ -253,9 +296,26 @@ class PrioritizeFastPath:
     def violating_names(
         self, compiled: CompiledPolicy, view: DeviceView
     ) -> Optional[Dict[str, None]]:
-        """The dontschedule violation set over all nodes, cached per state
-        version (request-independent, SURVEY §3.3); None when the policy
-        has no device-evaluable dontschedule rules."""
+        """The dontschedule violation set over all nodes, cached per rule
+        rows' content versions (request-independent, SURVEY §3.3); None
+        when the policy has no device-evaluable dontschedule rules."""
+        cached = self.violation_set(compiled, view)
+        if cached is None:
+            return None
+        # resolve rows back to names through the view (rows past the interned
+        # range are padding and never violate real nodes)
+        return {
+            view.node_names[i]: None
+            for i in cached
+            if i < len(view.node_names)
+        }
+
+    def violation_set(
+        self, compiled: CompiledPolicy, view: DeviceView
+    ) -> Optional[frozenset]:
+        """Identity-stable violating-row frozenset for this policy at this
+        state — the Filter response cache keys on the OBJECT identity, so
+        a state change (new frozenset) can never serve stale bytes."""
         rules = compiled.dontschedule
         if rules is None:
             return None
@@ -284,10 +344,38 @@ class PrioritizeFastPath:
             cached = frozenset(int(i) for i in np.nonzero(bad)[0])
             with self._lock:
                 self._violations[sig] = cached
-        # resolve rows back to names through the view (rows past the interned
-        # range are padding and never violate real nodes)
-        return {
-            view.node_names[i]: None
-            for i in cached
-            if i < len(view.node_names)
-        }
+        return cached
+
+    # -- filter response reuse -------------------------------------------------
+
+    def filter_lookup(
+        self, violations: frozenset, use_node_names: bool, parsed
+    ) -> Optional[bytes]:
+        """Cached Filter response bytes for this exact candidate span under
+        this exact violation set, or None."""
+        with self._lock:
+            responses = self._filter_responses
+            for idx, entry in enumerate(responses):
+                if (
+                    entry[0] is violations
+                    and entry[1] == use_node_names
+                    and parsed.span_matches(use_node_names, entry[2])
+                ):
+                    if idx:
+                        responses.insert(0, responses.pop(idx))
+                    return entry[3]
+        return None
+
+    def filter_store(
+        self, violations: frozenset, use_node_names: bool, parsed, body: bytes
+    ) -> None:
+        span = (
+            parsed.node_names_span() if use_node_names else parsed.nodes_span()
+        )
+        if span is None:
+            return
+        with self._lock:
+            self._filter_responses.insert(
+                0, [violations, use_node_names, span, body]
+            )
+            del self._filter_responses[self.RESPONSE_CACHE_SIZE :]

@@ -73,9 +73,18 @@ class MetricsExtender:
         mirror: Optional[TensorStateMirror] = None,
         recorder: Optional[LatencyRecorder] = None,
         planner=None,
+        node_cache_capable: bool = False,
     ):
+        """``node_cache_capable``: serve Prioritize/Filter from
+        ``Args.NodeNames`` when ``Args.Nodes`` is absent — the wire mode a
+        ``nodeCacheCapable: true`` extender registration receives
+        (extender/types.go:44-49; required by GAS, scheduler.go:455-461).
+        The reference TAS ignores NodeNames and returns the empty-200
+        quirk; that behavior is preserved when this flag is off (the
+        default), so large clusters opt in via --nodeCacheCapable."""
         self.cache = cache
         self.mirror = mirror
+        self.node_cache_capable = node_cache_capable
         self.recorder = recorder or LatencyRecorder()
         # opt-in tas.planner.BatchPlanner: prioritize answers steer planned
         # pods onto their batch-assigned node (see planner module doc)
@@ -134,7 +143,8 @@ class MetricsExtender:
             args = self._decode(request)
             if args is None:
                 return HTTPResponse()
-            if not args.nodes:
+            names = self._candidate_names(args)
+            if not names:
                 klog.v(2).info_s(
                     "bad extender arguments. No nodes in list", component="extender"
                 )
@@ -143,7 +153,9 @@ class MetricsExtender:
             if TAS_POLICY_LABEL not in args.pod.get_labels():
                 klog.v(2).info_s("no policy associated with pod", component="extender")
                 status = 400  # and still prioritize (telemetryscheduler.go:50-54)
-            return HTTPResponse.json(self._prioritize_body(args), status=status)
+            return HTTPResponse.json(
+                self._prioritize_body(args, names), status=status
+            )
         finally:
             self.recorder.observe("prioritize", time.perf_counter() - start)
 
@@ -151,6 +163,9 @@ class MetricsExtender:
         start = time.perf_counter()
         try:
             klog.v(2).info_s("Filter request received", component="extender")
+            probe = self._filter_cache_probe(request)
+            if isinstance(probe, HTTPResponse):
+                return probe
             args = self._decode(request)
             if args is None:
                 return HTTPResponse()
@@ -158,9 +173,68 @@ class MetricsExtender:
             if result is None:
                 klog.v(2).info_s("No filtered nodes returned", component="extender")
                 return HTTPResponse.json(b"null\n", status=404)
-            return HTTPResponse.json(result.to_json())
+            body = result.to_json()
+            if probe is not None:
+                parsed, violations, use_node_names = probe
+                self.fastpath.filter_store(
+                    violations, use_node_names, parsed, body
+                )
+            return HTTPResponse.json(body)
         finally:
             self.recorder.observe("filter", time.perf_counter() - start)
+
+    def _filter_cache_probe(self, request: HTTPRequest):
+        """Filter response reuse (same burst-amortization as Prioritize's
+        span cache): a cached HTTPResponse on hit; a (parsed, violations,
+        use_node_names) token when cacheable but missed (the verb stores
+        its exact Python-built bytes under that key); None when the
+        request isn't cacheable (host-only policy, odd shapes, no native
+        scanner) — the exact path then owns the response alone.
+
+        Correctness: the key pairs the request's raw candidate-span bytes
+        (memcmp, zero false positives) with the IDENTITY of the device
+        violation frozenset — any state change produces a new frozenset,
+        so stale bytes can never match."""
+        if self.fastpath is None:
+            return None
+        wirec = get_wirec()
+        if wirec is None:
+            return None
+        try:
+            parsed = wirec.parse_prioritize(request.body)
+            use_node_names = False
+            if not parsed.nodes_present or parsed.num_nodes == 0:
+                if (
+                    self.node_cache_capable
+                    and parsed.node_names_present
+                    and parsed.num_node_names > 0
+                ):
+                    use_node_names = True
+                else:
+                    return None
+            policy_name = parsed.policy_label
+            if policy_name is None:
+                return None
+            try:
+                policy = self.cache.read_policy(
+                    parsed.pod_namespace or "", policy_name
+                )
+            except Exception:
+                return None
+            compiled, view = self._device_policy(policy)
+            if compiled is None or not self._device_filter_ok(compiled):
+                return None
+            violations = self.fastpath.violation_set(compiled, view)
+            if violations is None:
+                return None
+            body = self.fastpath.filter_lookup(
+                violations, use_node_names, parsed
+            )
+            if body is not None:
+                return HTTPResponse.json(body)
+            return parsed, violations, use_node_names
+        except (ValueError, TypeError):
+            return None
 
     def bind(self, request: HTTPRequest) -> HTTPResponse:
         # TAS does not implement Bind (telemetryscheduler.go:179-181)
@@ -198,8 +272,16 @@ class MetricsExtender:
     ) -> Optional[HTTPResponse]:
         # parse errors (ValueError/TypeError) propagate to the outer guard
         parsed = wirec.parse_prioritize(request.body)
+        use_node_names = False
         if not parsed.nodes_present or parsed.num_nodes == 0:
-            return None  # empty-200 quirks belong to the exact path
+            if (
+                self.node_cache_capable
+                and parsed.node_names_present
+                and parsed.num_node_names > 0
+            ):
+                use_node_names = True
+            else:
+                return None  # empty-200 quirks belong to the exact path
         status = 200
         policy_name = parsed.policy_label
         if policy_name is None:
@@ -223,20 +305,24 @@ class MetricsExtender:
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
                 body = self.fastpath.prioritize_parsed(
-                    wirec, compiled, view, parsed, planned
+                    wirec, compiled, view, parsed, planned, use_node_names
                 )
                 return HTTPResponse.json(body, status)
             except Exception as exc:
                 klog.error("native prioritize failed, host fallback: %s", exc)
         # host-only policy/metric: exact host semantics over the parsed names
-        result = self._apply_plan(pod, self._prioritize_host(rule, parsed.node_names()))
+        names = (
+            parsed.node_names_list() if use_node_names else parsed.node_names()
+        )
+        result = self._apply_plan(pod, self._prioritize_host(rule, names))
         return HTTPResponse.json(encode_host_priority_list(result), status)
 
     # -- decode ---------------------------------------------------------------
 
     def _decode(self, request: HTTPRequest) -> Optional[Args]:
         """DecodeExtenderRequest (telemetryscheduler.go:63-78): errors —
-        including a missing Nodes list — log and produce an empty 200."""
+        including a missing Nodes list — log and produce an empty 200.
+        With node_cache_capable, a body carrying only NodeNames is valid."""
         if not request.body:
             klog.v(2).info_s("request body empty", component="extender")
             return None
@@ -246,13 +332,24 @@ class MetricsExtender:
             klog.v(2).info_s(f"error decoding request: {exc}", component="extender")
             return None
         if args.nodes is None:
+            if self.node_cache_capable and args.node_names is not None:
+                return args
             klog.v(2).info_s("no nodes in list", component="extender")
             return None
         return args
 
+    def _candidate_names(self, args: Args) -> List[str]:
+        """The request's candidate node names: Nodes.items when present,
+        else (nodeCacheCapable only) the NodeNames list."""
+        if args.nodes:
+            return [node.name for node in args.nodes]
+        if self.node_cache_capable and args.node_names:
+            return list(args.node_names)
+        return []
+
     # -- prioritize logic ------------------------------------------------------
 
-    def _prioritize_body(self, args: Args) -> bytes:
+    def _prioritize_body(self, args: Args, names: List[str]) -> bytes:
         """prioritizeNodes (telemetryscheduler.go:81-100) down to response
         bytes: any failure degrades to an empty priority list."""
         try:
@@ -269,7 +366,6 @@ class MetricsExtender:
                 component="extender",
             )
             return encode_host_priority_list([])
-        names = [node.name for node in args.nodes or []]
         compiled, view = self._device_policy(policy)
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
@@ -344,6 +440,8 @@ class MetricsExtender:
             return None
         violating = self._violating_nodes(policy, strategy)
         if not args.nodes:
+            if self.node_cache_capable and args.node_names:
+                return self._filter_node_names(policy, args.node_names, violating)
             klog.v(2).info_s("No nodes to compare", component="extender")
             return None
         filtered: List[Node] = []
@@ -363,6 +461,30 @@ class MetricsExtender:
             )
         return FilterResult(
             nodes=filtered, node_names=node_names, failed_nodes=failed, error=""
+        )
+
+    def _filter_node_names(
+        self, policy: TASPolicy, names: List[str], violating: Dict[str, None]
+    ) -> FilterResult:
+        """nodeCacheCapable Filter: answer with NodeNames only (the
+        kube-scheduler reads NodeNames from a nodeCacheCapable extender;
+        Nodes stays null).  Same trailing-"" construction as the Nodes
+        branch for uniform wire shape."""
+        failed: Dict[str, str] = {}
+        available = ""
+        for name in names:
+            if name in violating:
+                failed[name] = "Node violates"
+            else:
+                available += name + " "
+        node_names = available.split(" ")
+        if available:
+            klog.v(2).info_s(
+                f"Filtered nodes for {policy.name}: {available}",
+                component="extender",
+            )
+        return FilterResult(
+            nodes=None, node_names=node_names, failed_nodes=failed, error=""
         )
 
     def _violating_nodes(

@@ -1,0 +1,366 @@
+"""nodeCacheCapable wire mode + response-reuse caches: byte parity with
+the exact Python paths, staleness safety, and slim-HTTP edge cases."""
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest, Server
+from platform_aware_scheduling_tpu.native import get_wirec
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
+from platform_aware_scheduling_tpu.testing.builders import make_policy, rule
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+wirec = get_wirec()
+
+
+def build(node_cache_capable=True, values=None, dontschedule_target=75):
+    values = values or {"n1": 100, "n2": 50, "n3": 10, "n4": 70}
+    cache = AutoUpdatingCache()
+    mirror = TensorStateMirror()
+    mirror.attach(cache)
+    cache.write_policy(
+        "default",
+        "pol",
+        TASPolicy.from_obj(
+            make_policy(
+                "pol",
+                strategies={
+                    "scheduleonmetric": [rule("m", "GreaterThan", 0)],
+                    "dontschedule": [
+                        rule("m", "GreaterThan", dontschedule_target)
+                    ],
+                },
+            )
+        ),
+    )
+    cache.write_metric(
+        "m", {n: NodeMetric(value=Quantity(str(v))) for n, v in values.items()}
+    )
+    return cache, MetricsExtender(
+        cache, mirror=mirror, node_cache_capable=node_cache_capable
+    )
+
+
+def req(path, body):
+    return HTTPRequest(
+        method="POST",
+        path=path,
+        headers={"Content-Type": "application/json"},
+        body=body,
+    )
+
+
+def nn_body(names, pod="p"):
+    return json.dumps(
+        {
+            "Pod": {
+                "metadata": {
+                    "name": pod,
+                    "namespace": "default",
+                    "labels": {"telemetry-policy": "pol"},
+                }
+            },
+            "NodeNames": names,
+        }
+    ).encode()
+
+
+def nodes_body(names, pod="p"):
+    return json.dumps(
+        {
+            "Pod": {
+                "metadata": {
+                    "name": pod,
+                    "namespace": "default",
+                    "labels": {"telemetry-policy": "pol"},
+                }
+            },
+            "Nodes": {"items": [{"metadata": {"name": n}} for n in names]},
+        }
+    ).encode()
+
+
+class TestNodeNamesMode:
+    def test_prioritize_serves_node_names(self):
+        _, ext = build()
+        resp = ext.prioritize(req("/scheduler/prioritize", nn_body(["n1", "n3", "n2"])))
+        assert resp.status == 200
+        scored = json.loads(resp.body)
+        assert [e["Host"] for e in scored] == ["n2", "n3"] or [
+            e["Host"] for e in scored
+        ] == ["n1", "n2", "n3"]
+        # n1=100 violates dontschedule>75? No: dontschedule only affects
+        # Filter, not Prioritize (reference semantics) -> n1 first
+        assert scored[0]["Host"] == "n1"
+        assert scored[0]["Score"] == 10
+
+    def test_native_equals_python_nodenames(self, monkeypatch):
+        _, ext = build()
+        for names in (["n1", "n2", "n3", "n4"], ["n4", "ghost"], []):
+            body = nn_body(names)
+            native = ext.prioritize(req("/scheduler/prioritize", body))
+            monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+            python = ext.prioritize(req("/scheduler/prioritize", body))
+            monkeypatch.delenv("PAS_TPU_NO_NATIVE")
+            assert native.status == python.status, names
+            assert native.body == python.body, names
+
+    def test_quirk_preserved_when_capability_off(self):
+        # reference TAS ignores NodeNames entirely: empty 200
+        _, ext = build(node_cache_capable=False)
+        resp = ext.prioritize(req("/scheduler/prioritize", nn_body(["n1"])))
+        assert resp.status == 200
+        assert resp.body == b""
+
+    def test_filter_node_names_mode(self):
+        _, ext = build()
+        resp = ext.filter(req("/scheduler/filter", nn_body(["n1", "n2", "n3"])))
+        assert resp.status == 200
+        result = json.loads(resp.body)
+        # n1=100 > 75 violates; n2/n3 pass; trailing "" quirk preserved
+        assert result["Nodes"] is None
+        assert result["NodeNames"] == ["n2", "n3", ""]
+        assert result["FailedNodes"] == {"n1": "Node violates"}
+
+    def test_nodes_takes_precedence_over_nodenames(self, monkeypatch):
+        _, ext = build()
+        body = json.dumps(
+            {
+                "Pod": {
+                    "metadata": {
+                        "namespace": "default",
+                        "labels": {"telemetry-policy": "pol"},
+                    }
+                },
+                "Nodes": {"items": [{"metadata": {"name": "n2"}}]},
+                "NodeNames": ["n1", "n3"],
+            }
+        ).encode()
+        native = ext.prioritize(req("/scheduler/prioritize", body))
+        assert [e["Host"] for e in json.loads(native.body)] == ["n2"]
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.prioritize(req("/scheduler/prioritize", body))
+        assert native.body == python.body
+
+
+@pytest.mark.skipif(wirec is None, reason="no C toolchain for _wirec")
+class TestResponseReuseCache:
+    def test_rotating_pods_hit_cache_with_identical_bytes(self):
+        _, ext = build()
+        names = ["n1", "n2", "n3", "n4"]
+        first = ext.prioritize(req("/scheduler/prioritize", nn_body(names, pod="a")))
+        assert len(ext.fastpath._responses) == 1
+        second = ext.prioritize(req("/scheduler/prioritize", nn_body(names, pod="b")))
+        assert second.body == first.body
+        assert len(ext.fastpath._responses) == 1  # reused, not re-stored
+
+    def test_different_candidates_not_conflated(self):
+        _, ext = build()
+        a = ext.prioritize(req("/scheduler/prioritize", nn_body(["n1", "n2"])))
+        b = ext.prioritize(req("/scheduler/prioritize", nn_body(["n3", "n4"])))
+        assert a.body != b.body
+        hosts_b = [e["Host"] for e in json.loads(b.body)]
+        assert set(hosts_b) == {"n3", "n4"}
+
+    def test_metric_update_invalidates_prioritize_cache(self):
+        cache, ext = build()
+        names = ["n1", "n2", "n3"]
+        before = ext.prioritize(req("/scheduler/prioritize", nn_body(names)))
+        assert json.loads(before.body)[0]["Host"] == "n1"
+        cache.write_metric(
+            "m",
+            {
+                "n1": NodeMetric(value=Quantity("1")),
+                "n2": NodeMetric(value=Quantity("999")),
+                "n3": NodeMetric(value=Quantity("5")),
+            },
+        )
+        after = ext.prioritize(req("/scheduler/prioritize", nn_body(names)))
+        assert json.loads(after.body)[0]["Host"] == "n2"
+
+    def test_filter_cache_hits_and_invalidates(self, monkeypatch):
+        cache, ext = build()
+        names = ["n1", "n2", "n3"]
+        body = nn_body(names)
+        first = ext.filter(req("/scheduler/filter", body))
+        assert json.loads(first.body)["FailedNodes"] == {"n1": "Node violates"}
+        assert len(ext.fastpath._filter_responses) == 1
+        # second request (different pod) hits the cache byte-for-byte
+        second = ext.filter(req("/scheduler/filter", nn_body(names, pod="q")))
+        assert second.body == first.body
+        # python path agrees
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.filter(req("/scheduler/filter", body))
+        monkeypatch.delenv("PAS_TPU_NO_NATIVE")
+        assert python.body == first.body
+        # metric change flips the violation set -> fresh bytes
+        cache.write_metric(
+            "m",
+            {
+                "n1": NodeMetric(value=Quantity("1")),
+                "n2": NodeMetric(value=Quantity("999")),
+                "n3": NodeMetric(value=Quantity("5")),
+            },
+        )
+        third = ext.filter(req("/scheduler/filter", body))
+        assert json.loads(third.body)["FailedNodes"] == {"n2": "Node violates"}
+
+    def test_filter_nodes_mode_cache_parity(self, monkeypatch):
+        cache, ext = build()
+        names = ["n1", "n2", "n3"]
+        body1 = nodes_body(names, pod="a")
+        body2 = nodes_body(names, pod="b")
+        first = ext.filter(req("/scheduler/filter", body1))
+        second = ext.filter(req("/scheduler/filter", body2))
+        assert second.body == first.body
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.filter(req("/scheduler/filter", body1))
+        assert python.body == first.body
+
+
+class TestSlimHTTPServer:
+    def _serve(self):
+        _, ext = build()
+        server = Server(ext)
+        server.start_server(port="0", unsafe=True, host="127.0.0.1", block=False)
+        server.wait_ready()
+        return server
+
+    def test_pipelined_requests(self):
+        server = self._serve()
+        try:
+            body = nn_body(["n1", "n2"])
+            head = (
+                f"POST /scheduler/prioritize HTTP/1.1\r\n"
+                f"Host: x\r\nContent-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode()
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(head + body + head + body)  # two pipelined requests
+            data = b""
+            while data.count(b"HTTP/1.1 200") < 2:
+                chunk = sock.recv(65536)
+                assert chunk, data[:200]
+                data += chunk
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_expect_100_continue(self):
+        server = self._serve()
+        try:
+            body = nn_body(["n1"])
+            head = (
+                f"POST /scheduler/prioritize HTTP/1.1\r\n"
+                f"Host: x\r\nContent-Type: application/json\r\n"
+                f"Expect: 100-continue\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode()
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(head)
+            first = sock.recv(65536)
+            assert b"100 Continue" in first
+            sock.sendall(body)
+            data = first
+            while b"HTTP/1.1 200" not in data:
+                data += sock.recv(65536)
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_connection_close_honored(self):
+        server = self._serve()
+        try:
+            body = nn_body(["n1"])
+            head = (
+                f"POST /scheduler/prioritize HTTP/1.1\r\n"
+                f"Host: x\r\nContent-Type: application/json\r\n"
+                f"Connection: close\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode()
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(head + body)
+            data = b""
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            assert b"HTTP/1.1 200" in data
+            assert b"Connection: close" in data
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_bad_request_line(self):
+        server = self._serve()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(b"GARBAGE\r\n\r\n")
+            data = sock.recv(65536)
+            assert b"400" in data
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_bad_content_length(self):
+        server = self._serve()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(
+                b"POST /scheduler/prioritize HTTP/1.1\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: nope\r\n\r\n"
+            )
+            data = sock.recv(65536)
+            assert b"400" in data
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_negative_content_length_rejected(self):
+        server = self._serve()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(
+                b"POST /scheduler/prioritize HTTP/1.1\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: -1\r\n\r\n"
+            )
+            data = sock.recv(65536)
+            assert b"400" in data
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_handler_exception_returns_500(self):
+        class Boom:
+            def prioritize(self, request):
+                raise RuntimeError("boom")
+
+            filter = bind = prioritize
+
+        server = Server(Boom())
+        server.start_server(port="0", unsafe=True, host="127.0.0.1", block=False)
+        server.wait_ready()
+        try:
+            import http.client
+
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request(
+                "POST", "/scheduler/prioritize", body=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 500
+            resp.read()
+            conn.close()
+        finally:
+            server.shutdown()
