@@ -27,6 +27,10 @@ if TYPE_CHECKING:  # pragma: no cover
     from platform_aware_scheduling_tpu.extender.types import Scheduler
 
 MAX_CONTENT_LENGTH = 1 * 1000 * 1000 * 1000  # 1 GB (scheduler.go:30)
+# request-head ceiling (status line + all headers); net/http's default is
+# 1 MB, http.server enforced 64 KiB lines — without a cap a client that
+# streams endless header bytes grows the buffer without bound
+MAX_HEAD_LENGTH = 64 * 1024
 READ_HEADER_TIMEOUT_S = 5.0
 WRITE_TIMEOUT_S = 10.0
 
@@ -87,6 +91,7 @@ _STATUS_REASON = {
     400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
+    431: "Request Header Fields Too Large",
     500: "Internal Server Error",
 }
 
@@ -116,6 +121,9 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
             sock.settimeout(READ_HEADER_TIMEOUT_S)
             head_end = buf.find(b"\r\n\r\n")
             while head_end < 0:
+                if len(buf) > MAX_HEAD_LENGTH:
+                    self._send_simple(sock, 431, close=True)
+                    return
                 try:
                     chunk = sock.recv(self.rbufsize)
                 except (TimeoutError, OSError):
@@ -124,6 +132,9 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
                     return
                 buf += chunk
                 head_end = buf.find(b"\r\n\r\n")
+            if head_end > MAX_HEAD_LENGTH:
+                self._send_simple(sock, 431, close=True)
+                return
             head = bytes(buf[:head_end])
             del buf[: head_end + 4]
             lines = head.split(b"\r\n")
@@ -139,21 +150,40 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
                 self._send_simple(sock, 400, close=True)
                 return
             headers: Dict[str, str] = {}
+            content_lengths = []
+            bad_head = False
             for line in lines[1:]:
                 name, sep, value = line.partition(b":")
-                if sep:
-                    headers[name.decode("latin-1")] = value.strip().decode(
-                        "latin-1"
-                    )
+                if not sep:
+                    continue
+                if name != name.rstrip(b" \t"):
+                    # whitespace before the colon lets 'Transfer-Encoding :'
+                    # dodge the checks below (RFC 7230 §3.2.4 says reject)
+                    bad_head = True
+                    break
+                key = name.decode("latin-1")
+                headers[key] = value.strip().decode("latin-1")
+                if key.lower() == "content-length":
+                    content_lengths.append(headers[key])
             lowered = {k.lower(): v for k, v in headers.items()}
-            try:
-                length = int(lowered.get("content-length") or 0)
-            except ValueError:
+            if bad_head or "transfer-encoding" in lowered:
+                # chunked bodies aren't deframed here; leaving one in the
+                # keep-alive buffer would desync pipelining (request
+                # smuggling surface behind a proxy) — reject outright
                 self._send_simple(sock, 400, close=True)
                 return
-            if length < 0:  # negative framing would desync the buffer
+            if len(set(content_lengths)) > 1:
+                # differing duplicates MUST 400 (RFC 7230 §3.3.2): a
+                # first-wins proxy in front would frame differently
                 self._send_simple(sock, 400, close=True)
                 return
+            raw_length = content_lengths[0] if content_lengths else "0"
+            # strict framing: ASCII digits only (int() would accept '+5',
+            # '5_0', whitespace — all desync vectors)
+            if not (raw_length.isascii() and raw_length.isdigit()):
+                self._send_simple(sock, 400, close=True)
+                return
+            length = int(raw_length)
             if length > MAX_CONTENT_LENGTH:
                 # parity with the ContentLength middleware check: refuse to
                 # slurp oversized bodies

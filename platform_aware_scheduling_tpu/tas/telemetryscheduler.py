@@ -12,8 +12,11 @@ telemetryscheduler.go.  Wire behavior is reproduced quirk-for-quirk
   * a nil filter result is 404 with body ``null`` (:170-175);
   * FailedNodes messages are the literal "Node violates" (the reference's
     one-element strings.Join never uses its separator, :206);
-  * FilterResult.NodeNames is built by splitting "n1 n2 " on spaces and so
-    carries a trailing empty string (:212);
+  * in the legacy Nodes branch FilterResult.NodeNames is built by
+    splitting "n1 n2 " on spaces and so carries a trailing empty string
+    (:212) — harmless there because the scheduler ignores NodeNames; the
+    nodeCacheCapable branch instead emits exactly the passing names (the
+    scheduler consumes them and rejects unknown entries);
   * Bind is 404 — TAS does not bind (:179-181).
 
 Two execution paths produce identical wire bytes:
@@ -234,6 +237,12 @@ class MetricsExtender:
                 return HTTPResponse.json(body)
             return parsed, violations, use_node_names
         except (ValueError, TypeError):
+            return None
+        except Exception as exc:
+            # device trouble (XlaRuntimeError, OOM, ...) must never fail
+            # the verb: degrade to the exact path, whose host fallback
+            # owns the response — same invariant Prioritize keeps
+            klog.error("filter cache probe failed, exact path: %s", exc)
             return None
 
     def bind(self, request: HTTPRequest) -> HTTPResponse:
@@ -468,17 +477,22 @@ class MetricsExtender:
     ) -> FilterResult:
         """nodeCacheCapable Filter: answer with NodeNames only (the
         kube-scheduler reads NodeNames from a nodeCacheCapable extender;
-        Nodes stays null).  Same trailing-"" construction as the Nodes
-        branch for uniform wire shape."""
+        Nodes stays null).  Unlike the legacy Nodes branch — where the
+        scheduler ignores NodeNames and the trailing-"" split quirk is
+        harmless wire trivia — here kube-scheduler consumes every entry
+        and rejects names absent from its input list, so the list must
+        hold exactly the passing names (the reference's own
+        nodeCacheCapable extender appends cleanly, GAS scheduler.go:
+        467-476)."""
         failed: Dict[str, str] = {}
-        available = ""
+        node_names: List[str] = []
         for name in names:
             if name in violating:
                 failed[name] = "Node violates"
             else:
-                available += name + " "
-        node_names = available.split(" ")
-        if available:
+                node_names.append(name)
+        if node_names:
+            available = " ".join(node_names)
             klog.v(2).info_s(
                 f"Filtered nodes for {policy.name}: {available}",
                 component="extender",

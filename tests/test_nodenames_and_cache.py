@@ -124,9 +124,40 @@ class TestNodeNamesMode:
         resp = ext.filter(req("/scheduler/filter", nn_body(["n1", "n2", "n3"])))
         assert resp.status == 200
         result = json.loads(resp.body)
-        # n1=100 > 75 violates; n2/n3 pass; trailing "" quirk preserved
+        # n1=100 > 75 violates; n2/n3 pass.  No trailing "": in
+        # nodeCacheCapable mode the scheduler consumes NodeNames and
+        # rejects names outside its input list (the split-quirk stays
+        # confined to the legacy Nodes branch).
         assert result["Nodes"] is None
-        assert result["NodeNames"] == ["n2", "n3", ""]
+        assert result["NodeNames"] == ["n2", "n3"]
+        assert result["FailedNodes"] == {"n1": "Node violates"}
+
+    def test_filter_node_names_all_violating_is_empty_list(self):
+        _, ext = build(dontschedule_target=5)  # every node violates
+        resp = ext.filter(req("/scheduler/filter", nn_body(["n1", "n2", "n3"])))
+        assert resp.status == 200
+        result = json.loads(resp.body)
+        assert result["NodeNames"] == []  # not [""]
+        assert set(result["FailedNodes"]) == {"n1", "n2", "n3"}
+
+    def test_filter_device_error_degrades_to_exact_path(self, monkeypatch):
+        # a device/JAX runtime error in the cache probe (not just
+        # ValueError/TypeError) must fall back to the exact path, never
+        # surface as a 500 (round-3 advisor finding)
+        _, ext = build()
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        monkeypatch.setattr(
+            ext.fastpath,
+            "violation_set",
+            lambda *a, **k: (_ for _ in ()).throw(XlaRuntimeError("oom")),
+        )
+        resp = ext.filter(req("/scheduler/filter", nn_body(["n1", "n2", "n3"])))
+        assert resp.status == 200
+        result = json.loads(resp.body)
+        assert result["NodeNames"] == ["n2", "n3"]
         assert result["FailedNodes"] == {"n1": "Node violates"}
 
     def test_nodes_takes_precedence_over_nodenames(self, monkeypatch):
@@ -336,6 +367,119 @@ class TestSlimHTTPServer:
             )
             data = sock.recv(65536)
             assert b"400" in data
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_lenient_content_length_forms_rejected(self):
+        # int() would accept these; strict ASCII-digit framing must not
+        server = self._serve()
+        try:
+            # note " 7" is absent: OWS around header values is stripped at
+            # parse time (legal per RFC 7230), leaving plain digits
+            for bad in (b"+5", b"5_0", b"0x10"):
+                sock = socket.create_connection(("127.0.0.1", server.port))
+                sock.sendall(
+                    b"POST /scheduler/prioritize HTTP/1.1\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + bad + b"\r\n\r\n"
+                )
+                data = sock.recv(65536)
+                assert b"400" in data, bad
+                sock.close()
+        finally:
+            server.shutdown()
+
+    def test_header_name_trailing_whitespace_rejected(self):
+        # 'Transfer-Encoding : chunked' must not dodge the TE check
+        server = self._serve()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(
+                b"POST /scheduler/prioritize HTTP/1.1\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Transfer-Encoding : chunked\r\n\r\n"
+            )
+            data = sock.recv(65536)
+            assert b"400" in data
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_conflicting_duplicate_content_length_rejected(self):
+        server = self._serve()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(
+                b"POST /scheduler/prioritize HTTP/1.1\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: 10\r\n"
+                b"Content-Length: 0\r\n\r\n"
+            )
+            data = sock.recv(65536)
+            assert b"400" in data
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_transfer_encoding_rejected(self):
+        server = self._serve()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(
+                b"POST /scheduler/prioritize HTTP/1.1\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n0\r\n\r\n"
+            )
+            data = b""
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            assert b"400" in data
+            assert b"Connection: close" in data
+            sock.close()
+        finally:
+            server.shutdown()
+
+    def test_unbounded_header_stream_rejected(self):
+        server = self._serve()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            sock.sendall(b"POST /scheduler/prioritize HTTP/1.1\r\n")
+            filler = b"X-Pad: " + b"a" * 8000 + b"\r\n"
+            data = b""
+            # interleave sends with short reads: once the server answers
+            # 431 we stop sending, so it never closes with unread bytes
+            # in its buffer (close-with-pending-data would RST and could
+            # discard the buffered response)
+            for _ in range(12):  # ~96 KB of header bytes, no blank line
+                try:
+                    sock.sendall(filler)
+                except OSError:
+                    break
+                sock.settimeout(0.2)
+                try:
+                    chunk = sock.recv(65536)
+                    if chunk:
+                        data += chunk
+                        break
+                except TimeoutError:
+                    continue
+                except OSError:
+                    break
+            sock.settimeout(5.0)
+            try:
+                while b"\r\n\r\n" not in data:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+            except OSError:
+                pass
+            assert b"431" in data
             sock.close()
         finally:
             server.shutdown()
