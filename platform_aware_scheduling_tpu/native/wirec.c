@@ -1035,12 +1035,20 @@ error:
 /* ------------------------------------------------------------------ */
 /* select_encode                                                       */
 
+/* decimal render of score + '}' — snprintf is ~10x slower and sits on the
+ * per-row hot path of a 10k-entry response */
 static int put_score(Buf *b, long score) {
     char tmp[24];
-    int len = snprintf(tmp, sizeof(tmp), "%ld}", score);
-    if (len < 0) return -1;
-    if (len >= (int)sizeof(tmp)) len = (int)sizeof(tmp) - 1;  /* truncated */
-    return buf_put(b, tmp, (size_t)len);
+    char *end = tmp + sizeof(tmp);
+    char *p = end;
+    *--p = '}';
+    unsigned long v = score < 0 ? (unsigned long)(-score) : (unsigned long)score;
+    do {
+        *--p = (char)('0' + (v % 10));
+        v /= 10;
+    } while (v);
+    if (score < 0) *--p = '-';
+    return buf_put(b, p, (size_t)(end - p));
 }
 
 static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
@@ -1172,6 +1180,180 @@ error:
 }
 
 /* ------------------------------------------------------------------ */
+/* filter_encode                                                       */
+
+/* Build the NodeNames-mode FilterResult response straight from the
+ * parsed body + name table + a per-row violation bitmask:
+ *
+ *   {"Nodes": null, "NodeNames": [...passing...],
+ *    "FailedNodes": {"<name>": "Node violates", ...}, "Error": ""}\n
+ *
+ * Byte-identical to FilterResult.to_json() over the exact Python path's
+ * result for the same request (json.dumps separators/ensure_ascii):
+ * candidates keep request order; a name can be emitted raw iff its slice
+ * has no escapes and every byte is in [0x20,0x7e] (exactly the set
+ * json.dumps re-emits unchanged); duplicate violating names collapse to
+ * one FailedNodes entry at first-occurrence position (dict semantics);
+ * names absent from the table never violate (they pass through). */
+static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
+    PyObject *parsed_obj, *table_obj, *mask_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &parsed_obj, &table_obj, &mask_obj))
+        return NULL;
+    if (!PyObject_TypeCheck(parsed_obj, &ParsedArgs_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected ParsedArgs");
+        return NULL;
+    }
+    if (!PyObject_TypeCheck(table_obj, &NameTable_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected NameTable");
+        return NULL;
+    }
+    ParsedArgs *pa = (ParsedArgs *)parsed_obj;
+    NameTable *t = (NameTable *)table_obj;
+    Py_buffer viol;
+    if (PyObject_GetBuffer(mask_obj, &viol, PyBUF_SIMPLE) < 0) return NULL;
+    if (viol.len < t->n_rows) {
+        PyBuffer_Release(&viol);
+        PyErr_SetString(PyExc_ValueError, "violation mask shorter than table");
+        return NULL;
+    }
+    const uint8_t *vmask = (const uint8_t *)viol.buf;
+    const StrSlice *cand = pa->nn_names;  /* NodeNames mode only */
+    Py_ssize_t num = pa->num_nn_names;
+    const char *body = PyBytes_AS_STRING(pa->body);
+
+    /* per-candidate resolution: row (or -1) and, for slices json.dumps
+     * would re-escape, a pre-encoded buffer built under the GIL */
+    Py_ssize_t *rows = NULL;
+    uint8_t *raw_ok = NULL;
+    uint8_t *seen = NULL;          /* FailedNodes dedup by row */
+    const char **enc_ptr = NULL;   /* encoded bytes for non-raw slices */
+    Py_ssize_t *enc_len = NULL;
+    PyObject **enc_obj = NULL;     /* owned refs backing enc_ptr */
+    Py_ssize_t n_enc = 0;
+    PyObject *json_mod = NULL, *res = NULL;
+    Buf out;
+    out.data = NULL;
+    int oom = 0;
+
+    rows = PyMem_Malloc((size_t)(num ? num : 1) * sizeof(Py_ssize_t));
+    raw_ok = PyMem_Malloc((size_t)(num ? num : 1));
+    seen = PyMem_Calloc((size_t)t->n_rows + 1, 1);
+    if (!rows || !raw_ok || !seen) { PyErr_NoMemory(); goto done; }
+
+    size_t span_bytes = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t k = 0; k < num; k++) {
+        const StrSlice *sl = &cand[k];
+        int ok = !sl->escaped;
+        if (ok) {
+            const unsigned char *p = (const unsigned char *)body + sl->off;
+            for (Py_ssize_t j = 0; j < sl->len; j++) {
+                if (p[j] < 0x20 || p[j] >= 0x7f) { ok = 0; break; }
+            }
+        }
+        raw_ok[k] = (uint8_t)ok;
+        if (ok) {
+            rows[k] = table_lookup(t, body + sl->off, sl->len);
+            span_bytes += (size_t)sl->len;
+        } else {
+            rows[k] = -1;  /* resolved under the GIL below */
+            n_enc++;
+        }
+    }
+    Py_END_ALLOW_THREADS
+
+    if (n_enc) {
+        enc_ptr = PyMem_Calloc((size_t)num, sizeof(char *));
+        enc_len = PyMem_Calloc((size_t)num, sizeof(Py_ssize_t));
+        enc_obj = PyMem_Calloc((size_t)num, sizeof(PyObject *));
+        if (!enc_ptr || !enc_len || !enc_obj) { PyErr_NoMemory(); goto done; }
+        json_mod = PyImport_ImportModule("json");
+        if (!json_mod) goto done;
+        for (Py_ssize_t k = 0; k < num; k++) {
+            if (raw_ok[k]) continue;
+            PyObject *u = slice_to_unicode(pa->body, &cand[k]);
+            if (!u) goto done;
+            Py_ssize_t ulen;
+            const char *us = PyUnicode_AsUTF8AndSize(u, &ulen);
+            if (!us) { Py_DECREF(u); goto done; }
+            rows[k] = table_lookup(t, us, ulen);
+            PyObject *e = PyObject_CallMethod(json_mod, "dumps", "O", u);
+            Py_DECREF(u);
+            if (!e) goto done;
+            /* keep the utf-8 of the encoded form alive via a bytes ref */
+            PyObject *eb = PyUnicode_AsUTF8String(e);
+            Py_DECREF(e);
+            if (!eb) goto done;
+            enc_obj[k] = eb;
+            enc_ptr[k] = PyBytes_AS_STRING(eb);
+            enc_len[k] = PyBytes_GET_SIZE(eb);
+            span_bytes += (size_t)enc_len[k];
+        }
+    }
+
+    Py_BEGIN_ALLOW_THREADS
+    /* "name", -> len+4 each; failed entry adds ': "Node violates"' (18) */
+    if (buf_init(&out, 96 + span_bytes + (size_t)num * 24) < 0) oom = 1;
+    if (!oom && buf_put(&out, "{\"Nodes\": null, \"NodeNames\": [", 30) < 0)
+        oom = 1;
+    int first = 1;
+    for (Py_ssize_t k = 0; !oom && k < num; k++) {
+        Py_ssize_t row = rows[k];
+        if (row >= 0 && vmask[row]) continue;  /* violating -> FailedNodes */
+        if (!first && buf_put(&out, ", ", 2) < 0) { oom = 1; break; }
+        first = 0;
+        if (raw_ok[k]) {
+            const StrSlice *sl = &cand[k];
+            if (buf_put(&out, "\"", 1) < 0 ||
+                buf_put(&out, body + sl->off, (size_t)sl->len) < 0 ||
+                buf_put(&out, "\"", 1) < 0)
+                oom = 1;
+        } else {
+            if (buf_put(&out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
+        }
+    }
+    if (!oom && buf_put(&out, "], \"FailedNodes\": {", 19) < 0) oom = 1;
+    first = 1;
+    for (Py_ssize_t k = 0; !oom && k < num; k++) {
+        Py_ssize_t row = rows[k];
+        if (row < 0 || !vmask[row] || seen[row]) continue;
+        seen[row] = 1;
+        if (!first && buf_put(&out, ", ", 2) < 0) { oom = 1; break; }
+        first = 0;
+        if (raw_ok[k]) {
+            const StrSlice *sl = &cand[k];
+            if (buf_put(&out, "\"", 1) < 0 ||
+                buf_put(&out, body + sl->off, (size_t)sl->len) < 0 ||
+                buf_put(&out, "\"", 1) < 0)
+                oom = 1;
+        } else {
+            if (buf_put(&out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
+        }
+        if (!oom && buf_put(&out, ": \"Node violates\"", 17) < 0) oom = 1;
+    }
+    if (!oom && buf_put(&out, "}, \"Error\": \"\"}\n", 16) < 0) oom = 1;
+    Py_END_ALLOW_THREADS
+
+    if (oom) PyErr_NoMemory();
+    else res = PyBytes_FromStringAndSize(out.data, (Py_ssize_t)out.len);
+
+done:
+    if (out.data) buf_free(&out);
+    if (enc_obj) {
+        for (Py_ssize_t k = 0; k < num; k++) Py_XDECREF(enc_obj[k]);
+    }
+    PyMem_Free(enc_ptr);
+    PyMem_Free(enc_len);
+    PyMem_Free(enc_obj);
+    Py_XDECREF(json_mod);
+    PyMem_Free(rows);
+    PyMem_Free(raw_ok);
+    PyMem_Free(seen);
+    PyBuffer_Release(&viol);
+    return res;
+}
+
+/* ------------------------------------------------------------------ */
 
 static PyMethodDef wirec_methods[] = {
     {"parse_prioritize", wirec_parse_prioritize, METH_O,
@@ -1181,6 +1363,9 @@ static PyMethodDef wirec_methods[] = {
     {"select_encode", wirec_select_encode, METH_VARARGS,
      "Assemble the Prioritize response bytes from a parsed body, a name "
      "table, and the global rank order (optional planned row promotion)."},
+    {"filter_encode", wirec_filter_encode, METH_VARARGS,
+     "Assemble the NodeNames-mode FilterResult response bytes from a "
+     "parsed body, a name table, and a per-row violation bitmask."},
     {NULL},
 };
 

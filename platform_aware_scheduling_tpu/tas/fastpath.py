@@ -127,6 +127,11 @@ class PrioritizeFastPath:
         self._responses: List[list] = []
         # same idea for Filter: [violation_set, use_nn, span_bytes, body]
         self._filter_responses: List[list] = []
+        # violation frozenset -> uint8-per-row bitmask bytes for the
+        # native filter_encode; keyed by OBJECT identity (sets are
+        # identity-stable per state) with the set itself held in the
+        # entry so an id can never alias a collected set
+        self._viol_masks: List[list] = []
 
     # -- table/cache maintenance ----------------------------------------------
 
@@ -345,6 +350,40 @@ class PrioritizeFastPath:
             with self._lock:
                 self._violations[sig] = cached
         return cached
+
+    def _violation_mask(self, violations: frozenset, n_rows: int) -> bytes:
+        """uint8-per-row bitmask form of a violation frozenset (the shape
+        ``_wirec.filter_encode`` consumes); cached per set identity."""
+        with self._lock:
+            for idx, entry in enumerate(self._viol_masks):
+                if entry[0] is violations and entry[1] == n_rows:
+                    if idx:
+                        self._viol_masks.insert(0, self._viol_masks.pop(idx))
+                    return entry[2]
+        mask = np.zeros(n_rows, dtype=np.uint8)
+        if violations:
+            rows = np.fromiter(
+                (i for i in violations if i < n_rows), dtype=np.int64
+            )
+            if rows.size:
+                mask[rows] = 1
+        mask_bytes = mask.tobytes()
+        with self._lock:
+            self._viol_masks.insert(0, [violations, n_rows, mask_bytes])
+            del self._viol_masks[self.RESPONSE_CACHE_SIZE :]
+        return mask_bytes
+
+    def filter_parsed(
+        self, wirec, view: DeviceView, parsed, violations: frozenset
+    ) -> bytes:
+        """Native NodeNames-mode Filter response: candidate row lookup,
+        violation partition, and byte assembly all happen in
+        ``_wirec.filter_encode`` over the parsed body's zero-copy name
+        slices — the Filter analog of :meth:`prioritize_parsed` (byte
+        parity with the exact path pinned by tests/test_wirec.py)."""
+        table = self._table_for(view)
+        mask = self._violation_mask(violations, len(table.node_names))
+        return wirec.filter_encode(parsed, table.native(wirec), mask)
 
     # -- filter response reuse -------------------------------------------------
 

@@ -235,6 +235,18 @@ class MetricsExtender:
             )
             if body is not None:
                 return HTTPResponse.json(body)
+            if use_node_names and hasattr(wirec, "filter_encode"):
+                # span-cache miss, NodeNames mode: build the response
+                # natively (row lookup + violation partition + byte
+                # assembly in C) instead of paying the exact path's
+                # full Python decode; the result seeds the span cache
+                body = self.fastpath.filter_parsed(
+                    wirec, view, parsed, violations
+                )
+                self.fastpath.filter_store(
+                    violations, use_node_names, parsed, body
+                )
+                return HTTPResponse.json(body)
             return parsed, violations, use_node_names
         except (ValueError, TypeError):
             return None

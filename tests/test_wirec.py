@@ -147,6 +147,133 @@ class TestParityWithPython:
         assert native.body == python.body
 
 
+def build_filter_extender(values=None, target=50, node_cache_capable=True):
+    """Extender with a dontschedule policy (GreaterThan target violates)
+    over a device mirror, in NodeNames mode."""
+    values = values or {"n1": 100, "n2": 50, "n3": 10, "n4": 70}
+    cache = AutoUpdatingCache()
+    mirror = TensorStateMirror()
+    mirror.attach(cache)
+    cache.write_policy(
+        "default",
+        "pol",
+        TASPolicy.from_obj(
+            make_policy(
+                "pol",
+                strategies={
+                    "scheduleonmetric": [rule("m", "GreaterThan", 0)],
+                    "dontschedule": [rule("m", "GreaterThan", target)],
+                },
+            )
+        ),
+    )
+    cache.write_metric(
+        "m", {n: NodeMetric(value=Quantity(str(v))) for n, v in values.items()}
+    )
+    return MetricsExtender(
+        cache, mirror=mirror, node_cache_capable=node_cache_capable
+    )
+
+
+def nn_body(names, policy="pol") -> bytes:
+    pod = {"metadata": {"name": "p", "namespace": "default"}}
+    if policy is not None:
+        pod["metadata"]["labels"] = {"telemetry-policy": policy}
+    return json.dumps({"Pod": pod, "NodeNames": names}).encode()
+
+
+class TestFilterNativeParity:
+    """filter_encode (native NodeNames Filter path) must produce the exact
+    bytes of the Python path for the same request."""
+
+    # (names, native path expected) — the probe needs a non-empty
+    # NodeNames list, so the empty case must take the exact path
+    CASES = [
+        (["n1", "n2", "n3", "n4"], True),       # mixed violating/passing
+        (["n3", "n2"], True),                    # none violating
+        (["n1", "n4"], True),                    # all violating
+        (["n1", "ghost", "n3"], True),           # unknown name passes
+        (["n1", "n1", "n4", "n2", "n1"], True),  # duplicate violators collapse
+        ([""], True),                            # empty-string name
+        ([], False),                             # empty list -> exact path
+    ]
+
+    @staticmethod
+    def _spy_filter_encode(monkeypatch):
+        """Count filter_encode invocations — the parity assertions are
+        vacuous if a wiring bug silently degrades every request to the
+        exact path (the probe's broad except would eat the error)."""
+        calls = []
+        real = wirec.filter_encode
+
+        def spy(*args):
+            calls.append(args)
+            return real(*args)
+
+        monkeypatch.setattr(wirec, "filter_encode", spy)
+        return calls
+
+    @pytest.mark.parametrize("case_idx", range(len(CASES)))
+    def test_filter_nodenames_parity(self, case_idx, monkeypatch):
+        names, native_expected = self.CASES[case_idx]
+        body = nn_body(names)
+        request = request_from(body)
+        calls = self._spy_filter_encode(monkeypatch)
+        native = build_filter_extender().filter(request)
+        assert len(calls) == (1 if native_expected else 0), names
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = build_filter_extender().filter(request)
+        assert native.status == python.status, names
+        assert native.body == python.body, names
+
+    def test_filter_escaped_unicode_names(self, monkeypatch):
+        names = ['we"ird\\name', "uniécode", "plain", "tab\tname", "\x7f"]
+        values = {n: (100 if i % 2 == 0 else 1) for i, n in enumerate(names)}
+        body = nn_body(names + ["uniécode", 'we"ird\\name'])
+        request = request_from(body)
+        calls = self._spy_filter_encode(monkeypatch)
+        native = build_filter_extender(values=values).filter(request)
+        assert len(calls) == 1
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = build_filter_extender(values=values).filter(request)
+        assert native.body == python.body
+        assert b"FailedNodes" in native.body
+
+    def test_filter_parity_at_scale(self, monkeypatch):
+        rng = np.random.default_rng(7)
+        names = [f"node-{i:04d}" for i in range(400)]
+        values = {n: int(rng.integers(0, 100)) for n in names}
+        calls = self._spy_filter_encode(monkeypatch)
+        for trial in range(4):
+            subset = list(rng.choice(names, size=150, replace=False))
+            body = nn_body(subset)
+            request = request_from(body)
+            native = build_filter_extender(values=values).filter(request)
+            assert len(calls) == trial + 1
+            monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+            python = build_filter_extender(values=values).filter(request)
+            monkeypatch.delenv("PAS_TPU_NO_NATIVE")
+            assert native.body == python.body
+
+    def test_filter_miss_then_hit_same_bytes(self, monkeypatch):
+        """Two identical requests: first builds natively (miss), second is
+        served from the span cache — byte-identical, one native encode."""
+        calls = self._spy_filter_encode(monkeypatch)
+        ext = build_filter_extender()
+        request = request_from(nn_body(["n1", "n2", "n3"]))
+        first = ext.filter(request)
+        second = ext.filter(request)
+        assert len(calls) == 1  # the second request was a span-cache hit
+        assert first.body == second.body
+        assert first.status == second.status == 200
+
+    def test_filter_encode_mask_shorter_than_table_raises(self):
+        parsed = wirec.parse_prioritize(nn_body(["n1"]))
+        table = wirec.build_table(["n1", "n2"])
+        with pytest.raises(ValueError):
+            wirec.filter_encode(parsed, table, b"\x01")
+
+
 class TestScannerStrictness:
     @pytest.mark.parametrize(
         "bad",
