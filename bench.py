@@ -47,57 +47,6 @@ def build_problem(rng):
     )
 
 
-def host_control(state, pods, n_pods):
-    """The reference's per-pod loop in exact host semantics: violation set
-    (OR over rules), then per pod: intersect candidates, sort by metric,
-    greedily take the best node with free capacity."""
-    m_hi = np.asarray(state.metric_values.hi).astype(np.int64)
-    m_lo = np.asarray(state.metric_values.lo).astype(np.int64)
-    matrix = (m_hi << 32) | m_lo
-    present = np.asarray(state.metric_present)
-    rules_row = np.asarray(state.dontschedule.metric_row)
-    rules_op = np.asarray(state.dontschedule.op_id)
-    t_hi = np.asarray(state.dontschedule.target.hi).astype(np.int64)
-    t_lo = np.asarray(state.dontschedule.target.lo).astype(np.int64)
-    rules_target = (t_hi << 32) | t_lo
-    rules_active = np.asarray(state.dontschedule.active)
-    capacity = list(np.asarray(state.capacity))
-    pod_rows = np.asarray(pods.metric_row)
-    pod_ops = np.asarray(pods.op_id)
-    candidates = np.asarray(pods.candidates)
-
-    start = time.perf_counter()
-    # dontschedule violation set, the cacheable part (computed once per
-    # sync period in the reference too)
-    violating = set()
-    for r in range(len(rules_row)):
-        if not rules_active[r]:
-            continue
-        row = rules_row[r]
-        for n in range(NUM_NODES):
-            if not present[row, n]:
-                continue
-            v = int(matrix[row, n])
-            t = int(rules_target[r])
-            op = int(rules_op[r])
-            if (op == 0 and v < t) or (op == 1 and v > t) or (op == 2 and v == t):
-                violating.add(n)
-    for p in range(n_pods):
-        row = pod_rows[p]
-        op = int(pod_ops[p])
-        cand = [
-            n
-            for n in range(NUM_NODES)
-            if candidates[p, n] and present[row, n] and n not in violating
-        ]
-        cand.sort(key=lambda n: int(matrix[row, n]), reverse=(op == 1))
-        for n in cand:
-            if capacity[n] > 0:
-                capacity[n] -= 1
-                break
-    return time.perf_counter() - start
-
-
 def batched_solve():
     """Device pods/s on the full 10k x 1k problem vs the fully-measured
     host control; returns (result fields, stderr context string)."""
@@ -153,8 +102,11 @@ def batched_solve():
     _ = np.asarray(out.assignment.node_for_pod)
     single_solve_s = time.perf_counter() - t0
 
-    # --- host control, fully measured (all pods, all nodes) ---
-    host_full_s = host_control(state, pods, NUM_PODS)
+    # --- host control, fully measured (all pods, all nodes); the single
+    # shared implementation lives in benchmarks/configs.py ---
+    from benchmarks.configs import _host_prioritize_control
+
+    host_full_s = _host_prioritize_control(state, pods, NUM_NODES, NUM_PODS)
     host_pods_per_s = NUM_PODS / host_full_s
 
     fields = {

@@ -39,6 +39,7 @@ Round-3 verdict additions:
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -198,27 +199,34 @@ def drive(
         return status, length
 
     def worker(widx: int):
-        sock = socket.create_connection(("127.0.0.1", port))
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        buf = bytearray()
         mine = []
         try:
-            for i in range(per_worker):
-                # disjoint per-worker slices: when len(bodies) == requests
-                # (miss tier) every request uses a distinct body, so the
-                # 0%-hit property holds under any concurrency
-                idx = (widx * per_worker + i) % len(bodies)
-                t0 = time.perf_counter()
-                sock.sendall(reqs[idx])
-                status, length = read_response(sock, buf)
-                dt = time.perf_counter() - t0
-                if status != 200 or length < min_payload:
-                    with lock:
-                        errors.append(f"status={status} len={length}")
-                    return
-                mine.append(dt)
+            sock = socket.create_connection(("127.0.0.1", port))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            buf = bytearray()
+            try:
+                for i in range(per_worker):
+                    # disjoint per-worker slices: when len(bodies) ==
+                    # requests (miss tier) every request uses a distinct
+                    # body, so 0%-hit holds under any concurrency
+                    idx = (widx * per_worker + i) % len(bodies)
+                    t0 = time.perf_counter()
+                    sock.sendall(reqs[idx])
+                    status, length = read_response(sock, buf)
+                    dt = time.perf_counter() - t0
+                    if status != 200 or length < min_payload:
+                        with lock:
+                            errors.append(f"status={status} len={length}")
+                        return
+                    mine.append(dt)
+            finally:
+                sock.close()
+        except OSError as exc:
+            # a dying server must fail the run loudly, not truncate the
+            # percentile sample behind the thread excepthook
+            with lock:
+                errors.append(f"socket: {exc!r}")
         finally:
-            sock.close()
             with lock:
                 latencies.extend(mine)
 
@@ -310,6 +318,9 @@ def _spawn_service(num_nodes: int, device: bool) -> tuple:
         ],
         stdout=subprocess.PIPE,
         text=True,
+        # resolve `-m benchmarks.http_load` from the repo root regardless
+        # of the caller's cwd (bench.py supports being launched anywhere)
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     line = proc.stdout.readline().strip()
     if not line.startswith("READY "):
@@ -337,7 +348,7 @@ def run(
         n_req = device_requests if device else control_requests
         try:
             side: Dict = {}
-            body_cache: Dict[tuple, List[bytes]] = {}
+            body_cache: Dict[str, List[bytes]] = {}
             miss_offset = 0
             for key, verb, mode, miss, conc in configs:
                 if miss and not device:
@@ -346,26 +357,27 @@ def run(
                     # control (recorded under the miss key for clarity)
                     side[key] = side[f"{verb}_{mode}_c{conc}"]
                     continue
-                # miss configs never share bodies (each gets a fresh
-                # rotation window so a span cached by the previous config
-                # can never be re-sent); hit configs share per wire mode
-                bkey = (mode, miss, miss_offset if miss else 0)
-                if bkey not in body_cache:
-                    # miss tier: one unique span per request so the hit
-                    # rate is 0% regardless of cache size; the extra
-                    # `warmup` rotations at the tail are used ONLY for
-                    # warmup, so warming can never seed the span cache
-                    # with a span the measured run will send
-                    body_cache[bkey] = make_bodies(
+                if miss:
+                    # single-use by construction: a fresh rotation window
+                    # per config (a span cached by the previous config can
+                    # never be re-sent), one unique span per request so
+                    # the hit rate is 0% regardless of cache size, plus
+                    # `warmup` extra rotations at the tail used ONLY for
+                    # warmup — never cached in body_cache (at 10k nodes a
+                    # config's bodies are ~70 MB; keeping four of them
+                    # alive would starve the serving subprocess)
+                    bodies = make_bodies(
                         names,
                         mode,
-                        rotate_span=miss,
-                        count=(n_req + warmup) if miss else POD_ROTATION,
+                        rotate_span=True,
+                        count=n_req + warmup,
                         rotate_offset=miss_offset,
                     )
-                if miss:
                     miss_offset += n_req + warmup
-                bodies = body_cache[bkey]
+                else:
+                    if mode not in body_cache:
+                        body_cache[mode] = make_bodies(names, mode)
+                    bodies = body_cache[mode]
                 warm = bodies[n_req:] if miss else bodies[:5]
                 drive(
                     port,
