@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""Black-box e2e scenarios against a live kind cluster prepared by
+e2e_setup_cluster.sh — capability parity with the reference suite
+(.github/e2e/e2e_test.go:89-205): Filter steers a pod off violating
+nodes, Prioritize picks the highest-metric node, Deschedule labels the
+violating node, and policy add/delete churn stays correct across 5
+rounds.  Metric truth comes from the static textfile fixtures
+(.github/scripts/policies/node{1,2,3}):
+
+    kind-worker   (node1): filter1 90, prioritize1 10,   deschedule1 1
+    kind-worker2  (node2): filter1 20, prioritize1 9999, deschedule1 9
+    kind-worker3  (node3): filter1 70, prioritize1 50,   deschedule1 2
+
+Everything is driven through kubectl so the suite has no dependency on
+cluster credentials plumbing; it exits non-zero on the first failure and
+dumps the TAS pod log.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+NAMESPACE = "default"
+WORKERS = ["pas-tpu-e2e-worker", "pas-tpu-e2e-worker2", "pas-tpu-e2e-worker3"]
+EXPECT_WINNER = "pas-tpu-e2e-worker2"  # highest prioritize1, lowest filter1
+
+
+def sh(*args, check=True, capture=True):
+    proc = subprocess.run(
+        list(args), capture_output=capture, text=True
+    )
+    if check and proc.returncode != 0:
+        raise RuntimeError(f"{args}: {proc.stderr or proc.stdout}")
+    return proc.stdout if capture else ""
+
+
+def kubectl(*args, **kwargs):
+    return sh("kubectl", *args, **kwargs)
+
+
+def policy(name, strategies):
+    rules = {
+        kind: {"rules": rule_list} for kind, rule_list in strategies.items()
+    }
+    return {
+        "apiVersion": "telemetry.intel.com/v1alpha1",
+        "kind": "TASPolicy",
+        "metadata": {"name": name, "namespace": NAMESPACE},
+        "spec": {"strategies": rules},
+    }
+
+
+def pod(name, policy_name):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": NAMESPACE,
+            "labels": {"telemetry-policy": policy_name},
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "sleeper",
+                    "image": "busybox:1.36",
+                    "command": ["sleep", "3600"],
+                    "resources": {
+                        "requests": {"telemetry/scheduling": "1"},
+                        "limits": {"telemetry/scheduling": "1"},
+                    },
+                }
+            ],
+        },
+    }
+
+
+def apply(obj):
+    subprocess.run(
+        ["kubectl", "apply", "-f", "-"],
+        input=json.dumps(obj),
+        text=True,
+        check=True,
+        capture_output=True,
+    )
+
+
+def delete(kind, name, wait=True):
+    args = ["delete", kind, name, "-n", NAMESPACE, "--ignore-not-found"]
+    if not wait:
+        args.append("--wait=false")
+    kubectl(*args)
+
+
+def wait_for_metrics(metric="filter1_metric", timeout=120):
+    """The reference polls the custom-metrics API up to 120 s before the
+    scenarios start (e2e_test.go:74-78, 242-255)."""
+    deadline = time.time() + timeout
+    path = f"/apis/custom.metrics.k8s.io/v1beta2/nodes/*/{metric}"
+    while time.time() < deadline:
+        try:
+            out = json.loads(kubectl("get", "--raw", path))
+            names = {i["describedObject"]["name"] for i in out.get("items", [])}
+            if set(WORKERS) <= names:
+                return
+        except (RuntimeError, json.JSONDecodeError):
+            pass
+        time.sleep(5)
+    raise RuntimeError(f"metric {metric} never covered all workers")
+
+
+def scheduled_node(pod_name, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = kubectl(
+            "get", "pod", pod_name, "-n", NAMESPACE,
+            "-o", "jsonpath={.spec.nodeName}", check=False,
+        ).strip()
+        if out:
+            return out
+        time.sleep(3)
+    raise RuntimeError(f"pod {pod_name} never scheduled")
+
+
+def node_labels(node):
+    return json.loads(
+        kubectl("get", "node", node, "-o", "jsonpath={.metadata.labels}")
+    )
+
+
+def run_filter_scenario(round_idx=0):
+    """dontschedule filter1_metric > 40 -> only worker2 (20) survives."""
+    name = f"filter1-policy-{round_idx}"
+    apply(policy(name, {
+        "dontschedule": [
+            {"metricname": "filter1_metric", "operator": "GreaterThan",
+             "target": 40}
+        ],
+    }))
+    pod_name = f"filter-pod-{round_idx}"
+    try:
+        time.sleep(5)  # one sync period: the policy's metrics register
+        apply(pod(pod_name, name))
+        landed = scheduled_node(pod_name)
+        assert landed == EXPECT_WINNER, f"filter: landed {landed}"
+        print(f"PASS filter (round {round_idx}): pod on {landed}")
+    finally:
+        delete("pod", pod_name, wait=False)
+        delete("taspolicy", name)
+
+
+def run_prioritize_scenario():
+    """scheduleonmetric prioritize1_metric GreaterThan -> worker2 (9999)
+    wins.  A dontschedule strategy rides along exactly as the reference's
+    fixture builder always adds one (e2e_test.go:299-301)."""
+    name = "prioritize1-policy"
+    apply(policy(name, {
+        "scheduleonmetric": [
+            {"metricname": "prioritize1_metric", "operator": "GreaterThan"}
+        ],
+        "dontschedule": [
+            {"metricname": "prioritize1_metric", "operator": "LessThan",
+             "target": 1}
+        ],
+    }))
+    try:
+        time.sleep(5)
+        apply(pod("prioritize-pod", name))
+        landed = scheduled_node("prioritize-pod")
+        assert landed == EXPECT_WINNER, f"prioritize: landed {landed}"
+        print(f"PASS prioritize: pod on {landed}")
+    finally:
+        delete("pod", "prioritize-pod", wait=False)
+        delete("taspolicy", name)
+
+
+def run_deschedule_scenario():
+    """deschedule deschedule1_metric > 8 -> worker2 (9) gets labeled
+    <policy>=violating within a few sync periods; the others never do."""
+    name = "deschedule1-policy"
+    apply(policy(name, {
+        "deschedule": [
+            {"metricname": "deschedule1_metric", "operator": "GreaterThan",
+             "target": 8}
+        ],
+    }))
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if node_labels(EXPECT_WINNER).get(name) == "violating":
+                break
+            time.sleep(5)
+        else:
+            raise AssertionError(f"{EXPECT_WINNER} never labeled violating")
+        for node in WORKERS:
+            if node != EXPECT_WINNER:
+                assert node_labels(node).get(name) != "violating", node
+        print(f"PASS deschedule: {EXPECT_WINNER} labeled violating")
+    finally:
+        delete("taspolicy", name)
+
+
+def run_policy_churn():
+    """Policy add/delete churn: the filter scenario must hold across 5
+    create/delete rounds (reference TestAddAndDeletePolicy,
+    e2e_test.go:203-205)."""
+    for i in range(1, 6):
+        run_filter_scenario(round_idx=i)
+    print("PASS policy add/delete churn (5 rounds)")
+
+
+def dump_tas_log():
+    try:
+        pods = kubectl(
+            "get", "pods", "-n", NAMESPACE, "-l", "app=tas",
+            "-o", "jsonpath={.items[*].metadata.name}",
+        ).split()
+        for name in pods:
+            print(f"--- log: {name} ---", file=sys.stderr)
+            print(
+                kubectl("logs", "-n", NAMESPACE, name, check=False),
+                file=sys.stderr,
+            )
+    except Exception as exc:  # log dump must never mask the real failure
+        print(f"log dump failed: {exc}", file=sys.stderr)
+
+
+def main():
+    wait_for_metrics()
+    try:
+        run_filter_scenario()
+        run_prioritize_scenario()
+        run_deschedule_scenario()
+        run_policy_churn()
+    except Exception:
+        dump_tas_log()
+        raise
+    print("e2e: all scenarios passed")
+
+
+if __name__ == "__main__":
+    main()

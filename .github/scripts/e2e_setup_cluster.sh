@@ -32,34 +32,16 @@ EOF
 }
 
 install_metrics_pipeline() {
-  helm repo add prometheus-community \
-    https://prometheus-community.github.io/helm-charts
-  helm repo update
-  helm install node-exporter prometheus-community/prometheus-node-exporter \
-    --set "extraArgs={--collector.textfile.directory=/host/tmp/node-metrics}" \
-    --set "extraHostPathMounts[0].name=textfile" \
-    --set "extraHostPathMounts[0].hostPath=/tmp/node-metrics" \
-    --set "extraHostPathMounts[0].mountPath=/host/tmp/node-metrics" \
-    --set "extraHostPathMounts[0].readOnly=true"
-  helm install prometheus prometheus-community/prometheus
-  cat > /tmp/adapter-values.yaml <<'EOF'
-rules:
-  custom:
-    - seriesQuery: '{__name__=~"^node_.*"}'
-      resources:
-        overrides:
-          instance:
-            resource: node
-      name:
-        matches: ^node_(.*)
-        as: ""
-      metricsQuery: <<.Series>>
-prometheus:
-  url: http://prometheus-server.default.svc
-  port: 80
-EOF
-  helm install prometheus-adapter prometheus-community/prometheus-adapter \
-    -f /tmp/adapter-values.yaml
+  # the three vendored charts (deploy/charts/README.md): node-exporter
+  # reads the textfile fixtures mounted by create_cluster, prometheus
+  # scrapes it, the adapter republishes node_* as Node custom metrics.
+  # Release names matter: the adapter's default prometheusURL points at
+  # the service the prometheus chart creates under release "prometheus".
+  helm install node-exporter "$REPO_ROOT/deploy/charts/node-exporter"
+  helm install prometheus "$REPO_ROOT/deploy/charts/prometheus" \
+    --set scrapeIntervalSeconds=2
+  helm install adapter "$REPO_ROOT/deploy/charts/custom-metrics-adapter" \
+    --set metricsRelistIntervalSeconds=2
 }
 
 deploy_tas() {

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Tear down the kind e2e cluster (reference .github/scripts/
+# e2e_teardown_cluster.sh equivalent).
+set -euo pipefail
+
+CLUSTER=${CLUSTER:-pas-tpu-e2e}
+kind delete cluster --name "$CLUSTER" || true

@@ -1,0 +1,68 @@
+"""collectd python plugin: package power from the intel_rapl powercap tree.
+
+Reads ``energy_uj`` for every ``intel-rapl:N`` package zone each interval
+and dispatches the microjoule delta as watts (plugin ``package``, type
+``power``, plugin_instance ``N``) — write_prometheus exposes that as
+``collectd_package_power{package="<N>"}``, the series the
+prometheus-adapter `power` rules map onto Node objects and the external
+metrics API (deploy/charts/custom-metrics-adapter).
+"""
+
+import os
+import time
+
+import collectd  # provided by the collectd python plugin runtime
+
+POWERCAP = "/sys/class/powercap"
+_state = {}
+
+
+def configure(conf):
+    global POWERCAP
+    for node in conf.children:
+        if node.key == "PowercapPath" and node.values:
+            POWERCAP = str(node.values[0])
+
+
+def _package_zones():
+    try:
+        entries = sorted(os.listdir(POWERCAP))
+    except OSError:
+        return
+    for entry in entries:
+        # top-level package zones only: "intel-rapl:0", not ":0:0" subzones
+        if entry.startswith("intel-rapl:") and entry.count(":") == 1:
+            yield entry
+
+
+def read(data=None):
+    now = time.time()
+    for zone in _package_zones():
+        path = os.path.join(POWERCAP, zone, "energy_uj")
+        try:
+            with open(path) as f:
+                energy_uj = int(f.read().strip())
+        except (OSError, ValueError):
+            continue
+        prev = _state.get(zone)
+        _state[zone] = (now, energy_uj)
+        if prev is None:
+            continue
+        t0, e0 = prev
+        dt = now - t0
+        if dt <= 0:
+            continue
+        delta = energy_uj - e0
+        if delta < 0:  # counter wrap: max_energy_range_uj rollover
+            continue
+        watts = delta / dt / 1e6
+        values = collectd.Values(
+            plugin="package",
+            plugin_instance=zone.split(":", 1)[1],
+            type="power",
+        )
+        values.dispatch(values=[watts])
+
+
+collectd.register_config(configure)
+collectd.register_read(read)

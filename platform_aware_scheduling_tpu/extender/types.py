@@ -1,11 +1,20 @@
 """Scheduler-extender protocol wire types.
 
-JSON field names are the Go-default (capitalized) names of the reference's
-re-implemented upstream types (reference extender/types.go:22-82): ``Args``
-carries ``Pod`` / ``Nodes`` / ``NodeNames``; ``FilterResult`` carries
-``Nodes`` / ``NodeNames`` / ``FailedNodes`` / ``Error``; priorities are
-``[{"Host": .., "Score": ..}]``; bindings use ``PodName`` / ``PodNamespace``
-/ ``PodUID`` / ``Node``.  Node objects are passed through as raw dicts so
+JSON field names EMITTED are the Go-default (capitalized) names of the
+reference's re-implemented upstream types (reference extender/types.go:
+22-82): ``FilterResult`` carries ``Nodes`` / ``NodeNames`` /
+``FailedNodes`` / ``Error``; priorities are ``[{"Host": .., "Score": ..}]``.
+
+Field names ACCEPTED are case-insensitive, because that is how the
+reference actually interoperates: the real kube-scheduler marshals the
+*upstream* extender types, whose json tags are lowercase (``pod`` /
+``nodes`` / ``nodenames``; bindings ``podName`` / ``podNamespace`` /
+``podUID`` / ``node`` — k8s.io/kube-scheduler/extender/v1), and the
+reference's untagged Go structs decode them only via encoding/json's
+case-insensitive field matching.  Go resolves every JSON key to its field
+case-insensitively in document order, later assignments overwriting
+earlier ones — reproduced here exactly (tests/test_golden_wire.py pins
+both key spellings).  Node objects are passed through as raw dicts so
 responses round-trip the scheduler's own node JSON exactly.
 """
 
@@ -22,6 +31,38 @@ class DecodeError(ValueError):
     """Raised when a request body cannot be decoded into the expected type."""
 
 
+def _loads_with_top_pairs(body: bytes):
+    """json.loads plus the TOP-LEVEL object's (key, value) pairs in raw
+    document order.  Needed for Go parity: a body carrying both an exact
+    duplicate and a case-variant of one field (``{"Pod":A,"pod":B,
+    "Pod":C}``) resolves to the LAST occurrence in document order in Go
+    (and in the native scanner), but json.loads collapses the exact
+    duplicates at their first position, which would re-order the fold."""
+    pairs_box: List[list] = []
+
+    def hook(pairs):
+        pairs_box.append(pairs)
+        return dict(pairs)
+
+    obj = json.loads(body, object_pairs_hook=hook)
+    top = pairs_box[-1] if (pairs_box and isinstance(obj, dict)) else []
+    return obj, top
+
+
+def _fold_keys(pairs, fields: Dict[str, str]) -> Dict[str, Any]:
+    """Go-unmarshal field resolution over raw-document-order (key, value)
+    pairs: each JSON key matches a struct field case-insensitively, later
+    assignments overwrite earlier ones.  ``fields`` maps lowercase wire
+    name -> canonical name; unmatched keys are dropped (as Go ignores
+    them)."""
+    out: Dict[str, Any] = {}
+    for key, value in pairs:
+        canonical = fields.get(key.lower())
+        if canonical is not None:
+            out[canonical] = value
+    return out
+
+
 @dataclass
 class Args:
     """Arguments for Filter/Prioritize (reference extender/types.go:41-50)."""
@@ -35,18 +76,25 @@ class Args:
     @classmethod
     def from_json(cls, body: bytes) -> "Args":
         try:
-            obj = json.loads(body)
+            obj, top_pairs = _loads_with_top_pairs(body)
         except (json.JSONDecodeError, UnicodeDecodeError) as exc:
             raise DecodeError(f"error decoding request: {exc}") from exc
         if not isinstance(obj, dict):
             raise DecodeError("error decoding request: not an object")
-        pod = Pod(obj.get("Pod") or {})
-        nodes_obj = obj.get("Nodes")
+        # accept both the reference's capitalized keys and the upstream
+        # kube-scheduler's lowercase tags ("pod"/"nodes"/"nodenames"),
+        # exactly as Go's case-insensitive unmarshal does (module doc)
+        folded = _fold_keys(
+            top_pairs,
+            {"pod": "Pod", "nodes": "Nodes", "nodenames": "NodeNames"},
+        )
+        pod = Pod(folded.get("Pod") or {})
+        nodes_obj = folded.get("Nodes")
         nodes = None
         if nodes_obj is not None:
             items = nodes_obj.get("items")
             nodes = [Node(item) for item in (items or [])]
-        node_names = obj.get("NodeNames")
+        node_names = folded.get("NodeNames")
         return cls(pod=pod, nodes=nodes, node_names=node_names)
 
     def to_json(self) -> bytes:
@@ -131,16 +179,28 @@ class BindingArgs:
     @classmethod
     def from_json(cls, body: bytes) -> "BindingArgs":
         try:
-            obj = json.loads(body)
+            obj, top_pairs = _loads_with_top_pairs(body)
         except (json.JSONDecodeError, UnicodeDecodeError) as exc:
             raise DecodeError(f"error decoding request: {exc}") from exc
         if not isinstance(obj, dict):
             raise DecodeError("error decoding request: not an object")
+        # upstream ExtenderBindingArgs tags are podName/podNamespace/
+        # podUID/node; the reference's untagged struct accepts either
+        # spelling via Go case-insensitive matching — so do we
+        folded = _fold_keys(
+            top_pairs,
+            {
+                "podname": "PodName",
+                "podnamespace": "PodNamespace",
+                "poduid": "PodUID",
+                "node": "Node",
+            },
+        )
         return cls(
-            pod_name=obj.get("PodName", ""),
-            pod_namespace=obj.get("PodNamespace", ""),
-            pod_uid=obj.get("PodUID", ""),
-            node=obj.get("Node", ""),
+            pod_name=folded.get("PodName", ""),
+            pod_namespace=folded.get("PodNamespace", ""),
+            pod_uid=folded.get("PodUID", ""),
+            node=folded.get("Node", ""),
         )
 
     def to_json(self) -> bytes:

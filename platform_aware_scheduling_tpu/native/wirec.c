@@ -191,6 +191,24 @@ static int scan_string(Scan *sc, StrSlice *out) {
 
 static int skip_value(Scan *sc);
 
+/* ASCII-case-insensitive key match against a lowercase literal.  The
+ * real kube-scheduler marshals the upstream extender types (lowercase
+ * tags: "pod"/"nodes"/"nodenames"); the reference's untagged Go structs
+ * accept them through encoding/json's case-insensitive field matching,
+ * so the Args TOP-LEVEL keys must match case-insensitively here too
+ * (inner object keys are Go-marshaled v1 structs — always canonical
+ * lowercase on the wire — and stay exact, like the Python path). */
+static int key_is_ci(const char *s, Py_ssize_t len, const char *lower_lit,
+                     Py_ssize_t lit_len) {
+    if (len != lit_len) return 0;
+    for (Py_ssize_t i = 0; i < len; i++) {
+        char a = s[i];
+        if (a >= 'A' && a <= 'Z') a += 32;
+        if (a != lower_lit[i]) return 0;
+    }
+    return 1;
+}
+
 static int skip_object(Scan *sc) {
     sc->i++; /* '{' */
     skip_ws(sc);
@@ -816,18 +834,16 @@ static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
             sc->i++;
             const char *kp = sc->s + key.off;
             int handled = 0;
-            if (key.len == 3 && memcmp(kp, "Pod", 3) == 0) {
+            if (key_is_ci(kp, key.len, "pod", 3)) {
                 if (scan_pod(sc, pa) < 0) { ok = 0; break; }
                 handled = 1;
-            } else if (key.len == 5 &&
-                       memcmp(kp, "Nodes", 5) == 0) {
+            } else if (key_is_ci(kp, key.len, "nodes", 5)) {
                 pa->nodes_present = 0;
                 pa->num_names = 0;
                 pa->nodes_span_start = pa->nodes_span_end = -1;
                 if (scan_nodes(sc, pa, &cap) < 0) { ok = 0; break; }
                 handled = 1;
-            } else if (key.len == 9 &&
-                       memcmp(kp, "NodeNames", 9) == 0) {
+            } else if (key_is_ci(kp, key.len, "nodenames", 9)) {
                 if (scan_node_names(sc, pa, &nn_cap) < 0) { ok = 0; break; }
                 handled = 1;
             }

@@ -1,0 +1,230 @@
+"""Deterministic generator for the golden REQUEST fixtures.
+
+Emits byte-exact bodies as Go would marshal them (compact separators,
+struct field order, zero-value quirks like ``"creationTimestamp":null``).
+Request fixtures are committed; re-run this after editing and commit the
+diff.  Response goldens are pinned separately by test_golden_wire.py
+against the canned cache state (see README.md here).
+
+Derivation: upstream k8s.io/kube-scheduler/extender/v1 ExtenderArgs /
+ExtenderBindingArgs tags for the `*_upstream*` family; the reference's
+untagged structs (extender/types.go:41-76) for `*_reference_style`.
+Object shapes follow what a kind cluster's API server serves for nodes
+and a scheduler-bound pod.
+"""
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+NODE_NAMES = ["gw-a", "gw-b", "gw-c", "gw-d"]
+
+
+def compact(obj) -> bytes:
+    # Go json.Marshal writes compact JSON with no spaces
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def pod_obj():
+    """A scheduler-POSTed v1.Pod, Go-marshaled: struct field order,
+    creationTimestamp null, status present-but-sparse."""
+    return {
+        "metadata": {
+            "name": "golden-pod",
+            "namespace": "default",
+            "uid": "8f2a7e6c-1d4b-4e9a-bb2e-000000000001",
+            "resourceVersion": "12345",
+            "creationTimestamp": None,
+            "labels": {"telemetry-policy": "golden-pol"},
+            "annotations": {
+                "kubernetes.io/psp": "kind-default",
+            },
+        },
+        "spec": {
+            "volumes": [
+                {
+                    "name": "kube-api-access-x7k2p",
+                    "projected": {
+                        "sources": [
+                            {
+                                "serviceAccountToken": {
+                                    "expirationSeconds": 3607,
+                                    "path": "token",
+                                }
+                            }
+                        ],
+                        "defaultMode": 420,
+                    },
+                }
+            ],
+            "containers": [
+                {
+                    "name": "workload",
+                    "image": "busybox:1.36",
+                    "command": ["sleep", "3600"],
+                    "resources": {
+                        "limits": {"telemetry/scheduling": "1"},
+                        "requests": {"telemetry/scheduling": "1"},
+                    },
+                    "volumeMounts": [
+                        {
+                            "name": "kube-api-access-x7k2p",
+                            "readOnly": True,
+                            "mountPath": "/var/run/secrets/kubernetes.io/serviceaccount",
+                        }
+                    ],
+                    "terminationMessagePath": "/dev/termination-log",
+                    "terminationMessagePolicy": "File",
+                    "imagePullPolicy": "IfNotPresent",
+                }
+            ],
+            "restartPolicy": "Always",
+            "terminationGracePeriodSeconds": 30,
+            "dnsPolicy": "ClusterFirst",
+            "serviceAccountName": "default",
+            "serviceAccount": "default",
+            "securityContext": {},
+            "schedulerName": "default-scheduler",
+            "tolerations": [
+                {
+                    "key": "node.kubernetes.io/not-ready",
+                    "operator": "Exists",
+                    "effect": "NoExecute",
+                    "tolerationSeconds": 300,
+                },
+                {
+                    "key": "node.kubernetes.io/unreachable",
+                    "operator": "Exists",
+                    "effect": "NoExecute",
+                    "tolerationSeconds": 300,
+                },
+            ],
+            "priority": 0,
+            "enableServiceLinks": True,
+            "preemptionPolicy": "PreemptLowerPriority",
+        },
+        "status": {"phase": "Pending", "qosClass": "BestEffort"},
+    }
+
+
+def node_obj(name: str, ordinal: int):
+    """A kind-style v1.Node as the API server serves it."""
+    return {
+        "metadata": {
+            "name": name,
+            "uid": f"6c0e7d2a-0000-4000-8000-00000000000{ordinal}",
+            "resourceVersion": str(9000 + ordinal),
+            "creationTimestamp": None,
+            "labels": {
+                "beta.kubernetes.io/arch": "amd64",
+                "beta.kubernetes.io/os": "linux",
+                "kubernetes.io/arch": "amd64",
+                "kubernetes.io/hostname": name,
+                "kubernetes.io/os": "linux",
+            },
+            "annotations": {
+                "kubeadm.alpha.kubernetes.io/cri-socket": "unix:///run/containerd/containerd.sock",
+                "node.alpha.kubernetes.io/ttl": "0",
+                "volumes.kubernetes.io/controller-managed-attach-detach": "true",
+            },
+        },
+        "spec": {
+            "podCIDR": f"10.244.{ordinal}.0/24",
+            "podCIDRs": [f"10.244.{ordinal}.0/24"],
+            "providerID": f"kind://docker/golden/{name}",
+        },
+        "status": {
+            "capacity": {
+                "cpu": "8",
+                "ephemeral-storage": "263174212Ki",
+                "hugepages-2Mi": "0",
+                "memory": "32658828Ki",
+                "pods": "110",
+            },
+            "allocatable": {
+                "cpu": "8",
+                "ephemeral-storage": "263174212Ki",
+                "hugepages-2Mi": "0",
+                "memory": "32658828Ki",
+                "pods": "110",
+            },
+            "conditions": [
+                {
+                    "type": "Ready",
+                    "status": "True",
+                    "lastHeartbeatTime": "2026-07-29T00:00:00Z",
+                    "lastTransitionTime": "2026-07-29T00:00:00Z",
+                    "reason": "KubeletReady",
+                    "message": "kubelet is posting ready status",
+                }
+            ],
+            "addresses": [
+                {"type": "InternalIP", "address": f"172.18.0.{ordinal + 2}"},
+                {"type": "Hostname", "address": name},
+            ],
+            "daemonEndpoints": {"kubeletEndpoint": {"Port": 10250}},
+            "nodeInfo": {
+                "machineID": f"machine-{ordinal}",
+                "systemUUID": f"system-{ordinal}",
+                "bootID": f"boot-{ordinal}",
+                "kernelVersion": "6.1.0",
+                "osImage": "Debian GNU/Linux 12 (bookworm)",
+                "containerRuntimeVersion": "containerd://1.7.1",
+                "kubeletVersion": "v1.30.0",
+                "kubeProxyVersion": "v1.30.0",
+                "operatingSystem": "linux",
+                "architecture": "amd64",
+            },
+        },
+    }
+
+
+def node_list():
+    return {
+        "metadata": {},
+        "items": [node_obj(n, i) for i, n in enumerate(NODE_NAMES)],
+    }
+
+
+def write(name: str, data: bytes) -> None:
+    with open(os.path.join(HERE, name), "wb") as f:
+        f.write(data)
+
+
+def main():
+    # upstream kube-scheduler spellings (lowercase tags, omitempty)
+    write(
+        "prioritize_request_upstream.json",
+        compact({"pod": pod_obj(), "nodes": node_list()}),
+    )
+    write(
+        "prioritize_request_upstream_nodenames.json",
+        compact({"pod": pod_obj(), "nodenames": NODE_NAMES}),
+    )
+    write(
+        "bind_request_upstream.json",
+        compact(
+            {
+                "podName": "golden-pod",
+                "podNamespace": "default",
+                "podUID": "8f2a7e6c-1d4b-4e9a-bb2e-000000000001",
+                "node": "gw-b",
+            }
+        ),
+    )
+    # the reference's untagged-struct spellings (all fields, null absents)
+    write(
+        "prioritize_request_reference_style.json",
+        compact(
+            {"Pod": pod_obj(), "Nodes": node_list(), "NodeNames": None}
+        ),
+    )
+    write(
+        "prioritize_request_reference_style_nodenames.json",
+        compact({"Pod": pod_obj(), "Nodes": None, "NodeNames": NODE_NAMES}),
+    )
+
+
+if __name__ == "__main__":
+    main()

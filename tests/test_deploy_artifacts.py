@@ -1,0 +1,190 @@
+"""Deploy artifacts must be renderable and well-formed without a cluster:
+the three metrics-pipeline charts (deploy/charts/*), the power demo
+(docs/power/), and the raw manifests (deploy/tas, deploy/gas).
+
+Chart templates restrict themselves to simple ``{{ .Values.* }}`` /
+``{{ .Release.* }}`` / ``{{ .Chart.Name }}`` substitutions (no
+conditionals/loops) precisely so this test can render them the way
+``helm template`` would and schema-check the output hermetically.
+"""
+
+import os
+import re
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHARTS = os.path.join(REPO, "deploy", "charts")
+CHART_NAMES = ["node-exporter", "prometheus", "custom-metrics-adapter"]
+
+_SUB = re.compile(r"\{\{\s*([^}]+?)\s*\}\}")
+
+
+def render(template: str, values: dict, release="rel", namespace="default",
+           chart="chart") -> str:
+    """The helm-subset renderer: resolves .Values paths, .Release.Name,
+    .Release.Namespace, .Chart.Name; anything else is an error."""
+
+    def resolve(match):
+        expr = match.group(1).strip()
+        if expr == ".Release.Name":
+            return release
+        if expr == ".Release.Namespace":
+            return namespace
+        if expr == ".Chart.Name":
+            return chart
+        if expr.startswith(".Values."):
+            node = values
+            for part in expr[len(".Values."):].split("."):
+                assert isinstance(node, dict) and part in node, (
+                    f"unresolved values path {expr}"
+                )
+                node = node[part]
+            assert not isinstance(node, (dict, list)), f"non-scalar {expr}"
+            return str(node)
+        raise AssertionError(f"template uses unsupported construct: {expr}")
+
+    return _SUB.sub(resolve, template)
+
+
+def chart_docs(chart_dir: str):
+    """All rendered YAML documents of one chart."""
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    tdir = os.path.join(chart_dir, "templates")
+    docs = []
+    for name in sorted(os.listdir(tdir)):
+        with open(os.path.join(tdir, name)) as f:
+            rendered = render(f.read(), values)
+        assert "{{" not in rendered, f"unrendered expression in {name}"
+        for doc in yaml.safe_load_all(rendered):
+            if doc is not None:
+                docs.append((name, doc))
+    return docs
+
+
+class TestCharts:
+    @pytest.mark.parametrize("chart", CHART_NAMES)
+    def test_chart_metadata(self, chart):
+        with open(os.path.join(CHARTS, chart, "Chart.yaml")) as f:
+            meta = yaml.safe_load(f)
+        assert meta["apiVersion"] == "v2"
+        assert meta["name"] == chart
+        assert meta["version"]
+
+    @pytest.mark.parametrize("chart", CHART_NAMES)
+    def test_templates_render_to_valid_k8s_objects(self, chart):
+        docs = chart_docs(os.path.join(CHARTS, chart))
+        assert docs, f"chart {chart} rendered no documents"
+        for name, doc in docs:
+            assert "kind" in doc and "apiVersion" in doc, (name, doc)
+            assert doc["metadata"].get("name"), (name, doc)
+
+    def test_pipeline_wiring(self):
+        """The load-bearing cross-references: DaemonSet textfile mount,
+        prometheus config name matches its deployment volume, adapter rule
+        maps node_* onto Node objects, APIService points at the adapter
+        service."""
+        ne = dict_by_kind(chart_docs(os.path.join(CHARTS, "node-exporter")))
+        ds = ne["DaemonSet"]
+        args = ds["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert any("--collector.textfile.directory" in a for a in args)
+
+        prom = dict_by_kind(chart_docs(os.path.join(CHARTS, "prometheus")))
+        config_name = prom["ConfigMap"]["metadata"]["name"]
+        volumes = prom["Deployment"]["spec"]["template"]["spec"]["volumes"]
+        assert any(
+            v.get("configMap", {}).get("name") == config_name for v in volumes
+        )
+        prom_yml = yaml.safe_load(prom["ConfigMap"]["data"]["prometheus.yml"])
+        jobs = {j["job_name"] for j in prom_yml["scrape_configs"]}
+        assert {"kubernetes-nodes", "kubernetes-pods"} <= jobs
+
+        ad = chart_docs(os.path.join(CHARTS, "custom-metrics-adapter"))
+        by_kind = dict_by_kind(ad)
+        rule_cfg = yaml.safe_load(by_kind["ConfigMap"]["data"]["config.yaml"])
+        node_rules = [
+            r
+            for r in rule_cfg["rules"]
+            if r["resources"]["overrides"]["instance"]["resource"] == "node"
+        ]
+        assert any("node_" in r["seriesQuery"] for r in node_rules)
+        assert any(r["name"].get("as") == "power" for r in node_rules)
+        # the power HPA consumes `power` as an External metric: the
+        # adapter must carry externalRules AND register the
+        # external.metrics.k8s.io APIService
+        ext_rules = rule_cfg["externalRules"]
+        assert any(r["name"].get("as") == "power" for r in ext_rules)
+        svc_name = by_kind["Service"]["metadata"]["name"]
+        apiservices = [d for n, d in ad if d["kind"] == "APIService"]
+        assert {a["metadata"]["name"] for a in apiservices} == {
+            "v1beta2.custom.metrics.k8s.io",
+            "v1beta1.custom.metrics.k8s.io",
+            "v1beta1.external.metrics.k8s.io",
+        }
+        for a in apiservices:
+            assert a["spec"]["service"]["name"] == svc_name
+        # node-exporter port coupling: prometheus scrapes the port the
+        # node-exporter chart serves on
+        with open(
+            os.path.join(CHARTS, "node-exporter", "values.yaml")
+        ) as f:
+            ne_port = yaml.safe_load(f)["port"]
+        with open(os.path.join(CHARTS, "prometheus", "values.yaml")) as f:
+            assert yaml.safe_load(f)["nodeExporterPort"] == ne_port
+
+
+def dict_by_kind(docs):
+    return {doc["kind"]: doc for _, doc in docs}
+
+
+def yaml_files_under(*parts):
+    root = os.path.join(REPO, *parts)
+    found = []
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            if name.endswith((".yaml", ".yml")):
+                found.append(os.path.join(dirpath, name))
+    return found
+
+
+class TestRawManifests:
+    @pytest.mark.parametrize(
+        "path",
+        yaml_files_under("docs", "power")
+        + yaml_files_under("deploy", "tas")
+        + yaml_files_under("deploy", "gas")
+        + yaml_files_under("deploy", "extender-configuration")
+        + yaml_files_under("deploy", "health-metric-demo"),
+        ids=lambda p: os.path.relpath(p, REPO),
+    )
+    def test_parses_as_k8s_yaml(self, path):
+        with open(path) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d is not None]
+        assert docs, path
+        for doc in docs:
+            assert "kind" in doc and "apiVersion" in doc, path
+            # component-config kinds (KubeSchedulerConfiguration,
+            # DeschedulerPolicy) are files, not cluster objects — no name
+            if "metadata" in doc:
+                assert doc["metadata"].get("name"), path
+
+    def test_power_demo_complete(self):
+        names = {
+            os.path.basename(p) for p in yaml_files_under("docs", "power")
+        }
+        assert {
+            "daemonset.yaml",
+            "configmap.yaml",
+            "service.yaml",
+            "tas-policy.yaml",
+            "power-hungry-application.yaml",
+            "power-autoscaler.yaml",
+        } <= names
+        assert os.path.exists(
+            os.path.join(REPO, "docs", "power", "collectd", "Dockerfile")
+        )
+        assert os.path.exists(
+            os.path.join(REPO, "docs", "power", "collectd", "rapl_reader.py")
+        )

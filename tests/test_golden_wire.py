@@ -1,0 +1,250 @@
+"""Golden wire fixtures (tests/golden/): the upstream kube-scheduler's
+lowercase-tagged bodies and the reference's capitalized bodies must both
+decode, produce identical responses through the native and Python paths,
+and match the pinned response bytes exactly.
+
+This suite exists because the reference only interoperates with the real
+kube-scheduler via Go's case-insensitive unmarshal (its own structs are
+untagged/capitalized while the scheduler marshals lowercase tags) — a
+detail invisible to hermetic tests that always speak one spelling.
+"""
+
+import json
+import os
+
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.extender.types import Args, BindingArgs
+from platform_aware_scheduling_tpu.native import get_wirec
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
+from platform_aware_scheduling_tpu.testing.builders import make_policy, rule
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+# canned state documented in golden/README.md
+VALUES = {"gw-a": 50, "gw-b": 90, "gw-c": 10, "gw-d": 70}
+
+REQUESTS = {
+    "upstream_nodes": "prioritize_request_upstream.json",
+    "upstream_nodenames": "prioritize_request_upstream_nodenames.json",
+    "reference_nodes": "prioritize_request_reference_style.json",
+    "reference_nodenames": "prioritize_request_reference_style_nodenames.json",
+}
+
+
+def fixture(name: str) -> bytes:
+    with open(os.path.join(GOLDEN, name), "rb") as f:
+        return f.read()
+
+
+def golden_extender():
+    cache = AutoUpdatingCache()
+    mirror = TensorStateMirror()
+    mirror.attach(cache)
+    cache.write_policy(
+        "default",
+        "golden-pol",
+        TASPolicy.from_obj(
+            make_policy(
+                "golden-pol",
+                strategies={
+                    "scheduleonmetric": [
+                        rule("golden_metric", "GreaterThan", 0)
+                    ],
+                    "dontschedule": [
+                        rule("golden_metric", "GreaterThan", 80)
+                    ],
+                },
+            )
+        ),
+    )
+    cache.write_metric(
+        "golden_metric",
+        {n: NodeMetric(value=Quantity(v)) for n, v in VALUES.items()},
+    )
+    return MetricsExtender(cache, mirror=mirror, node_cache_capable=True)
+
+
+def post(ext, verb: str, body: bytes):
+    request = HTTPRequest(
+        method="POST",
+        path=f"/scheduler/{verb}",
+        headers={"Content-Type": "application/json"},
+        body=body,
+    )
+    return getattr(ext, verb if verb != "prioritize" else "prioritize")(
+        request
+    )
+
+
+class TestGeneratorPinned:
+    def test_fixtures_match_generator(self):
+        """The committed fixtures are exactly what generate.py emits —
+        edits must go through the generator so derivation stays recorded."""
+        import subprocess
+        import sys
+
+        before = {
+            name: fixture(name) for name in REQUESTS.values()
+        }
+        subprocess.run(
+            [sys.executable, os.path.join(GOLDEN, "generate.py")], check=True
+        )
+        for name, content in before.items():
+            assert fixture(name) == content, f"{name} drifted from generator"
+
+
+class TestRequestDecoding:
+    @pytest.mark.parametrize("key", sorted(REQUESTS))
+    def test_args_decode(self, key):
+        args = Args.from_json(fixture(REQUESTS[key]))
+        assert args.pod.name == "golden-pod"
+        assert args.pod.namespace == "default"
+        assert args.pod.get_labels()["telemetry-policy"] == "golden-pol"
+        if key.endswith("_nodes"):
+            assert [n.name for n in args.nodes] == sorted(VALUES)
+        else:
+            assert args.node_names == sorted(VALUES)
+
+    def test_upstream_and_reference_decode_identically(self):
+        up = Args.from_json(fixture(REQUESTS["upstream_nodenames"]))
+        ref = Args.from_json(fixture(REQUESTS["reference_nodenames"]))
+        assert up.node_names == ref.node_names
+        assert up.pod.raw == ref.pod.raw
+
+    def test_bind_args_upstream_tags(self):
+        args = BindingArgs.from_json(fixture("bind_request_upstream.json"))
+        assert args.pod_name == "golden-pod"
+        assert args.pod_namespace == "default"
+        assert args.pod_uid.startswith("8f2a7e6c")
+        assert args.node == "gw-b"
+
+    def test_mixed_case_last_wins_like_go(self):
+        body = json.dumps(
+            {
+                "NodeNames": ["x"],
+                "nodenames": ["gw-a", "gw-b"],
+                "pod": {"metadata": {"name": "p"}},
+            }
+        ).encode()
+        args = Args.from_json(body)
+        assert args.node_names == ["gw-a", "gw-b"]
+
+    def test_exact_duplicate_plus_case_variant_resolves_in_doc_order(self):
+        """{"NodeNames":A, "nodenames":B, "NodeNames":C} -> C in Go (raw
+        document order, last wins) even though json.loads collapses the
+        exact duplicates at their first position; the native scanner
+        scans raw bytes so it agrees with Go — the Python fold must too."""
+        body = (
+            b'{"NodeNames": ["x"], "nodenames": ["y"],'
+            b' "NodeNames": ["gw-c"], "pod": {"metadata": {"name": "p"}}}'
+        )
+        args = Args.from_json(body)
+        assert args.node_names == ["gw-c"]
+        wirec = get_wirec()
+        if wirec is not None:
+            parsed = wirec.parse_prioritize(body)
+            assert parsed.node_names_list() == ["gw-c"]
+
+    @pytest.mark.skipif(get_wirec() is None, reason="no C toolchain")
+    @pytest.mark.parametrize("key", sorted(REQUESTS))
+    def test_native_scanner_decodes(self, key):
+        parsed = get_wirec().parse_prioritize(fixture(REQUESTS[key]))
+        assert parsed.pod_name == "golden-pod"
+        assert parsed.policy_label == "golden-pol"
+        if key.endswith("_nodes"):
+            assert parsed.node_names() == sorted(VALUES)
+        else:
+            assert parsed.node_names_list() == sorted(VALUES)
+
+
+class TestGoldenResponses:
+    """Response bytes pinned against *.golden files (regenerate with
+    --update after an intentional wire change: see __main__ below)."""
+
+    CASES = [
+        ("prioritize", "upstream_nodenames", "prioritize_nodenames_response.golden"),
+        ("prioritize", "reference_nodenames", "prioritize_nodenames_response.golden"),
+        ("prioritize", "upstream_nodes", "prioritize_nodes_response.golden"),
+        ("prioritize", "reference_nodes", "prioritize_nodes_response.golden"),
+        ("filter", "upstream_nodenames", "filter_nodenames_response.golden"),
+        ("filter", "reference_nodenames", "filter_nodenames_response.golden"),
+        ("filter", "upstream_nodes", "filter_nodes_response.golden"),
+        ("filter", "reference_nodes", "filter_nodes_response.golden"),
+    ]
+
+    @pytest.mark.parametrize("verb,req,golden", CASES)
+    def test_response_bytes_pinned(self, verb, req, golden, monkeypatch):
+        for native in (False, True):
+            if native and get_wirec() is None:
+                continue
+            if not native:
+                monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+            else:
+                monkeypatch.delenv("PAS_TPU_NO_NATIVE", raising=False)
+            ext = golden_extender()
+            response = post(ext, verb, fixture(REQUESTS[req]))
+            assert response.status == 200
+            assert response.body == fixture(golden), (verb, req, native)
+
+    def test_semantics_hand_checkable(self):
+        """Scores are ordinal 10-rank over metric desc: gw-b(90) gw-d(70)
+        gw-a(50) gw-c(10); filter rejects gw-b (90 > 80)."""
+        prio = json.loads(fixture("prioritize_nodenames_response.golden"))
+        assert [(e["Host"], e["Score"]) for e in prio] == [
+            ("gw-b", 10), ("gw-d", 9), ("gw-a", 8), ("gw-c", 7),
+        ]
+        filt = json.loads(fixture("filter_nodenames_response.golden"))
+        assert filt["NodeNames"] == ["gw-a", "gw-c", "gw-d"]
+        assert filt["FailedNodes"] == {"gw-b": "Node violates"}
+        legacy = json.loads(fixture("filter_nodes_response.golden"))
+        # the Nodes branch echoes full node objects and keeps the
+        # reference's trailing-"" NodeNames split quirk
+        assert [n["metadata"]["name"] for n in legacy["Nodes"]["items"]] == [
+            "gw-a", "gw-c", "gw-d",
+        ]
+        assert legacy["NodeNames"] == ["gw-a", "gw-c", "gw-d", ""]
+        assert legacy["FailedNodes"] == {"gw-b": "Node violates"}
+
+
+def update_goldens():
+    """Regenerate the *.golden response files from the current (exact
+    Python path) implementation."""
+    os.environ["PAS_TPU_NO_NATIVE"] = "1"
+    ext = golden_extender()
+    outputs = {
+        "prioritize_nodenames_response.golden": post(
+            ext, "prioritize", fixture(REQUESTS["upstream_nodenames"])
+        ),
+        "prioritize_nodes_response.golden": post(
+            ext, "prioritize", fixture(REQUESTS["upstream_nodes"])
+        ),
+        "filter_nodenames_response.golden": post(
+            ext, "filter", fixture(REQUESTS["upstream_nodenames"])
+        ),
+        # legacy Nodes branch: full node echo + the trailing-"" NodeNames
+        # split quirk (telemetryscheduler.go:212)
+        "filter_nodes_response.golden": post(
+            ext, "filter", fixture(REQUESTS["upstream_nodes"])
+        ),
+    }
+    for name, response in outputs.items():
+        assert response.status == 200, name
+        with open(os.path.join(GOLDEN, name), "wb") as f:
+            f.write(response.body)
+        print(f"wrote {name} ({len(response.body)} bytes)")
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--update" in sys.argv:
+        update_goldens()
+    else:
+        print("usage: python tests/test_golden_wire.py --update")
