@@ -4,18 +4,63 @@
 # kube-scheduler.  Capability parity with the reference's
 # .github/scripts/e2e_setup_cluster.sh; the hermetic in-process version of
 # these scenarios runs in tests/test_e2e.py.
+#
+# Scheduler wiring happens at cluster creation through kubeadmConfigPatches
+# (the reference's approach): the extender KubeSchedulerConfiguration is
+# host-mounted into the control plane and handed to kube-scheduler via
+# extraArgs/extraVolumes — nothing is patched inside the running node, so
+# no tooling beyond kubeadm itself is needed in the kindest image.
 set -euo pipefail
 
 CLUSTER=${CLUSTER:-pas-tpu-e2e}
 SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 REPO_ROOT=$(cd "$SCRIPT_DIR/../.." && pwd)
+CONFIG_DIR=$(mktemp -d -t pas-e2e-XXXXXX)
+
+write_scheduler_config() {
+  # kube-scheduler runs hostNetwork: it cannot resolve cluster-DNS
+  # service names, so the extender URL is the service's fixed ClusterIP
+  # (tas-service.yaml pins spec.clusterIP to 10.96.200.10, inside kind's
+  # default service CIDR 10.96.0.0/16)
+  cat > "$CONFIG_DIR/scheduler-config.yaml" <<'EOF'
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+clientConnection:
+  kubeconfig: /etc/kubernetes/scheduler.conf
+extenders:
+  - urlPrefix: "http://10.96.200.10:9001"
+    prioritizeVerb: "scheduler/prioritize"
+    filterVerb: "scheduler/filter"
+    weight: 100
+    enableHTTPS: false
+    managedResources:
+      - name: "telemetry/scheduling"
+        ignoredByScheduler: true
+    ignorable: false
+EOF
+}
 
 create_cluster() {
   cat <<EOF | kind create cluster --name "$CLUSTER" --config=-
 kind: Cluster
 apiVersion: kind.x-k8s.io/v1alpha4
+kubeadmConfigPatches:
+  - |
+    kind: ClusterConfiguration
+    scheduler:
+      extraArgs:
+        config: /etc/kubernetes/extender/scheduler-config.yaml
+      extraVolumes:
+        - name: extender-config
+          hostPath: /etc/kubernetes/extender
+          mountPath: /etc/kubernetes/extender
+          readOnly: true
+          pathType: DirectoryOrCreate
 nodes:
   - role: control-plane
+    extraMounts:
+      - hostPath: $CONFIG_DIR
+        containerPath: /etc/kubernetes/extender
   - role: worker
     extraMounts:
       - hostPath: $SCRIPT_DIR/policies/node1
@@ -50,40 +95,29 @@ deploy_tas() {
   kind load docker-image pas-tpu-tas --name "$CLUSTER"
   kubectl apply -f "$REPO_ROOT/deploy/tas/tas-policy-crd.yaml"
   kubectl apply -f "$REPO_ROOT/deploy/tas/tas-rbac.yaml"
-  kubectl apply -f "$REPO_ROOT/deploy/tas/tas-service.yaml"
-  # e2e runs unsafe (plain HTTP), like the reference's e2e policy
+  # fixed ClusterIP so the host-network kube-scheduler reaches the
+  # extender without cluster DNS (see write_scheduler_config)
   kubectl apply -f - <<EOF
-$(sed 's/--cert=.*/--unsafe/; /--key=\|--cacert=/d' \
+$(sed 's/^spec:/spec:\n  clusterIP: 10.96.200.10/' \
+    "$REPO_ROOT/deploy/tas/tas-service.yaml")
+EOF
+  # the deployment mounts Secret extender-secret for mTLS; e2e runs
+  # unsafe (plain HTTP, like the reference's e2e tlsConfig.insecure) but
+  # the volume must still mount — a placeholder satisfies it
+  kubectl create secret generic extender-secret \
+    --from-literal=tls.crt=unused --from-literal=tls.key=unused \
+    --dry-run=client -o yaml | kubectl apply -f -
+  # swap mTLS flags for --unsafe and raise verbosity to the wire-dump
+  # level (--v=5) so the CI wire-capture artifact holds real
+  # request/response pairs for tests/golden/ refresh
+  kubectl apply -f - <<EOF
+$(sed 's/--cert=.*/--unsafe/; /--key=\|--cacert=/d; s/--v=2/--v=5/' \
     "$REPO_ROOT/deploy/tas/tas-deployment.yaml")
 EOF
 }
 
-configure_scheduler() {
-  docker exec "${CLUSTER}-control-plane" bash -c "
-    cat > /etc/kubernetes/scheduler-extender-config.yaml" <<'EOF'
-apiVersion: kubescheduler.config.k8s.io/v1
-kind: KubeSchedulerConfiguration
-clientConnection:
-  kubeconfig: /etc/kubernetes/scheduler.conf
-extenders:
-  - urlPrefix: "http://tas-service.default.svc.cluster.local:9001"
-    prioritizeVerb: "scheduler/prioritize"
-    filterVerb: "scheduler/filter"
-    weight: 100
-    enableHTTPS: false
-    managedResources:
-      - name: "telemetry/scheduling"
-        ignoredByScheduler: true
-    ignorable: false
-EOF
-  docker cp "$REPO_ROOT/deploy/extender-configuration/configure-scheduler.sh" \
-    "${CLUSTER}-control-plane:/tmp/"
-  docker exec "${CLUSTER}-control-plane" bash /tmp/configure-scheduler.sh \
-    /etc/kubernetes/scheduler-extender-config.yaml
-}
-
+write_scheduler_config
 create_cluster
 install_metrics_pipeline
 deploy_tas
-configure_scheduler
 echo "cluster $CLUSTER ready; run the scenario assertions against it"

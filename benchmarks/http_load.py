@@ -287,17 +287,12 @@ def _serve_forever(num_nodes: int, device: bool) -> None:
     let the measuring threads contend with the handler threads and charge
     the contention to the server under test.
 
-    GC posture (applies to BOTH sides of the A/B): the warmed service
-    heap is frozen out of collection and generational thresholds are
-    raised — request handling allocates bulk bytes but no reference
-    cycles, so frequent young-gen scans of a JAX-sized module graph only
-    add tail latency (the standard latency-service tuning)."""
-    import gc
+    GC posture (applies to BOTH sides of the A/B): the same serving
+    tuning the production mains apply (utils/gctuning.py)."""
+    from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 
     server, _ = build_service(num_nodes, device=device)
-    gc.collect()
-    gc.freeze()
-    gc.set_threshold(100_000, 50, 50)
+    tune_for_serving()
     print(f"READY {server.port}", flush=True)
     threading.Event().wait()
 

@@ -3,6 +3,12 @@
 # KubeSchedulerConfiguration onto the control-plane host and patches the
 # static-pod manifest to mount + use it.
 # (capability parity: reference deploy/extender-configuration/configure-scheduler.sh)
+#
+# Requirements: run ON a control-plane host with sudo, python3, and
+# kubectl available (a kubeadm-managed cluster).  For kind clusters use
+# kubeadmConfigPatches at creation instead — the kindest node image has
+# neither sudo nor python3 (.github/scripts/e2e_setup_cluster.sh shows
+# the pattern).
 set -euo pipefail
 
 CONFIG=${1:-scheduler-config.yaml}

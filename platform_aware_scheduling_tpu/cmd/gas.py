@@ -43,6 +43,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     kube_client = get_kube_client(args.kubeConfig)
     extender = GASExtender(kube_client)
 
+    from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
+
+    tune_for_serving()
     server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
     done = threading.Event()
     failed = []

@@ -134,6 +134,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         node_cache_capable=args.nodeCacheCapable,
     )
 
+    from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
+
+    tune_for_serving()
     server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
     done = threading.Event()
     failed = []

@@ -282,6 +282,23 @@ class Server:
             "/scheduler/bind": self.scheduler.bind,
         }
         handler = routes.get(request.path, not_found_handler)
+        if klog.v(5).enabled():
+            # full wire dump (reference GAS logs the request at V(5),
+            # scheduler.go:491-495; the response dump is what the kind
+            # e2e's wire-capture artifact harvests to refresh
+            # tests/golden/ from a real kube-scheduler)
+            klog.v(5).info_s(
+                f"WIRE request {request.method} {request.path} "
+                f"body={request.body.decode('utf-8', 'replace')}",
+                component="extender",
+            )
+            response = apply_middleware(handler, request)
+            klog.v(5).info_s(
+                f"WIRE response {request.path} status={response.status} "
+                f"body={response.body.decode('utf-8', 'replace')}",
+                component="extender",
+            )
+            return response
         return apply_middleware(handler, request)
 
     # -- serving -------------------------------------------------------------

@@ -37,29 +37,44 @@ def _loads_with_top_pairs(body: bytes):
     duplicate and a case-variant of one field (``{"Pod":A,"pod":B,
     "Pod":C}``) resolves to the LAST occurrence in document order in Go
     (and in the native scanner), but json.loads collapses the exact
-    duplicates at their first position, which would re-order the fold."""
-    pairs_box: List[list] = []
+    duplicates at their first position, which would re-order the fold.
+
+    The hook fires for every object bottom-up, the outermost last — only
+    that final call is kept (O(1) extra memory, not O(total keys))."""
+    top: List[tuple] = []
 
     def hook(pairs):
-        pairs_box.append(pairs)
+        nonlocal top
+        top = pairs
         return dict(pairs)
 
     obj = json.loads(body, object_pairs_hook=hook)
-    top = pairs_box[-1] if (pairs_box and isinstance(obj, dict)) else []
-    return obj, top
+    return obj, (top if isinstance(obj, dict) else [])
 
 
-def _fold_keys(pairs, fields: Dict[str, str]) -> Dict[str, Any]:
+def _fold_keys(
+    pairs, fields: Dict[str, str], nullable: frozenset = frozenset()
+) -> Dict[str, Any]:
     """Go-unmarshal field resolution over raw-document-order (key, value)
     pairs: each JSON key matches a struct field case-insensitively, later
     assignments overwrite earlier ones.  ``fields`` maps lowercase wire
     name -> canonical name; unmatched keys are dropped (as Go ignores
-    them)."""
+    them).
+
+    JSON ``null`` follows Go's per-type rule: decoding null into a
+    pointer/slice/map field assigns nil (fields listed in ``nullable`` —
+    ``Nodes`` / ``NodeNames`` are pointers in both the reference and
+    upstream structs), while null into a value field (strings,
+    struct-valued ``Pod``) "has no effect" — the earlier value, if any,
+    survives."""
     out: Dict[str, Any] = {}
     for key, value in pairs:
         canonical = fields.get(key.lower())
-        if canonical is not None:
-            out[canonical] = value
+        if canonical is None:
+            continue
+        if value is None and canonical not in nullable:
+            continue  # Go: null into a value field has no effect
+        out[canonical] = value
     return out
 
 
@@ -87,6 +102,7 @@ class Args:
         folded = _fold_keys(
             top_pairs,
             {"pod": "Pod", "nodes": "Nodes", "nodenames": "NodeNames"},
+            nullable=frozenset({"Nodes", "NodeNames"}),
         )
         pod = Pod(folded.get("Pod") or {})
         nodes_obj = folded.get("Nodes")

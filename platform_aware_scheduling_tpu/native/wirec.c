@@ -107,17 +107,63 @@ static int fail_raw(Scan *sc, const char *msg) {
 
 #define fail(msg) fail_raw(sc, msg)
 
+/* any byte outside plain-ASCII string content: < 0x20 (control), '\\'
+ * (escape), or >= 0x80 (multibyte UTF-8) — found via an 8-byte SWAR
+ * sweep.  '"' cannot appear in the probed span (it is memchr's stop). */
+static int span_has_special(const char *s, Py_ssize_t n) {
+    const uint64_t ones = 0x0101010101010101ULL;
+    const uint64_t highs = 0x8080808080808080ULL;
+    Py_ssize_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t w;
+        memcpy(&w, s + i, 8);
+        uint64_t lt20 = (w - ones * 0x20) & ~w & highs;
+        uint64_t ge80 = w & highs;
+        uint64_t xbs = w ^ (ones * (unsigned char)'\\');
+        uint64_t isbs = (xbs - ones) & ~xbs & highs;
+        if (lt20 | ge80 | isbs) return 1;
+    }
+    for (; i < n; i++) {
+        unsigned char c = (unsigned char)s[i];
+        if (c < 0x20 || c >= 0x80 || c == '\\') return 1;
+    }
+    return 0;
+}
+
 /* scan a JSON string starting at the opening quote; record the slice.
  *
  * Escape sequences and UTF-8 well-formedness are validated HERE, exactly
  * as strictly as json.loads over bytes (which UTF-8-decodes first): a body
  * that json.loads would reject must fail the native parse too, so the
  * exact Python path owns the response for it — never a silent divergence
- * or a deferred exception at slice-materialization time. */
+ * or a deferred exception at slice-materialization time.
+ *
+ * Fast path: memchr to the next '"', one SWAR sweep over the span; when
+ * the span is plain ASCII (the overwhelmingly common case for node
+ * names/keys) the per-byte validating loop is skipped entirely.  Any
+ * special byte — including an escaped quote, whose preceding backslash
+ * trips the sweep — falls back to the exact loop from the start. */
 static int scan_string(Scan *sc, StrSlice *out) {
     if (sc->i >= sc->n || sc->s[sc->i] != '"') return fail("expected string");
     sc->i++;
     Py_ssize_t start = sc->i;
+    {
+        const char *base = sc->s + start;
+        const char *q = memchr(base, '"', (size_t)(sc->n - start));
+        if (q) {
+            Py_ssize_t len = (Py_ssize_t)(q - base);
+            if (!span_has_special(base, len)) {
+                if (out) {
+                    out->off = start;
+                    out->len = len;
+                    out->escaped = 0;
+                    out->present = 1;
+                }
+                sc->i = start + len + 1;
+                return 0;
+            }
+        }
+    }
     int escaped = 0;
     while (sc->i < sc->n) {
         unsigned char c = (unsigned char)sc->s[sc->i];
@@ -557,13 +603,16 @@ static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
 static int scan_pod(Scan *sc, ParsedArgs *pa) {
     skip_ws(sc);
     if (sc->i >= sc->n) return fail("eof in Pod");
-    /* duplicate top-level "Pod" keys: last wins like json.loads (mirrors
-     * the "Nodes" reset in wirec_parse_prioritize) */
+    /* "Pod": null — Go decodes null into a VALUE struct as "no effect"
+     * (the reference's Args.Pod is v1.Pod by value), so fields captured
+     * from an earlier duplicate occurrence must survive; contrast the
+     * pointer-typed Nodes/NodeNames where null assigns nil */
+    if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
+    /* duplicate top-level "Pod" keys carrying objects: last wins */
     memset(&pa->pod_name, 0, sizeof(StrSlice));
     memset(&pa->pod_namespace, 0, sizeof(StrSlice));
     memset(&pa->policy_label, 0, sizeof(StrSlice));
     pa->has_label = 0;
-    if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
     if (sc->s[sc->i] != '{') return fail("Pod not object");
     sc->i++;
     skip_ws(sc);

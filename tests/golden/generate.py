@@ -187,12 +187,11 @@ def node_list():
     }
 
 
-def write(name: str, data: bytes) -> None:
-    with open(os.path.join(HERE, name), "wb") as f:
-        f.write(data)
+def main(out_dir: str = HERE):
+    def write(name: str, data: bytes) -> None:
+        with open(os.path.join(out_dir, name), "wb") as f:
+            f.write(data)
 
-
-def main():
     # upstream kube-scheduler spellings (lowercase tags, omitempty)
     write(
         "prioritize_request_upstream.json",
@@ -227,4 +226,6 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else HERE)

@@ -84,20 +84,25 @@ def post(ext, verb: str, body: bytes):
 
 
 class TestGeneratorPinned:
-    def test_fixtures_match_generator(self):
+    def test_fixtures_match_generator(self, tmp_path):
         """The committed fixtures are exactly what generate.py emits —
-        edits must go through the generator so derivation stays recorded."""
+        edits must go through the generator so derivation stays recorded.
+        Generation goes to a temp dir: writing in place would self-heal a
+        drift on the second run (and dirty the tree on every run)."""
         import subprocess
         import sys
 
-        before = {
-            name: fixture(name) for name in REQUESTS.values()
-        }
         subprocess.run(
-            [sys.executable, os.path.join(GOLDEN, "generate.py")], check=True
+            [
+                sys.executable,
+                os.path.join(GOLDEN, "generate.py"),
+                str(tmp_path),
+            ],
+            check=True,
         )
-        for name, content in before.items():
-            assert fixture(name) == content, f"{name} drifted from generator"
+        for name in REQUESTS.values():
+            generated = (tmp_path / name).read_bytes()
+            assert generated == fixture(name), f"{name} drifted from generator"
 
 
 class TestRequestDecoding:
@@ -117,6 +122,12 @@ class TestRequestDecoding:
         ref = Args.from_json(fixture(REQUESTS["reference_nodenames"]))
         assert up.node_names == ref.node_names
         assert up.pod.raw == ref.pod.raw
+
+    def test_bind_null_case_variant_does_not_clobber_string(self):
+        """{"Node":"n1","node":null}: Go assigns "n1" then ignores the
+        null (null into a string field has no effect) — so must we."""
+        args = BindingArgs.from_json(b'{"Node": "n1", "node": null}')
+        assert args.node == "n1"
 
     def test_bind_args_upstream_tags(self):
         args = BindingArgs.from_json(fixture("bind_request_upstream.json"))

@@ -388,15 +388,37 @@ class TestAdvisorFindings:
         )
         assert wirec.parse_prioritize(body2).policy_label == "new"
 
-    def test_pod_null_after_object_clears_fields(self):
+    def test_pod_null_after_object_has_no_effect(self):
+        """Go decodes null into a VALUE struct (the reference's Args.Pod
+        is v1.Pod by value) as "no effect" — fields captured from the
+        earlier occurrence survive; the Python fold (_fold_keys nullable
+        handling) and the native scanner agree."""
         body = (
             b'{"Pod": {"metadata": {"name": "first", '
             b'"labels": {"telemetry-policy": "pol"}}}, '
             b'"Pod": null, "Nodes": {"items": []}}'
         )
         parsed = wirec.parse_prioritize(body)
-        assert parsed.pod_name is None
-        assert parsed.policy_label is None
+        assert parsed.pod_name == "first"
+        assert parsed.policy_label == "pol"
+        from platform_aware_scheduling_tpu.extender.types import Args
+
+        args = Args.from_json(body)
+        assert args.pod.name == "first"
+        assert args.pod.get_labels()["telemetry-policy"] == "pol"
+
+    def test_nodes_null_after_object_assigns_nil(self):
+        """Pointer-typed Nodes/NodeNames DO take null (Go assigns nil)."""
+        body = (
+            b'{"NodeNames": ["n1"], "NodeNames": null, '
+            b'"Pod": {"metadata": {"name": "p"}}}'
+        )
+        parsed = wirec.parse_prioritize(body)
+        assert parsed.node_names_present == 0
+        from platform_aware_scheduling_tpu.extender.types import Args
+
+        args = Args.from_json(body)
+        assert args.node_names is None
 
     def test_allocator_hygiene_under_debug_malloc(self):
         # NameTable mixes Buf (malloc) and PyMem storage; the dealloc must
