@@ -15,13 +15,18 @@ set -euo pipefail
 CLUSTER=${CLUSTER:-pas-tpu-e2e}
 SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 REPO_ROOT=$(cd "$SCRIPT_DIR/../.." && pwd)
-CONFIG_DIR=$(mktemp -d -t pas-e2e-XXXXXX)
+# fixed per-cluster path (not mktemp): the kind node mounts it for the
+# cluster's whole lifetime, so cleanup belongs to e2e_teardown_cluster.sh,
+# which derives the same path from $CLUSTER
+CONFIG_DIR=/tmp/pas-e2e-$CLUSTER
+mkdir -p "$CONFIG_DIR"
 
 write_scheduler_config() {
   # kube-scheduler runs hostNetwork: it cannot resolve cluster-DNS
-  # service names, so the extender URL is the service's fixed ClusterIP
-  # (tas-service.yaml pins spec.clusterIP to 10.96.200.10, inside kind's
-  # default service CIDR 10.96.0.0/16)
+  # service names, so the extender URL is a fixed ClusterIP inside
+  # kind's default service CIDR (10.96.0.0/16).  deploy_tas injects the
+  # SAME address into tas-service.yaml via sed — the two must stay in
+  # lockstep (the plain manifest carries no clusterIP pin)
   cat > "$CONFIG_DIR/scheduler-config.yaml" <<'EOF'
 apiVersion: kubescheduler.config.k8s.io/v1
 kind: KubeSchedulerConfiguration

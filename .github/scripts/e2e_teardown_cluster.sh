@@ -5,3 +5,5 @@ set -euo pipefail
 
 CLUSTER=${CLUSTER:-pas-tpu-e2e}
 kind delete cluster --name "$CLUSTER" || true
+# the scheduler-config dir the setup script host-mounted into the node
+rm -rf "/tmp/pas-e2e-$CLUSTER"

@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e bench lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-configs lint image clean dryrun
 
 all: test
 
@@ -9,8 +9,23 @@ test:
 e2e:
 	python -m pytest tests/test_e2e.py -q
 
+# real-cluster e2e (requires kind/helm/kubectl/docker; CI runs this);
+# teardown always runs so a failed scenario can't leak the kind cluster
+e2e-kind:
+	bash .github/scripts/e2e_setup_cluster.sh
+	python .github/e2e/run_e2e.py; rc=$$?; \
+		bash .github/scripts/e2e_teardown_cluster.sh; exit $$rc
+
 bench:
 	python bench.py
+
+# north-star serving A/B alone (faster than the full bench)
+bench-http:
+	python -m benchmarks.http_load
+
+# BASELINE configs #2/#3/#5 + solver surface alone
+bench-configs:
+	python -m benchmarks.configs
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

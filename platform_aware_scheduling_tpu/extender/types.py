@@ -13,9 +13,21 @@ reference actually interoperates: the real kube-scheduler marshals the
 reference's untagged Go structs decode them only via encoding/json's
 case-insensitive field matching.  Go resolves every JSON key to its field
 case-insensitively in document order, later assignments overwriting
-earlier ones — reproduced here exactly (tests/test_golden_wire.py pins
-both key spellings).  Node objects are passed through as raw dicts so
-responses round-trip the scheduler's own node JSON exactly.
+earlier ones — reproduced here (tests/test_golden_wire.py pins both key
+spellings).
+
+Envelope note on duplicate keys: field RESOLUTION (case-insensitivity,
+document order, per-type null rules) is Go-exact, but when the same
+object-valued field appears twice, the later OBJECT replaces the earlier
+one wholesale (json.loads semantics, matched by the native scanner),
+whereas Go would merge it per-field into the existing struct.  Go
+marshalers cannot emit duplicate keys, so no real wire producer
+exercises the difference; what matters — and is pinned by tests — is
+that both of this framework's decode paths agree with each other on
+such bodies.
+
+Node objects are passed through as raw dicts so responses round-trip the
+scheduler's own node JSON exactly.
 """
 
 from __future__ import annotations

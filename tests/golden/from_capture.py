@@ -1,0 +1,90 @@
+"""Extract golden wire fixtures from a kind-e2e wire capture.
+
+The CI e2e job runs TAS at ``--v=5``, where the server dumps every
+request/response pair (extender/server.py WIRE lines), and uploads the
+pod log as the ``wire-capture`` artifact.  This tool turns that log back
+into fixture files — the refresh path for ``tests/golden/`` from a REAL
+kube-scheduler:
+
+    python tests/golden/from_capture.py wire-capture/tas.log out_dir/
+
+Each pair becomes ``<n>_<verb>_request.json`` + ``<n>_<verb>_response.json``
+with a small index.json describing what was captured.  Review, pick
+representative pairs, and commit them alongside the hand-derived
+fixtures (generate.py) with updated expectations.
+"""
+
+import json
+import os
+import re
+import sys
+
+WIRE_REQ = re.compile(
+    r"WIRE request POST /scheduler/(\w+) body=(.*?)(?: component=|$)"
+)
+WIRE_RESP = re.compile(
+    r"WIRE response /scheduler/(\w+) status=(\d+) body=(.*?)(?: component=|$)"
+)
+
+
+def extract(log_text: str):
+    """Yield (verb, request body, status, response body) in log order.
+    Pairing is FIFO per verb: each response matches the OLDEST unanswered
+    request for that verb.
+
+    Caveat: FIFO is only guaranteed correct for sequential traffic — the
+    threaded server may interleave concurrent requests' log lines out of
+    completion order.  The kind e2e scenarios drive requests one at a
+    time (.github/e2e/run_e2e.py), so their capture pairs exactly;
+    captures from a busy production scheduler should be taken during a
+    quiet window or reviewed pair-by-pair before committing."""
+    pending = {}
+    for line in log_text.splitlines():
+        m = WIRE_REQ.search(line)
+        if m:
+            pending.setdefault(m.group(1), []).append(m.group(2))
+            continue
+        m = WIRE_RESP.search(line)
+        if m:
+            verb, status, body = m.group(1), int(m.group(2)), m.group(3)
+            stack = pending.get(verb)
+            if stack:
+                yield verb, stack.pop(0), status, body
+
+
+def main(log_path: str, out_dir: str) -> int:
+    with open(log_path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    os.makedirs(out_dir, exist_ok=True)
+    index = []
+    for i, (verb, req, status, resp) in enumerate(extract(text)):
+        req_name = f"{i:03d}_{verb}_request.json"
+        resp_name = f"{i:03d}_{verb}_response.json"
+        with open(os.path.join(out_dir, req_name), "w") as f:
+            f.write(req)
+        with open(os.path.join(out_dir, resp_name), "w") as f:
+            f.write(resp)
+        entry = {"verb": verb, "status": status, "request": req_name,
+                 "response": resp_name}
+        try:  # annotate with the candidate count for easy picking
+            obj = json.loads(req)
+            lowered = {k.lower(): v for k, v in obj.items()}
+            names = lowered.get("nodenames")
+            nodes = lowered.get("nodes") or {}
+            entry["candidates"] = (
+                len(names) if names else len(nodes.get("items") or [])
+            )
+        except (json.JSONDecodeError, AttributeError):
+            pass
+        index.append(entry)
+    with open(os.path.join(out_dir, "index.json"), "w") as f:
+        json.dump(index, f, indent=1)
+    print(f"extracted {len(index)} wire pairs to {out_dir}")
+    return 0 if index else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        print(__doc__)
+        raise SystemExit(2)
+    raise SystemExit(main(sys.argv[1], sys.argv[2]))

@@ -123,6 +123,27 @@ class TestRequestDecoding:
         assert up.node_names == ref.node_names
         assert up.pod.raw == ref.pod.raw
 
+    def test_duplicate_object_key_replaces_wholesale_both_paths_agree(self):
+        """{"Pod": {name+label}, "Pod": {name only}}: Go would MERGE
+        per-field (keeping the label); this framework's documented
+        envelope replaces the object wholesale — what is pinned here is
+        that the native scanner and the Python fold AGREE (types.py
+        module doc, 'Envelope note on duplicate keys')."""
+        body = (
+            b'{"Pod": {"metadata": {"name": "p", '
+            b'"labels": {"telemetry-policy": "golden-pol"}}}, '
+            b'"Pod": {"metadata": {"name": "q"}}, '
+            b'"NodeNames": ["gw-a"]}'
+        )
+        args = Args.from_json(body)
+        assert args.pod.name == "q"
+        assert "telemetry-policy" not in args.pod.get_labels()
+        wirec = get_wirec()
+        if wirec is not None:
+            parsed = wirec.parse_prioritize(body)
+            assert parsed.pod_name == "q"
+            assert parsed.policy_label is None
+
     def test_bind_null_case_variant_does_not_clobber_string(self):
         """{"Node":"n1","node":null}: Go assigns "n1" then ignores the
         null (null into a string field has no effect) — so must we."""
