@@ -15,11 +15,13 @@ set -euo pipefail
 CLUSTER=${CLUSTER:-pas-tpu-e2e}
 SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 REPO_ROOT=$(cd "$SCRIPT_DIR/../.." && pwd)
-# fixed per-cluster path (not mktemp): the kind node mounts it for the
-# cluster's whole lifetime, so cleanup belongs to e2e_teardown_cluster.sh,
-# which derives the same path from $CLUSTER
-CONFIG_DIR=/tmp/pas-e2e-$CLUSTER
-mkdir -p "$CONFIG_DIR"
+# unpredictable mktemp dir (a fixed /tmp path could be pre-created or
+# symlinked by another tenant and gets host-mounted into the node); it
+# must outlive this script — the kind node mounts it for the cluster's
+# lifetime — so the path is recorded in the repo workspace for
+# e2e_teardown_cluster.sh to clean up
+CONFIG_DIR=$(mktemp -d -t pas-e2e-XXXXXXXX)
+echo "$CONFIG_DIR" > "$REPO_ROOT/.e2e-config-dir"
 
 write_scheduler_config() {
   # kube-scheduler runs hostNetwork: it cannot resolve cluster-DNS

@@ -4,6 +4,15 @@
 set -euo pipefail
 
 CLUSTER=${CLUSTER:-pas-tpu-e2e}
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
+REPO_ROOT=$(cd "$SCRIPT_DIR/../.." && pwd)
 kind delete cluster --name "$CLUSTER" || true
 # the scheduler-config dir the setup script host-mounted into the node
-rm -rf "/tmp/pas-e2e-$CLUSTER"
+# (path recorded by e2e_setup_cluster.sh; only remove what we created)
+if [[ -f "$REPO_ROOT/.e2e-config-dir" ]]; then
+  dir=$(cat "$REPO_ROOT/.e2e-config-dir")
+  case "$dir" in
+    */pas-e2e-*) rm -rf "$dir" ;;
+  esac
+  rm -f "$REPO_ROOT/.e2e-config-dir"
+fi

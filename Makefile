@@ -10,10 +10,11 @@ e2e:
 	python -m pytest tests/test_e2e.py -q
 
 # real-cluster e2e (requires kind/helm/kubectl/docker; CI runs this);
-# teardown always runs so a failed scenario can't leak the kind cluster
+# teardown always runs — a failure anywhere in setup OR the scenarios
+# must not leak the kind cluster
 e2e-kind:
-	bash .github/scripts/e2e_setup_cluster.sh
-	python .github/e2e/run_e2e.py; rc=$$?; \
+	( bash .github/scripts/e2e_setup_cluster.sh && \
+		python .github/e2e/run_e2e.py ); rc=$$?; \
 		bash .github/scripts/e2e_teardown_cluster.sh; exit $$rc
 
 bench:

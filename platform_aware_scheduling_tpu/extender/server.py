@@ -286,16 +286,22 @@ class Server:
             # full wire dump (reference GAS logs the request at V(5),
             # scheduler.go:491-495; the response dump is what the kind
             # e2e's wire-capture artifact harvests to refresh
-            # tests/golden/ from a real kube-scheduler)
+            # tests/golden/ from a real kube-scheduler).  Bodies are
+            # base64 so each record is one unambiguous log line and the
+            # extractor (tests/golden/from_capture.py) recovers EXACT
+            # bytes — raw dumps would split on embedded newlines and
+            # could collide with the log's own field delimiters
+            import base64
+
             klog.v(5).info_s(
                 f"WIRE request {request.method} {request.path} "
-                f"body={request.body.decode('utf-8', 'replace')}",
+                f"b64={base64.b64encode(request.body).decode('ascii')}",
                 component="extender",
             )
             response = apply_middleware(handler, request)
             klog.v(5).info_s(
                 f"WIRE response {request.path} status={response.status} "
-                f"body={response.body.decode('utf-8', 'replace')}",
+                f"b64={base64.b64encode(response.body).decode('ascii')}",
                 component="extender",
             )
             return response

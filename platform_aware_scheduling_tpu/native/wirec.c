@@ -72,6 +72,60 @@ static int buf_put(Buf *b, const char *src, size_t n) {
     return 0;
 }
 
+/* Process-wide pool of reusable scratch buffers for the per-request
+ * encode paths.
+ *
+ * A 10k-node response is ~400 KB; glibc malloc serves that size from
+ * mmap, so a fresh allocation per request means fresh pages — the
+ * page-fault + munmap churn lands straight in p99 on the cache-miss
+ * tier.  The pool keeps a handful of high-water buffers alive across
+ * requests AND across connections (the server is thread-per-connection,
+ * so thread-local scratch would leak per connection and never stay
+ * warm).  pool_get always returns an owned Buf (possibly freshly
+ * allocated; data==NULL only on OOM); pool_put returns it to a free
+ * slot or frees it when the pool is full — bounded memory, no leak. */
+#include <pthread.h>
+#define POOL_SLOTS 8
+static pthread_mutex_t pool_lock = PTHREAD_MUTEX_INITIALIZER;
+static Buf buf_pool[POOL_SLOTS];
+
+static Buf pool_get(size_t want) {
+    Buf b = {NULL, 0, 0};
+    pthread_mutex_lock(&pool_lock);
+    for (int i = 0; i < POOL_SLOTS; i++) {
+        if (buf_pool[i].data) {
+            b = buf_pool[i];
+            buf_pool[i].data = NULL;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&pool_lock);
+    if (b.data) {
+        b.len = 0;
+        if (want && buf_reserve(&b, want) < 0) {
+            buf_free(&b);
+            b.data = NULL;
+        }
+    } else if (buf_init(&b, want ? want : 4096) < 0) {
+        b.data = NULL;
+    }
+    return b;
+}
+
+static void pool_put(Buf *b) {
+    if (!b->data) return;
+    pthread_mutex_lock(&pool_lock);
+    for (int i = 0; i < POOL_SLOTS; i++) {
+        if (!buf_pool[i].data) {
+            buf_pool[i] = *b;
+            b->data = NULL;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&pool_lock);
+    if (b->data) buf_free(b);
+}
+
 /* ------------------------------------------------------------------ */
 /* JSON scanner over a byte body                                       */
 
@@ -639,10 +693,24 @@ static int scan_pod(Scan *sc, ParsedArgs *pa) {
     }
 }
 
+/* process-wide high-water candidate count: the first growth of a name
+ * array jumps straight to the size recent requests needed, collapsing
+ * the realloc chain (each step past the mmap threshold is a fresh
+ * mapping + copy — p99 churn at 10k nodes).  Atomic because the server
+ * is thread-per-connection (a per-thread hint would reset every
+ * connection); relaxed ordering — the hint is only an optimization. */
+#include <stdatomic.h>
+static _Atomic Py_ssize_t names_hint = NAME_CHUNK;
+
+static Py_ssize_t grow_cap(Py_ssize_t cap) {
+    return cap ? cap * 2
+               : atomic_load_explicit(&names_hint, memory_order_relaxed);
+}
+
 static int push_name(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap,
                      const StrSlice *sl) {
     if (pa->num_names == *cap) {
-        Py_ssize_t ncap = *cap ? *cap * 2 : NAME_CHUNK;
+        Py_ssize_t ncap = grow_cap(*cap);
         StrSlice *nn = realloc(pa->names, ncap * sizeof(StrSlice));
         if (!nn) return fail("out of memory");
         pa->names = nn;
@@ -747,7 +815,7 @@ static int scan_node_names(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
         StrSlice name;
         if (scan_string(sc, &name) < 0) return -1;
         if (pa->num_nn_names == *cap) {
-            Py_ssize_t ncap = *cap ? *cap * 2 : NAME_CHUNK;
+            Py_ssize_t ncap = grow_cap(*cap);
             StrSlice *nn = realloc(pa->nn_names, ncap * sizeof(StrSlice));
             if (!nn) return fail("out of memory");
             pa->nn_names = nn;
@@ -915,6 +983,15 @@ static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
         Py_DECREF(pa);
         PyErr_SetString(PyExc_ValueError, sc->err ? sc->err : "parse error");
         return NULL;
+    }
+    /* remember this request's candidate count so the next request's
+     * array starts at the right size (thread-local: no races) */
+    Py_ssize_t seen = pa->num_names > pa->num_nn_names ? pa->num_names
+                                                       : pa->num_nn_names;
+    if (seen > atomic_load_explicit(&names_hint, memory_order_relaxed)) {
+        Py_ssize_t h = NAME_CHUNK;
+        while (h < seen) h *= 2;
+        atomic_store_explicit(&names_hint, h, memory_order_relaxed);
     }
     return (PyObject *)pa;
 }
@@ -1151,12 +1228,16 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
     Py_ssize_t num_cand = use_node_names ? pa->num_nn_names : pa->num_names;
 
     /* candidate mask over rows; escaped names (rare) resolve under the
-     * GIL first, everything else runs GIL-free below */
-    uint8_t *mask = calloc((size_t)t->n_rows + 1, 1);
-    if (!mask) {
+     * GIL first, everything else runs GIL-free below.  The mask lives in
+     * thread-local scratch (stale bytes cleared here) — a fresh calloc
+     * per request at 10k rows churns pages into p99 */
+    Buf mask_buf = pool_get((size_t)t->n_rows + 1);
+    if (!mask_buf.data) {
         PyBuffer_Release(&ranked);
         return PyErr_NoMemory();
     }
+    uint8_t *mask = (uint8_t *)mask_buf.data;
+    memset(mask, 0, (size_t)t->n_rows + 1);
     for (Py_ssize_t k = 0; k < num_cand; k++) {
         const StrSlice *sl = &cand[k];
         if (sl->present && sl->escaped) {
@@ -1172,7 +1253,8 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
     }
 
     const char *body = PyBytes_AS_STRING(pa->body);
-    Buf out;
+    Buf out_buf = {NULL, 0, 0};
+    Buf *out = &out_buf;
     int oom = 0;
     Py_BEGIN_ALLOW_THREADS
     for (Py_ssize_t k = 0; k < num_cand; k++) {
@@ -1187,7 +1269,8 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
     for (Py_ssize_t row = 0; row < t->n_rows; row++)
         if (mask[row])
             est += (size_t)(t->frag_off[row + 1] - t->frag_off[row]) + 16;
-    if (buf_init(&out, est) < 0) oom = 1;
+    out_buf = pool_get(est);
+    if (!out_buf.data) oom = 1;
 
     if (!oom) {
         int promote = 0;
@@ -1199,12 +1282,12 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
         }
         long rank = 0;
         int first = 1;
-        if (buf_put(&out, "[", 1) < 0) oom = 1;
+        if (buf_put(out, "[", 1) < 0) oom = 1;
         if (!oom && promote) {
             Py_ssize_t off = t->frag_off[planned_row];
-            if (buf_put(&out, t->frag_bytes + off,
+            if (buf_put(out, t->frag_bytes + off,
                         (size_t)(t->frag_off[planned_row + 1] - off)) < 0 ||
-                put_score(&out, 10) < 0)
+                put_score(out, 10) < 0)
                 oom = 1;
             rank = 1;
             first = 0;
@@ -1213,33 +1296,33 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
             int64_t row = order[k];
             if (row < 0 || row >= t->n_rows || !mask[row]) continue;
             if (promote && row == planned_row) continue;
-            if (!first && buf_put(&out, ", ", 2) < 0) { oom = 1; break; }
+            if (!first && buf_put(out, ", ", 2) < 0) { oom = 1; break; }
             first = 0;
             Py_ssize_t off = t->frag_off[row];
-            if (buf_put(&out, t->frag_bytes + off,
+            if (buf_put(out, t->frag_bytes + off,
                         (size_t)(t->frag_off[row + 1] - off)) < 0 ||
-                put_score(&out, 10 - rank) < 0) {
+                put_score(out, 10 - rank) < 0) {
                 oom = 1;
                 break;
             }
             rank++;
         }
-        if (!oom && buf_put(&out, "]\n", 2) < 0) oom = 1;
+        if (!oom && buf_put(out, "]\n", 2) < 0) oom = 1;
     }
     Py_END_ALLOW_THREADS
 
-    free(mask);
+    pool_put(&mask_buf);
     PyBuffer_Release(&ranked);
     if (oom) {
-        buf_free(&out);
+        pool_put(&out_buf);
         return PyErr_NoMemory();
     }
-    PyObject *res = PyBytes_FromStringAndSize(out.data, (Py_ssize_t)out.len);
-    buf_free(&out);
+    PyObject *res = PyBytes_FromStringAndSize(out->data, (Py_ssize_t)out->len);
+    pool_put(&out_buf);
     return res;
 
 error:
-    free(mask);
+    pool_put(&mask_buf);
     PyBuffer_Release(&ranked);
     return NULL;
 }
@@ -1296,8 +1379,8 @@ static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
     PyObject **enc_obj = NULL;     /* owned refs backing enc_ptr */
     Py_ssize_t n_enc = 0;
     PyObject *json_mod = NULL, *res = NULL;
-    Buf out;
-    out.data = NULL;
+    Buf out_buf = {NULL, 0, 0};
+    Buf *out = &out_buf;
     int oom = 0;
 
     rows = PyMem_Malloc((size_t)(num ? num : 1) * sizeof(Py_ssize_t));
@@ -1358,52 +1441,53 @@ static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
 
     Py_BEGIN_ALLOW_THREADS
     /* "name", -> len+4 each; failed entry adds ': "Node violates"' (18) */
-    if (buf_init(&out, 96 + span_bytes + (size_t)num * 24) < 0) oom = 1;
-    if (!oom && buf_put(&out, "{\"Nodes\": null, \"NodeNames\": [", 30) < 0)
+    out_buf = pool_get(96 + span_bytes + (size_t)num * 24);
+    if (!out_buf.data) oom = 1;
+    if (!oom && buf_put(out, "{\"Nodes\": null, \"NodeNames\": [", 30) < 0)
         oom = 1;
     int first = 1;
     for (Py_ssize_t k = 0; !oom && k < num; k++) {
         Py_ssize_t row = rows[k];
         if (row >= 0 && vmask[row]) continue;  /* violating -> FailedNodes */
-        if (!first && buf_put(&out, ", ", 2) < 0) { oom = 1; break; }
+        if (!first && buf_put(out, ", ", 2) < 0) { oom = 1; break; }
         first = 0;
         if (raw_ok[k]) {
             const StrSlice *sl = &cand[k];
-            if (buf_put(&out, "\"", 1) < 0 ||
-                buf_put(&out, body + sl->off, (size_t)sl->len) < 0 ||
-                buf_put(&out, "\"", 1) < 0)
+            if (buf_put(out, "\"", 1) < 0 ||
+                buf_put(out, body + sl->off, (size_t)sl->len) < 0 ||
+                buf_put(out, "\"", 1) < 0)
                 oom = 1;
         } else {
-            if (buf_put(&out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
+            if (buf_put(out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
         }
     }
-    if (!oom && buf_put(&out, "], \"FailedNodes\": {", 19) < 0) oom = 1;
+    if (!oom && buf_put(out, "], \"FailedNodes\": {", 19) < 0) oom = 1;
     first = 1;
     for (Py_ssize_t k = 0; !oom && k < num; k++) {
         Py_ssize_t row = rows[k];
         if (row < 0 || !vmask[row] || seen[row]) continue;
         seen[row] = 1;
-        if (!first && buf_put(&out, ", ", 2) < 0) { oom = 1; break; }
+        if (!first && buf_put(out, ", ", 2) < 0) { oom = 1; break; }
         first = 0;
         if (raw_ok[k]) {
             const StrSlice *sl = &cand[k];
-            if (buf_put(&out, "\"", 1) < 0 ||
-                buf_put(&out, body + sl->off, (size_t)sl->len) < 0 ||
-                buf_put(&out, "\"", 1) < 0)
+            if (buf_put(out, "\"", 1) < 0 ||
+                buf_put(out, body + sl->off, (size_t)sl->len) < 0 ||
+                buf_put(out, "\"", 1) < 0)
                 oom = 1;
         } else {
-            if (buf_put(&out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
+            if (buf_put(out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
         }
-        if (!oom && buf_put(&out, ": \"Node violates\"", 17) < 0) oom = 1;
+        if (!oom && buf_put(out, ": \"Node violates\"", 17) < 0) oom = 1;
     }
-    if (!oom && buf_put(&out, "}, \"Error\": \"\"}\n", 16) < 0) oom = 1;
+    if (!oom && buf_put(out, "}, \"Error\": \"\"}\n", 16) < 0) oom = 1;
     Py_END_ALLOW_THREADS
 
     if (oom) PyErr_NoMemory();
-    else res = PyBytes_FromStringAndSize(out.data, (Py_ssize_t)out.len);
+    else res = PyBytes_FromStringAndSize(out->data, (Py_ssize_t)out->len);
 
 done:
-    if (out.data) buf_free(&out);
+    pool_put(&out_buf);
     if (enc_obj) {
         for (Py_ssize_t k = 0; k < num; k++) Py_XDECREF(enc_obj[k]);
     }

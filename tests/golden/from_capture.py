@@ -14,21 +14,26 @@ representative pairs, and commit them alongside the hand-derived
 fixtures (generate.py) with updated expectations.
 """
 
+import base64
+import binascii
 import json
 import os
 import re
 import sys
 
+# bodies are base64 on one line (extender/server.py v5 dump): recovery is
+# byte-exact — trailing newlines survive, and no body content can collide
+# with the log format's own delimiters
 WIRE_REQ = re.compile(
-    r"WIRE request POST /scheduler/(\w+) body=(.*?)(?: component=|$)"
+    r"WIRE request POST /scheduler/(\w+) b64=([A-Za-z0-9+/=]*)"
 )
 WIRE_RESP = re.compile(
-    r"WIRE response /scheduler/(\w+) status=(\d+) body=(.*?)(?: component=|$)"
+    r"WIRE response /scheduler/(\w+) status=(\d+) b64=([A-Za-z0-9+/=]*)"
 )
 
 
 def extract(log_text: str):
-    """Yield (verb, request body, status, response body) in log order.
+    """Yield (verb, request bytes, status, response bytes) in log order.
     Pairing is FIFO per verb: each response matches the OLDEST unanswered
     request for that verb.
 
@@ -42,14 +47,22 @@ def extract(log_text: str):
     for line in log_text.splitlines():
         m = WIRE_REQ.search(line)
         if m:
-            pending.setdefault(m.group(1), []).append(m.group(2))
+            try:
+                body = base64.b64decode(m.group(2), validate=True)
+            except binascii.Error:
+                continue  # truncated log line: drop, never mispair
+            pending.setdefault(m.group(1), []).append(body)
             continue
         m = WIRE_RESP.search(line)
         if m:
-            verb, status, body = m.group(1), int(m.group(2)), m.group(3)
-            stack = pending.get(verb)
-            if stack:
-                yield verb, stack.pop(0), status, body
+            verb, status = m.group(1), int(m.group(2))
+            try:
+                body = base64.b64decode(m.group(3), validate=True)
+            except binascii.Error:
+                continue
+            queue = pending.get(verb)
+            if queue:
+                yield verb, queue.pop(0), status, body
 
 
 def main(log_path: str, out_dir: str) -> int:
@@ -60,9 +73,9 @@ def main(log_path: str, out_dir: str) -> int:
     for i, (verb, req, status, resp) in enumerate(extract(text)):
         req_name = f"{i:03d}_{verb}_request.json"
         resp_name = f"{i:03d}_{verb}_response.json"
-        with open(os.path.join(out_dir, req_name), "w") as f:
+        with open(os.path.join(out_dir, req_name), "wb") as f:
             f.write(req)
-        with open(os.path.join(out_dir, resp_name), "w") as f:
+        with open(os.path.join(out_dir, resp_name), "wb") as f:
             f.write(resp)
         entry = {"verb": verb, "status": status, "request": req_name,
                  "response": resp_name}

@@ -79,13 +79,24 @@ class TestWireCaptureRoundTrip:
         index = json.loads((out / "index.json").read_text())
         verbs = [e["verb"] for e in index]
         assert verbs == ["prioritize", "filter"]
+        body = (
+            b'{"pod": {"metadata": {"name": "p"}}, "nodenames": ["n1"]}'
+        )
+        expected_resp = {
+            "prioritize": b'[{"Host": "n1", "Score": 10}]\n',
+            "filter": (
+                b'{"Nodes": null, "NodeNames": ["n1"], "FailedNodes": {}, '
+                b'"Error": ""}\n'
+            ),
+        }
         for entry in index:
-            req = (out / entry["request"]).read_text()
-            assert json.loads(req)["nodenames"] == ["n1"]
+            # byte-exact round trip, including the trailing newline the
+            # encoders emit (base64 transport can't lose or split it)
+            assert (out / entry["request"]).read_bytes() == body
             assert entry["candidates"] == 1
-            resp = (out / entry["response"]).read_text()
             assert entry["status"] == 200
-            json.loads(resp)
+            resp = (out / entry["response"]).read_bytes()
+            assert resp == expected_resp[entry["verb"]]
 
     def test_cli_usage(self):
         proc = subprocess.run(
