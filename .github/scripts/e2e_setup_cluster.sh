@@ -21,7 +21,10 @@ REPO_ROOT=$(cd "$SCRIPT_DIR/../.." && pwd)
 # lifetime — so the path is recorded in the repo workspace for
 # e2e_teardown_cluster.sh to clean up
 CONFIG_DIR=$(mktemp -d -t pas-e2e-XXXXXXXX)
-echo "$CONFIG_DIR" > "$REPO_ROOT/.e2e-config-dir"
+# record keyed by cluster name: concurrent clusters (or a rerun) must
+# not overwrite each other's record — teardown of one cluster deleting
+# another's still-mounted config dir would break its live scheduler
+echo "$CONFIG_DIR" > "$REPO_ROOT/.e2e-config-dir-$CLUSTER"
 
 write_scheduler_config() {
   # kube-scheduler runs hostNetwork: it cannot resolve cluster-DNS

@@ -8,11 +8,12 @@ SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 REPO_ROOT=$(cd "$SCRIPT_DIR/../.." && pwd)
 kind delete cluster --name "$CLUSTER" || true
 # the scheduler-config dir the setup script host-mounted into the node
-# (path recorded by e2e_setup_cluster.sh; only remove what we created)
-if [[ -f "$REPO_ROOT/.e2e-config-dir" ]]; then
-  dir=$(cat "$REPO_ROOT/.e2e-config-dir")
+# (path recorded per cluster by e2e_setup_cluster.sh; only remove what
+# this cluster's setup created)
+if [[ -f "$REPO_ROOT/.e2e-config-dir-$CLUSTER" ]]; then
+  dir=$(cat "$REPO_ROOT/.e2e-config-dir-$CLUSTER")
   case "$dir" in
     */pas-e2e-*) rm -rf "$dir" ;;
   esac
-  rm -f "$REPO_ROOT/.e2e-config-dir"
+  rm -f "$REPO_ROOT/.e2e-config-dir-$CLUSTER"
 fi

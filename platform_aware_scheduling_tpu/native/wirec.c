@@ -985,7 +985,8 @@ static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
         return NULL;
     }
     /* remember this request's candidate count so the next request's
-     * array starts at the right size (thread-local: no races) */
+     * array starts at the right size (process-wide atomic, relaxed —
+     * the hint is only an allocation-size optimization) */
     Py_ssize_t seen = pa->num_names > pa->num_nn_names ? pa->num_names
                                                        : pa->num_nn_names;
     if (seen > atomic_load_explicit(&names_hint, memory_order_relaxed)) {
@@ -1228,9 +1229,9 @@ static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
     Py_ssize_t num_cand = use_node_names ? pa->num_nn_names : pa->num_names;
 
     /* candidate mask over rows; escaped names (rare) resolve under the
-     * GIL first, everything else runs GIL-free below.  The mask lives in
-     * thread-local scratch (stale bytes cleared here) — a fresh calloc
-     * per request at 10k rows churns pages into p99 */
+     * GIL first, everything else runs GIL-free below.  The mask comes
+     * from the process-wide buffer pool (stale bytes cleared here) — a
+     * fresh calloc per request at 10k rows churns pages into p99 */
     Buf mask_buf = pool_get((size_t)t->n_rows + 1);
     if (!mask_buf.data) {
         PyBuffer_Release(&ranked);

@@ -23,13 +23,25 @@ import sys
 
 # bodies are base64 on one line (extender/server.py v5 dump): recovery is
 # byte-exact — trailing newlines survive, and no body content can collide
-# with the log format's own delimiters
+# with the log format's own delimiters.  The explicit len= guards against
+# log-line truncation: a cut base64 string can still decode "validly" if
+# the cut lands on a 4-char boundary, but its length won't match.
 WIRE_REQ = re.compile(
-    r"WIRE request POST /scheduler/(\w+) b64=([A-Za-z0-9+/=]*)"
+    r"WIRE request POST /scheduler/(\w+) len=(\d+) b64=([A-Za-z0-9+/=]*)"
 )
 WIRE_RESP = re.compile(
-    r"WIRE response /scheduler/(\w+) status=(\d+) b64=([A-Za-z0-9+/=]*)"
+    r"WIRE response /scheduler/(\w+) status=(\d+) len=(\d+) "
+    r"b64=([A-Za-z0-9+/=]*)"
 )
+
+
+def _decode_checked(length_str: str, b64_str: str):
+    """bytes or None: base64 must validate AND match the declared length."""
+    try:
+        body = base64.b64decode(b64_str, validate=True)
+    except binascii.Error:
+        return None
+    return body if len(body) == int(length_str) else None
 
 
 def extract(log_text: str):
@@ -47,22 +59,30 @@ def extract(log_text: str):
     for line in log_text.splitlines():
         m = WIRE_REQ.search(line)
         if m:
-            try:
-                body = base64.b64decode(m.group(2), validate=True)
-            except binascii.Error:
-                continue  # truncated log line: drop, never mispair
-            pending.setdefault(m.group(1), []).append(body)
+            body = _decode_checked(m.group(2), m.group(3))
+            if body is None:
+                # truncated request line: poison this verb's queue with a
+                # placeholder so its (valid) response is consumed against
+                # it and discarded — pairing order survives
+                pending.setdefault(m.group(1), []).append(None)
+            else:
+                pending.setdefault(m.group(1), []).append(body)
             continue
         m = WIRE_RESP.search(line)
         if m:
             verb, status = m.group(1), int(m.group(2))
-            try:
-                body = base64.b64decode(m.group(3), validate=True)
-            except binascii.Error:
-                continue
             queue = pending.get(verb)
+            body = _decode_checked(m.group(3), m.group(4))
+            if body is None:
+                # truncated response line: its request must be consumed
+                # too, or every later pair for this verb shifts by one
+                if queue:
+                    queue.pop(0)
+                continue
             if queue:
-                yield verb, queue.pop(0), status, body
+                request_body = queue.pop(0)
+                if request_body is not None:
+                    yield verb, request_body, status, body
 
 
 def main(log_path: str, out_dir: str) -> int:

@@ -98,6 +98,46 @@ class TestWireCaptureRoundTrip:
             resp = (out / entry["response"]).read_bytes()
             assert resp == expected_resp[entry["verb"]]
 
+    def test_truncated_response_consumes_its_request(self):
+        """A corrupt response line must eat its request too — otherwise
+        every later pair for that verb shifts by one and fixtures get
+        committed with request N paired to response N+1."""
+        import base64
+
+        def b64(b):
+            return base64.b64encode(b).decode()
+
+        log = "\n".join(
+            [
+                f"I WIRE request POST /scheduler/prioritize len=5 b64={b64(b'req-1')}",
+                # truncated at a 4-char base64 boundary: decodes "validly"
+                # but the declared length exposes it
+                f"I WIRE response /scheduler/prioritize status=200 len=9 b64={b64(b'resp')[:4]}",
+                f"I WIRE request POST /scheduler/prioritize len=5 b64={b64(b'req-2')}",
+                f"I WIRE response /scheduler/prioritize status=200 len=6 b64={b64(b'resp-2')}",
+            ]
+        )
+        pairs = list(from_capture.extract(log))
+        assert pairs == [("prioritize", b"req-2", 200, b"resp-2")]
+
+    def test_truncated_request_discards_its_response(self):
+        import base64
+
+        def b64(b):
+            return base64.b64encode(b).decode()
+
+        log = "\n".join(
+            [
+                # request line cut mid-base64 (declared length mismatch)
+                f"I WIRE request POST /scheduler/filter len=100 b64={b64(b'cut!')}",
+                f"I WIRE response /scheduler/filter status=200 len=7 b64={b64(b'resp-X!')}",
+                f"I WIRE request POST /scheduler/filter len=5 b64={b64(b'req-2')}",
+                f"I WIRE response /scheduler/filter status=200 len=6 b64={b64(b'resp-2')}",
+            ]
+        )
+        pairs = list(from_capture.extract(log))
+        assert pairs == [("filter", b"req-2", 200, b"resp-2")]
+
     def test_cli_usage(self):
         proc = subprocess.run(
             [sys.executable, os.path.join(GOLDEN, "from_capture.py")],

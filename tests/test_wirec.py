@@ -274,6 +274,76 @@ class TestFilterNativeParity:
             wirec.filter_encode(parsed, table, b"\x01")
 
 
+class TestEncoderPoolConcurrency:
+    """The process-wide buffer pool behind select_encode/filter_encode:
+    many threads hammering both encoders (GIL-free sections overlap for
+    real) must produce byte-correct output — a pooled buffer handed to
+    two requests at once, or stale mask bytes surviving reuse, would
+    corrupt responses."""
+
+    def test_parallel_encoders_byte_correct(self):
+        import threading
+
+        rng = np.random.default_rng(3)
+        n = 600
+        names = [f"node-{i:04d}" for i in range(n)]
+        table = wirec.build_table(names)
+        ranked = np.argsort(
+            rng.permutation(n), kind="stable"
+        ).astype(np.int64)
+        masks = [
+            (rng.random(n) < p).astype(np.uint8).tobytes()
+            for p in (0.0, 0.3, 0.9)
+        ]
+        subsets = []
+        for _ in range(6):
+            chosen = sorted(rng.choice(n, size=200, replace=False))
+            body = json.dumps(
+                {
+                    "Pod": {"metadata": {"name": "p"}},
+                    "NodeNames": [names[i] for i in chosen],
+                }
+            ).encode()
+            subsets.append(body)
+        # per-workload expected bytes computed single-threaded first
+        expected = {}
+        for bi, body in enumerate(subsets):
+            parsed = wirec.parse_prioritize(body)
+            expected[("sel", bi)] = wirec.select_encode(
+                parsed, table, ranked, -1, True
+            )
+            for mi, mask in enumerate(masks):
+                expected[("fil", bi, mi)] = wirec.filter_encode(
+                    parsed, table, mask
+                )
+        errors = []
+
+        def worker(seed):
+            r = np.random.default_rng(seed)
+            for _ in range(120):
+                bi = int(r.integers(len(subsets)))
+                parsed = wirec.parse_prioritize(subsets[bi])
+                if r.random() < 0.5:
+                    got = wirec.select_encode(parsed, table, ranked, -1, True)
+                    want = expected[("sel", bi)]
+                else:
+                    mi = int(r.integers(len(masks)))
+                    got = wirec.filter_encode(parsed, table, masks[mi])
+                    want = expected[("fil", bi, mi)]
+                if got != want:
+                    errors.append((seed, bi))
+                    return
+
+        threads = [
+            threading.Thread(target=worker, args=(s,)) for s in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
 class TestScannerStrictness:
     @pytest.mark.parametrize(
         "bad",
