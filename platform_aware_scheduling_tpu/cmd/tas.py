@@ -66,6 +66,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="serve Prioritize/Filter from Args.NodeNames "
                         "(register the extender nodeCacheCapable: true); "
                         "large clusters avoid shipping full node objects")
+    parser.add_argument("--profilePort", type=int, default=0,
+                        help="start the JAX profiler server on this port "
+                        "(0 = off): connect TensorBoard/xprof on demand to "
+                        "trace the device kernels with zero steady-state "
+                        "overhead (SURVEY §5.1 — the reference has no "
+                        "tracing at all)")
     return parser
 
 
@@ -133,6 +139,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         batch_solver=args.batchSolver,
         node_cache_capable=args.nodeCacheCapable,
     )
+
+    if args.profilePort:
+        try:
+            import jax.profiler
+
+            jax.profiler.start_server(args.profilePort)
+            klog.v(1).info_s(
+                f"JAX profiler serving on :{args.profilePort}",
+                component="extender",
+            )
+        except Exception as exc:  # profiling must never block serving
+            klog.error("profiler server failed: %s", exc)
 
     from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 

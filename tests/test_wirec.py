@@ -319,20 +319,25 @@ class TestEncoderPoolConcurrency:
         errors = []
 
         def worker(seed):
-            r = np.random.default_rng(seed)
-            for _ in range(120):
-                bi = int(r.integers(len(subsets)))
-                parsed = wirec.parse_prioritize(subsets[bi])
-                if r.random() < 0.5:
-                    got = wirec.select_encode(parsed, table, ranked, -1, True)
-                    want = expected[("sel", bi)]
-                else:
-                    mi = int(r.integers(len(masks)))
-                    got = wirec.filter_encode(parsed, table, masks[mi])
-                    want = expected[("fil", bi, mi)]
-                if got != want:
-                    errors.append((seed, bi))
-                    return
+            try:
+                r = np.random.default_rng(seed)
+                for _ in range(120):
+                    bi = int(r.integers(len(subsets)))
+                    parsed = wirec.parse_prioritize(subsets[bi])
+                    if r.random() < 0.5:
+                        got = wirec.select_encode(
+                            parsed, table, ranked, -1, True
+                        )
+                        want = expected[("sel", bi)]
+                    else:
+                        mi = int(r.integers(len(masks)))
+                        got = wirec.filter_encode(parsed, table, masks[mi])
+                        want = expected[("fil", bi, mi)]
+                    if got != want:
+                        errors.append((seed, bi))
+                        return
+            except Exception as exc:  # a dying thread must fail the test
+                errors.append((seed, repr(exc)))
 
         threads = [
             threading.Thread(target=worker, args=(s,)) for s in range(8)
