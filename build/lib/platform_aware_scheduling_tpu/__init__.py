@@ -1,0 +1,17 @@
+"""platform_aware_scheduling_tpu — a TPU-native platform-aware scheduling framework.
+
+A brand-new implementation of the capabilities of
+intel/platform-aware-scheduling (reference at /root/reference): Kubernetes
+scheduler extenders that filter / prioritize / bind pods on live platform
+telemetry (TAS) and per-GPU-card resource bin-packing (GAS).
+
+Instead of the reference's per-pod, per-node Go loops, the scoring and
+placement core here is a batched JAX/XLA program: rule evaluation, ranking,
+and per-card feasibility are computed over dense (pods x nodes x metrics)
+tensors in one compiled pass (see ``ops/`` and ``models/``), sharded over a
+``jax.sharding.Mesh`` for large clusters (see ``parallel/``). The host-side
+subsystems (HTTP extender protocol, policy CRD controller, caches, informers)
+live in ``extender/``, ``tas/``, ``gas/``, and ``kube/``.
+"""
+
+__version__ = "0.1.0"

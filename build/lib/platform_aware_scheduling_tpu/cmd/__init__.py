@@ -1,0 +1,3 @@
+"""Service entry points (the reference's cmd/ binaries):
+``cmd.tas`` — telemetry-aware scheduling extender,
+``cmd.gas`` — GPU-aware scheduling extender."""

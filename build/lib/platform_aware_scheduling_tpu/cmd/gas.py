@@ -1,0 +1,80 @@
+"""GAS service main.
+
+Reference: gpu-aware-scheduling/cmd/gas-scheduler-extender/main.go:11-35 —
+flags, extender assembly, HTTP(S) serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+from typing import List, Optional
+
+from platform_aware_scheduling_tpu.extender.server import Server
+from platform_aware_scheduling_tpu.gas.scheduler import GASExtender
+from platform_aware_scheduling_tpu.kube.client import get_kube_client
+from platform_aware_scheduling_tpu.utils import klog
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="gas-extender",
+        description="GPU-aware scheduling extender (TPU-native)",
+    )
+    default_kubeconfig = os.path.join(
+        os.environ.get("HOME", "/root"), ".kube", "config"
+    )
+    parser.add_argument("--kubeConfig", default=default_kubeconfig)
+    parser.add_argument("--port", default="9001")
+    parser.add_argument("--cert", default="/etc/kubernetes/pki/ca.crt")
+    parser.add_argument("--key", default="/etc/kubernetes/pki/ca.key")
+    parser.add_argument("--cacert", default="/etc/kubernetes/pki/ca.crt")
+    parser.add_argument("--unsafe", action="store_true")
+    parser.add_argument("--v", type=int, default=4, help="klog verbosity")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    klog.set_verbosity(args.v)
+
+    kube_client = get_kube_client(args.kubeConfig)
+    extender = GASExtender(kube_client)
+
+    from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
+
+    tune_for_serving()
+    server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
+    done = threading.Event()
+    failed = []
+
+    def serve():
+        try:
+            server.start_server(
+                port=args.port,
+                cert_file=args.cert,
+                key_file=args.key,
+                ca_file=args.cacert,
+                unsafe=args.unsafe,
+                block=True,
+            )
+        except Exception as exc:
+            klog.error("extender server failed: %s", exc)
+            failed.append(exc)
+            done.set()
+
+    threading.Thread(target=serve, daemon=True).start()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    extender.cache.stop()
+    server.shutdown()
+    klog.v(1).info_s("Exiting", component="extender")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

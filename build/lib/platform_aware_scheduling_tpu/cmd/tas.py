@@ -1,0 +1,192 @@
+"""TAS service main: flags, assembly, signal handling.
+
+Reference: telemetry-aware-scheduling/cmd/main.go:31-117.  Identical flag
+surface (``--kubeConfig --port --cert --key --cacert --unsafe --syncPeriod``
+plus klog ``--v``); assembly adds the TPU twist: a TensorStateMirror is
+attached to the cache so the extender's hot path runs the jitted scoring
+kernels, with the exact host path as automatic fallback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+from typing import List, Optional
+
+from platform_aware_scheduling_tpu.extender.server import Server
+from platform_aware_scheduling_tpu.kube.client import KubeClient, get_kube_client
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.controller import TelemetryPolicyController
+from platform_aware_scheduling_tpu.tas.metrics import CustomMetricsClient
+from platform_aware_scheduling_tpu.tas.strategies import (
+    core,
+    deschedule,
+    dontschedule,
+    scheduleonmetric,
+)
+from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
+from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tas-extender",
+        description="Telemetry-aware scheduling extender (TPU-native)",
+    )
+    default_kubeconfig = os.path.join(
+        os.environ.get("HOME", "/root"), ".kube", "config"
+    )
+    parser.add_argument("--kubeConfig", default=default_kubeconfig,
+                        help="location of kubernetes config file")
+    parser.add_argument("--port", default="9001",
+                        help="port on which the scheduler extender will listen")
+    parser.add_argument("--cert", default="/etc/kubernetes/pki/ca.crt",
+                        help="cert file extender will use")
+    parser.add_argument("--key", default="/etc/kubernetes/pki/ca.key",
+                        help="key file extender will use")
+    parser.add_argument("--cacert", default="/etc/kubernetes/pki/ca.crt",
+                        help="ca file extender will use")
+    parser.add_argument("--unsafe", action="store_true",
+                        help="unsafe instances of extender will be served over http")
+    parser.add_argument("--syncPeriod", default="5s",
+                        help="interval between cache syncs, e.g. 1m or 2s")
+    parser.add_argument("--v", type=int, default=2, help="klog verbosity")
+    parser.add_argument("--batchPlanner", action="store_true",
+                        help="solve the whole pending set each sync period "
+                        "and steer pods onto their batch-assigned nodes")
+    parser.add_argument("--batchSolver", default="greedy",
+                        choices=["greedy", "sinkhorn"],
+                        help="batch planner solver: greedy (sequential-"
+                        "equivalent) or sinkhorn (globally coordinated)")
+    parser.add_argument("--nodeCacheCapable", action="store_true",
+                        help="serve Prioritize/Filter from Args.NodeNames "
+                        "(register the extender nodeCacheCapable: true); "
+                        "large clusters avoid shipping full node objects")
+    parser.add_argument("--profilePort", type=int, default=0,
+                        help="start the JAX profiler server on this port "
+                        "(0 = off): connect TensorBoard/xprof on demand to "
+                        "trace the device kernels with zero steady-state "
+                        "overhead (SURVEY §5.1 — the reference has no "
+                        "tracing at all)")
+    return parser
+
+
+def assemble(
+    kube_client: KubeClient,
+    metrics_client,
+    sync_period_s: float,
+    enable_device_path: bool = True,
+    enable_batch_planner: bool = False,
+    batch_solver: str = "greedy",
+    node_cache_capable: bool = False,
+):
+    """Wire cache + mirror + extender + controller + enforcer (the body of
+    ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
+    a stop Event controlling every background loop."""
+    cache = AutoUpdatingCache()
+    mirror: Optional[TensorStateMirror] = None
+    if enable_device_path:
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+    planner = None
+    if enable_batch_planner and mirror is not None:
+        from platform_aware_scheduling_tpu.tas.planner import BatchPlanner
+
+        planner = BatchPlanner(cache, mirror, solver=batch_solver)
+    extender = MetricsExtender(
+        cache,
+        mirror=mirror,
+        planner=planner,
+        node_cache_capable=node_cache_capable,
+    )
+
+    enforcer = core.MetricEnforcer(kube_client, mirror=mirror)
+    enforcer.register_strategy_type(deschedule.Strategy())
+    enforcer.register_strategy_type(scheduleonmetric.Strategy())
+    enforcer.register_strategy_type(dontschedule.Strategy())
+
+    controller = TelemetryPolicyController(kube_client, cache, enforcer)
+
+    stop = threading.Event()
+    cache.start_periodic_update(sync_period_s, metrics_client, stop=stop)
+    controller.run(stop)
+    enforcer.start_enforcing(cache, sync_period_s, stop=stop)
+    if planner is not None:
+        planner_informer = planner.watch(kube_client)
+        planner.start(sync_period_s)
+        threading.Thread(
+            target=lambda: (stop.wait(), planner_informer.stop()), daemon=True
+        ).start()
+    return cache, mirror, extender, controller, enforcer, stop
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    klog.set_verbosity(args.v)
+    sync_period_s = parse_duration(args.syncPeriod)
+
+    kube_client = get_kube_client(args.kubeConfig)
+    metrics_client = CustomMetricsClient(kube_client)
+    _, _, extender, _, _, stop = assemble(
+        kube_client,
+        metrics_client,
+        sync_period_s,
+        enable_batch_planner=args.batchPlanner,
+        batch_solver=args.batchSolver,
+        node_cache_capable=args.nodeCacheCapable,
+    )
+
+    if args.profilePort:
+        try:
+            import jax.profiler
+
+            jax.profiler.start_server(args.profilePort)
+            klog.v(1).info_s(
+                f"JAX profiler serving on :{args.profilePort}",
+                component="extender",
+            )
+        except Exception as exc:  # profiling must never block serving
+            klog.error("profiler server failed: %s", exc)
+
+    from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
+
+    tune_for_serving()
+    server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
+    done = threading.Event()
+    failed = []
+
+    def serve():
+        try:
+            server.start_server(
+                port=args.port,
+                cert_file=args.cert,
+                key_file=args.key,
+                ca_file=args.cacert,
+                unsafe=args.unsafe,
+                block=True,
+            )
+        except Exception as exc:
+            # a dead server must take the process down so the kubelet
+            # restarts it, not leave a Running pod that serves nothing
+            klog.error("extender server failed: %s", exc)
+            failed.append(exc)
+            done.set()
+
+    threading.Thread(target=serve, daemon=True).start()
+
+    # catchInterrupt (reference cmd/main.go:113-117)
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    stop.set()
+    server.shutdown()
+    klog.v(1).info_s("Exiting", component="extender")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,32 @@
+"""Scheduler-extender wire layer: protocol types, HTTP(S) server, middleware.
+
+The north-facing protocol of the framework — kube-scheduler POSTs JSON to
+``/scheduler/{filter,prioritize,bind}`` — is kept wire-compatible with the
+reference (reference extender/scheduler.go:86-91, extender/types.go:26-82).
+"""
+
+from platform_aware_scheduling_tpu.extender.types import (
+    Args,
+    BindingArgs,
+    BindingResult,
+    FilterResult,
+    HostPriority,
+    Scheduler,
+)
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    HTTPResponse,
+    Server,
+)
+
+__all__ = [
+    "Args",
+    "BindingArgs",
+    "BindingResult",
+    "FilterResult",
+    "HostPriority",
+    "Scheduler",
+    "Server",
+    "HTTPRequest",
+    "HTTPResponse",
+]

@@ -1,0 +1,276 @@
+"""Scheduler-extender protocol wire types.
+
+JSON field names EMITTED are the Go-default (capitalized) names of the
+reference's re-implemented upstream types (reference extender/types.go:
+22-82): ``FilterResult`` carries ``Nodes`` / ``NodeNames`` /
+``FailedNodes`` / ``Error``; priorities are ``[{"Host": .., "Score": ..}]``.
+
+Field names ACCEPTED are case-insensitive, because that is how the
+reference actually interoperates: the real kube-scheduler marshals the
+*upstream* extender types, whose json tags are lowercase (``pod`` /
+``nodes`` / ``nodenames``; bindings ``podName`` / ``podNamespace`` /
+``podUID`` / ``node`` — k8s.io/kube-scheduler/extender/v1), and the
+reference's untagged Go structs decode them only via encoding/json's
+case-insensitive field matching.  Go resolves every JSON key to its field
+case-insensitively in document order, later assignments overwriting
+earlier ones — reproduced here (tests/test_golden_wire.py pins both key
+spellings).
+
+Envelope note on duplicate keys: field RESOLUTION (case-insensitivity,
+document order, per-type null rules) is Go-exact, but when the same
+object-valued field appears twice, the later OBJECT replaces the earlier
+one wholesale (json.loads semantics, matched by the native scanner),
+whereas Go would merge it per-field into the existing struct.  Go
+marshalers cannot emit duplicate keys, so no real wire producer
+exercises the difference; what matters — and is pinned by tests — is
+that both of this framework's decode paths agree with each other on
+such bodies.
+
+Node objects are passed through as raw dicts so responses round-trip the
+scheduler's own node JSON exactly.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Protocol
+
+from platform_aware_scheduling_tpu.kube.objects import Node, Pod
+
+
+class DecodeError(ValueError):
+    """Raised when a request body cannot be decoded into the expected type."""
+
+
+def _loads_with_top_pairs(body: bytes):
+    """json.loads plus the TOP-LEVEL object's (key, value) pairs in raw
+    document order.  Needed for Go parity: a body carrying both an exact
+    duplicate and a case-variant of one field (``{"Pod":A,"pod":B,
+    "Pod":C}``) resolves to the LAST occurrence in document order in Go
+    (and in the native scanner), but json.loads collapses the exact
+    duplicates at their first position, which would re-order the fold.
+
+    The hook fires for every object bottom-up, the outermost last — only
+    that final call is kept (O(1) extra memory, not O(total keys))."""
+    top: List[tuple] = []
+
+    def hook(pairs):
+        nonlocal top
+        top = pairs
+        return dict(pairs)
+
+    obj = json.loads(body, object_pairs_hook=hook)
+    return obj, (top if isinstance(obj, dict) else [])
+
+
+def _fold_keys(
+    pairs, fields: Dict[str, str], nullable: frozenset = frozenset()
+) -> Dict[str, Any]:
+    """Go-unmarshal field resolution over raw-document-order (key, value)
+    pairs: each JSON key matches a struct field case-insensitively, later
+    assignments overwrite earlier ones.  ``fields`` maps lowercase wire
+    name -> canonical name; unmatched keys are dropped (as Go ignores
+    them).
+
+    JSON ``null`` follows Go's per-type rule: decoding null into a
+    pointer/slice/map field assigns nil (fields listed in ``nullable`` —
+    ``Nodes`` / ``NodeNames`` are pointers in both the reference and
+    upstream structs), while null into a value field (strings,
+    struct-valued ``Pod``) "has no effect" — the earlier value, if any,
+    survives."""
+    out: Dict[str, Any] = {}
+    for key, value in pairs:
+        canonical = fields.get(key.lower())
+        if canonical is None:
+            continue
+        if value is None and canonical not in nullable:
+            continue  # Go: null into a value field has no effect
+        out[canonical] = value
+    return out
+
+
+@dataclass
+class Args:
+    """Arguments for Filter/Prioritize (reference extender/types.go:41-50)."""
+
+    pod: Pod
+    # populated when the extender is registered nodeCacheCapable: false
+    nodes: Optional[List[Node]]
+    # populated when the extender is registered nodeCacheCapable: true
+    node_names: Optional[List[str]]
+
+    @classmethod
+    def from_json(cls, body: bytes) -> "Args":
+        try:
+            obj, top_pairs = _loads_with_top_pairs(body)
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            raise DecodeError(f"error decoding request: {exc}") from exc
+        if not isinstance(obj, dict):
+            raise DecodeError("error decoding request: not an object")
+        # accept both the reference's capitalized keys and the upstream
+        # kube-scheduler's lowercase tags ("pod"/"nodes"/"nodenames"),
+        # exactly as Go's case-insensitive unmarshal does (module doc)
+        folded = _fold_keys(
+            top_pairs,
+            {"pod": "Pod", "nodes": "Nodes", "nodenames": "NodeNames"},
+            nullable=frozenset({"Nodes", "NodeNames"}),
+        )
+        pod = Pod(folded.get("Pod") or {})
+        nodes_obj = folded.get("Nodes")
+        nodes = None
+        if nodes_obj is not None:
+            items = nodes_obj.get("items")
+            nodes = [Node(item) for item in (items or [])]
+        node_names = folded.get("NodeNames")
+        return cls(pod=pod, nodes=nodes, node_names=node_names)
+
+    def to_json(self) -> bytes:
+        nodes = None
+        if self.nodes is not None:
+            nodes = {"metadata": {}, "items": [n.raw for n in self.nodes]}
+        return json.dumps(
+            {"Pod": self.pod.raw, "Nodes": nodes, "NodeNames": self.node_names}
+        ).encode()
+
+
+@dataclass
+class HostPriority:
+    """Priority of one host; higher is better (reference extender/types.go:26)."""
+
+    host: str
+    score: int
+
+    def to_obj(self) -> Dict[str, Any]:
+        return {"Host": self.host, "Score": self.score}
+
+
+def encode_host_priority_list(items: List[HostPriority]) -> bytes:
+    return (json.dumps([hp.to_obj() for hp in items]) + "\n").encode()
+
+
+def decode_host_priority_list(body: bytes) -> List[HostPriority]:
+    obj = json.loads(body)
+    if obj is None:
+        return []
+    return [HostPriority(host=e["Host"], score=e["Score"]) for e in obj]
+
+
+@dataclass
+class FilterResult:
+    """Filter verb response (reference extender/types.go:53-64)."""
+
+    nodes: Optional[List[Node]] = None
+    node_names: Optional[List[str]] = None
+    failed_nodes: Dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    def to_obj(self) -> Dict[str, Any]:
+        nodes = None
+        if self.nodes is not None:
+            items = [n.raw for n in self.nodes] if self.nodes else None
+            nodes = {"metadata": {}, "items": items}
+        return {
+            "Nodes": nodes,
+            "NodeNames": self.node_names,
+            "FailedNodes": self.failed_nodes if self.failed_nodes is not None else None,
+            "Error": self.error,
+        }
+
+    def to_json(self) -> bytes:
+        return (json.dumps(self.to_obj()) + "\n").encode()
+
+    @classmethod
+    def from_json(cls, body: bytes) -> "FilterResult":
+        obj = json.loads(body)
+        nodes = None
+        nodes_obj = obj.get("Nodes")
+        if nodes_obj is not None:
+            nodes = [Node(item) for item in (nodes_obj.get("items") or [])]
+        return cls(
+            nodes=nodes,
+            node_names=obj.get("NodeNames"),
+            failed_nodes=obj.get("FailedNodes") or {},
+            error=obj.get("Error") or "",
+        )
+
+
+@dataclass
+class BindingArgs:
+    """Bind verb arguments (reference extender/types.go:67-76)."""
+
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    node: str
+
+    @classmethod
+    def from_json(cls, body: bytes) -> "BindingArgs":
+        try:
+            obj, top_pairs = _loads_with_top_pairs(body)
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            raise DecodeError(f"error decoding request: {exc}") from exc
+        if not isinstance(obj, dict):
+            raise DecodeError("error decoding request: not an object")
+        # upstream ExtenderBindingArgs tags are podName/podNamespace/
+        # podUID/node; the reference's untagged struct accepts either
+        # spelling via Go case-insensitive matching — so do we
+        folded = _fold_keys(
+            top_pairs,
+            {
+                "podname": "PodName",
+                "podnamespace": "PodNamespace",
+                "poduid": "PodUID",
+                "node": "Node",
+            },
+        )
+        return cls(
+            pod_name=folded.get("PodName", ""),
+            pod_namespace=folded.get("PodNamespace", ""),
+            pod_uid=folded.get("PodUID", ""),
+            node=folded.get("Node", ""),
+        )
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "PodName": self.pod_name,
+                "PodNamespace": self.pod_namespace,
+                "PodUID": self.pod_uid,
+                "Node": self.node,
+            }
+        ).encode()
+
+
+@dataclass
+class BindingResult:
+    """Bind verb response (reference extender/types.go:79-82)."""
+
+    error: str = ""
+
+    def to_json(self) -> bytes:
+        return (json.dumps({"Error": self.error}) + "\n").encode()
+
+    @classmethod
+    def from_json(cls, body: bytes) -> "BindingResult":
+        obj = json.loads(body)
+        return cls(error=obj.get("Error") or "")
+
+
+class Scheduler(Protocol):
+    """The three scheduler verbs an extender implements
+    (reference extender/types.go:11-15).  Handlers receive the parsed HTTP
+    request and return the response to send."""
+
+    def filter(self, request: "HTTPRequest") -> "HTTPResponse": ...
+
+    def prioritize(self, request: "HTTPRequest") -> "HTTPResponse": ...
+
+    def bind(self, request: "HTTPRequest") -> "HTTPResponse": ...
+
+
+# imported late to avoid a cycle; re-exported for typing convenience
+from platform_aware_scheduling_tpu.extender.server import (  # noqa: E402
+    HTTPRequest,
+    HTTPResponse,
+)

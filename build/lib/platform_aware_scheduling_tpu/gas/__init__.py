@@ -1,0 +1,7 @@
+"""GPU-aware scheduling (GAS): per-card resource bookkeeping and first-fit
+bin-packing so fractional-GPU pods land on nodes where each individual card
+can satisfy them (reference gpu-aware-scheduling/README.md:14-19).
+
+Host layer mirrors the reference's semantics exactly; the batched filter
+path runs ops/binpack.py — one vmapped XLA pass over every candidate node
+instead of the reference's per-node loop under a global lock."""

@@ -1,0 +1,378 @@
+"""GAS scheduling logic: Filter (per-card fit check) and Bind (card
+assignment + annotation + bind).
+
+Reference: gpu-aware-scheduling/pkg/gpuscheduler/scheduler.go.  Behaviors
+reproduced:
+
+  * Filter requires ``NodeNames`` (nodeCacheCapable mode, :455-461) and
+    answers 404 + an Error result otherwise;
+  * card selection is first-fit over sorted card names with per-GPU
+    resource division via the ``i915`` count (:200-257, 180-198) — a card
+    with room for several per-GPU shares can be picked more than once for
+    the same container, exactly like the reference;
+  * vanished GPUs (usage recorded for a card no longer in the node label)
+    are tolerated and skipped (:230-234);
+  * Bind re-runs scheduling on the chosen node, books resources, annotates
+    the pod (``gas-ts`` + ``gas-container-cards``) with a 5-attempt
+    conflict-retry, calls the Bind subresource, and rolls the booking back
+    on any later failure (:385-445, 82-119);
+  * Prioritize is 404 (:515-519).
+
+The TPU path: Filter fans the per-node fit check out as ONE vmapped XLA
+pass over all candidate nodes (ops/binpack.py) instead of the reference's
+sequential per-node loop — the host loop remains as exact fallback/control.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    HTTPResponse,
+)
+from platform_aware_scheduling_tpu.extender.types import (
+    Args,
+    BindingArgs,
+    BindingResult,
+    FilterResult,
+)
+from platform_aware_scheduling_tpu.gas.cache import ADD, REMOVE, Cache
+from platform_aware_scheduling_tpu.gas.resource_map import (
+    NodeResources,
+    ResourceMap,
+)
+from platform_aware_scheduling_tpu.gas.utils import (
+    CARD_ANNOTATION,
+    GPU_LIST_LABEL,
+    GPU_PLUGIN_RESOURCE,
+    RESOURCE_PREFIX,
+    TS_ANNOTATION,
+    container_requests,
+)
+from platform_aware_scheduling_tpu.kube.client import ConflictError
+from platform_aware_scheduling_tpu.kube.objects import Node, Pod
+from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
+
+UPDATE_RETRY_COUNT = 5  # scheduler.go:28
+
+
+class WontFitError(Exception):
+    """will not fit (scheduler.go:49)"""
+
+
+class GASExtender:
+    """extender.Scheduler implementation for GAS (scheduler.go:58-71)."""
+
+    def __init__(
+        self,
+        kube_client,
+        cache: Optional[Cache] = None,
+        recorder: Optional[LatencyRecorder] = None,
+        use_device: bool = True,
+        use_mirror: bool = True,
+    ):
+        self.kube_client = kube_client
+        self.cache = cache if cache is not None else Cache(kube_client)
+        self.recorder = recorder or LatencyRecorder()
+        self._rwmutex = threading.RLock()
+        self._device = None
+        if use_device:
+            # deferred import: keeps the host layer importable without jax
+            from platform_aware_scheduling_tpu.gas.device import DeviceBinpacker
+
+            self._device = DeviceBinpacker(self.cache, use_mirror=use_mirror)
+
+    # -- verbs -----------------------------------------------------------------
+
+    def prioritize(self, request: HTTPRequest) -> HTTPResponse:
+        # not implemented by GAS (scheduler.go:515-519)
+        return HTTPResponse(status=404)
+
+    def filter(self, request: HTTPRequest) -> HTTPResponse:
+        start = time.perf_counter()
+        try:
+            klog.v(4).info_s("filter request received", component="extender")
+            try:
+                args = Args.from_json(request.body) if request.body else None
+            except Exception as exc:
+                args = None
+                klog.error("cannot decode request %s", exc)
+            if args is None:
+                return HTTPResponse(status=404)
+            result = self._filter_nodes(args)
+            status = 404 if result.error else 200
+            return HTTPResponse.json(result.to_json(), status=status)
+        finally:
+            self.recorder.observe("gas_filter", time.perf_counter() - start)
+
+    def bind(self, request: HTTPRequest) -> HTTPResponse:
+        start = time.perf_counter()
+        try:
+            klog.v(4).info_s("bind request received", component="extender")
+            try:
+                args = BindingArgs.from_json(request.body) if request.body else None
+            except Exception as exc:
+                args = None
+                klog.error("cannot decode request %s", exc)
+            if args is None:
+                return HTTPResponse(status=404)
+            result = self._bind_node(args)
+            status = 404 if result.error else 200
+            return HTTPResponse.json(result.to_json(), status=status)
+        finally:
+            self.recorder.observe("gas_bind", time.perf_counter() - start)
+
+    # -- filter (scheduler.go:447-482) -----------------------------------------
+
+    def _filter_nodes(self, args: Args) -> FilterResult:
+        if not args.node_names:
+            error = (
+                "No nodes to compare. This should not happen, perhaps the "
+                "extender is misconfigured with NodeCacheCapable == false."
+            )
+            klog.error(error)
+            return FilterResult(error=error)
+        with self._rwmutex:
+            if self._device is not None:
+                try:
+                    fits = self._device.batch_fit(args.pod, args.node_names)
+                except Exception as exc:
+                    klog.error("device binpack failed, host fallback: %s", exc)
+                    fits = None
+                if fits is not None:
+                    node_names = [n for n, ok in zip(args.node_names, fits) if ok]
+                    failed = {
+                        n: "Not enough GPU-resources for deployment"
+                        for n, ok in zip(args.node_names, fits)
+                        if not ok
+                    }
+                    return FilterResult(
+                        node_names=node_names, failed_nodes=failed, error=""
+                    )
+            node_names: List[str] = []
+            failed: Dict[str, str] = {}
+            for node_name in args.node_names:
+                try:
+                    self._run_scheduling_logic(args.pod, node_name)
+                    node_names.append(node_name)
+                except Exception:
+                    failed[node_name] = "Not enough GPU-resources for deployment"
+            return FilterResult(node_names=node_names, failed_nodes=failed, error="")
+
+    # -- scheduling core (scheduler.go:277-338) ---------------------------------
+
+    def _run_scheduling_logic(self, pod: Pod, node_name: str) -> str:
+        """Pick cards for every container of ``pod`` on ``node_name``;
+        returns the annotation string, raises if the pod won't fit.  Does
+        not mutate booked state."""
+        node = self.cache.fetch_node(node_name)
+        gpus = get_node_gpu_list(node)
+        if not gpus:
+            klog.warning("Node %s GPUs have vanished", node_name)
+            raise WontFitError("will not fit")
+        per_gpu_capacity = get_per_gpu_resource_capacity(node, len(gpus))
+        used = self.cache.get_node_resource_status(node_name)
+        gpu_set = set(gpus)
+        for gpu in gpus:  # empty maps for unused cards (:269-275)
+            used.setdefault(gpu, ResourceMap())
+        annotation_parts: List[str] = []
+        for i, request in enumerate(container_requests(pod)):
+            cards = self._cards_for_container_request(
+                request, per_gpu_capacity, node_name, pod.name, used, gpu_set
+            )
+            annotation_parts.append(",".join(cards))
+        return "|".join(annotation_parts)
+
+    def _cards_for_container_request(
+        self,
+        container_request: ResourceMap,
+        per_gpu_capacity: ResourceMap,
+        node_name: str,
+        pod_name: str,
+        used: NodeResources,
+        gpu_set,
+    ) -> List[str]:
+        """First-fit card pick per requested GPU (scheduler.go:200-257);
+        mutates ``used`` (the caller's scratch copy) as it books."""
+        if not container_request:
+            return []
+        per_gpu_request, num_i915 = get_per_gpu_resource_request(container_request)
+        cards: List[str] = []
+        for _ in range(num_i915):
+            fitted = False
+            for gpu_name in sorted(used):
+                if gpu_name not in gpu_set:
+                    klog.warning(
+                        "node %s gpu %s has vanished", node_name, gpu_name
+                    )
+                    continue
+                if check_resource_capacity(
+                    per_gpu_request, per_gpu_capacity, used[gpu_name]
+                ):
+                    try:
+                        used[gpu_name].add_rm(per_gpu_request)
+                    except Exception:
+                        break
+                    fitted = True
+                    cards.append(gpu_name)
+                    break
+            if not fitted:
+                klog.v(4).info_s(
+                    f"pod {pod_name} will not fit node {node_name}",
+                    component="extender",
+                )
+                raise WontFitError("will not fit")
+        return cards
+
+    # -- bind (scheduler.go:385-445) --------------------------------------------
+
+    def _bind_node(self, args: BindingArgs) -> BindingResult:
+        try:
+            pod = self.cache.fetch_pod(args.pod_namespace, args.pod_name)
+        except Exception as exc:
+            klog.warning("Pod %s couldn't be read or pod vanished", args.pod_name)
+            return BindingResult(error=str(exc))
+        with self._rwmutex:
+            resources_adjusted = False
+            annotation = ""
+            try:
+                annotation = self._run_scheduling_logic(pod, args.node)
+                self.cache.adjust_pod_resources_locked(
+                    pod, ADD, annotation, args.node
+                )
+                resources_adjusted = True
+                self._annotate_pod_bind(annotation, pod)
+                self.kube_client.bind_pod(
+                    args.pod_namespace, args.pod_name, args.pod_uid, args.node
+                )
+                return BindingResult()
+            except Exception as exc:
+                klog.error("binding failed: %s", exc)
+                if resources_adjusted:
+                    # roll the booking back (scheduler.go:404-414)
+                    try:
+                        self.cache.adjust_pod_resources_locked(
+                            pod, REMOVE, annotation, args.node
+                        )
+                    except Exception as rollback_exc:
+                        klog.error("rollback failed: %s", rollback_exc)
+                return BindingResult(error=str(exc))
+
+    def _annotate_pod_bind(self, annotation: str, pod: Pod) -> None:
+        """Write gas-ts + gas-container-cards with a conflict-retry loop
+        (scheduler.go:82-119)."""
+        pod_copy = pod.deep_copy()
+        ts = str(time.time_ns())
+        last_exc: Optional[Exception] = None
+        for _attempt in range(UPDATE_RETRY_COUNT):
+            pod_copy.annotations[TS_ANNOTATION] = ts
+            pod_copy.annotations[CARD_ANNOTATION] = annotation
+            try:
+                self.kube_client.update_pod(pod_copy)
+                klog.v(2).info_s(
+                    f"Annotated pod {pod.name} with annotation {annotation}",
+                    component="extender",
+                )
+                return
+            except ConflictError as exc:
+                last_exc = exc
+                try:
+                    pod_copy = self.kube_client.get_pod(
+                        pod_copy.namespace, pod_copy.name
+                    )
+                except Exception:
+                    klog.error("pod refresh failed")
+                    break
+                klog.error("pod update failed, retrying with refreshed pod")
+            except Exception as exc:
+                last_exc = exc
+                break
+        klog.error(
+            "Failed to annotate POD with container cards: %s", last_exc
+        )
+        raise last_exc if last_exc else RuntimeError("annotate failed")
+
+
+# -- pure helpers (module-level like the reference) ----------------------------
+
+
+def get_node_gpu_list(node: Node) -> List[str]:
+    """Cards from the ``gpu.intel.com/cards`` label, "card0.card1..."
+    (scheduler.go:132-148)."""
+    labels = node.get_labels() if node is not None else None
+    if not labels or GPU_LIST_LABEL not in labels:
+        klog.error("gpulist label not found from node")
+        return []
+    return labels[GPU_LIST_LABEL].split(".")
+
+
+def get_node_gpu_resource_capacity(node: Node) -> ResourceMap:
+    """Allocatable entries under the gpu.intel.com/ prefix
+    (scheduler.go:150-162)."""
+    capacity = ResourceMap()
+    for name, raw in node.allocatable.items():
+        if name.startswith(RESOURCE_PREFIX):
+            value, _ok = Quantity(str(raw)).as_int64()
+            capacity[name] = value
+    return capacity
+
+
+def get_per_gpu_resource_capacity(node: Node, gpu_count: int) -> ResourceMap:
+    """Node capacity divided evenly across cards — homogeneous-GPU
+    assumption (scheduler.go:164-178)."""
+    if gpu_count == 0:
+        return ResourceMap()
+    per_gpu = get_node_gpu_resource_capacity(node).new_copy()
+    per_gpu.divide(gpu_count)
+    return per_gpu
+
+
+def get_num_i915(container_request: ResourceMap) -> int:
+    """(scheduler.go:192-198)"""
+    value = container_request.get(GPU_PLUGIN_RESOURCE, 0)
+    return value if value > 0 else 0
+
+
+def get_per_gpu_resource_request(
+    container_request: ResourceMap,
+) -> Tuple[ResourceMap, int]:
+    """Divide the container request evenly across its i915 count
+    (scheduler.go:180-190)."""
+    per_gpu = container_request.new_copy()
+    num_i915 = get_num_i915(container_request)
+    if num_i915 > 1:
+        per_gpu.divide(num_i915)
+    return per_gpu, num_i915
+
+
+def check_resource_capacity(
+    needed: ResourceMap, capacity: ResourceMap, used: ResourceMap
+) -> bool:
+    """True when every needed resource fits under per-card capacity
+    (scheduler.go:341-383): negative need/used fail, missing or non-positive
+    capacity fails, int64 overflow of used+need fails."""
+    int64_max = 2**63 - 1
+    for name, need in needed.items():
+        if need < 0:
+            klog.error("negative resource request")
+            return False
+        cap = capacity.get(name)
+        if cap is None or cap <= 0:
+            klog.v(4).info_s(f" no capacity available for {name}")
+            return False
+        in_use = used.get(name, 0)
+        if in_use < 0:
+            klog.error("negative amount of resources in use")
+            return False
+        if in_use + need > int64_max:  # Go wraparound check (used+need < 0)
+            klog.error("resource request overflow error")
+            return False
+        if cap < in_use + need:
+            klog.v(4).info_s(" not enough resources")
+            return False
+    return True

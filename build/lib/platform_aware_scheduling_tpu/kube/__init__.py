@@ -1,0 +1,12 @@
+"""Minimal Kubernetes client layer: dict-backed objects, REST client,
+informers, workqueues, and an in-memory fake API server for tests.
+
+This is the client-go equivalent of the framework.  Objects stay as their
+wire-format JSON dicts (wrapped for ergonomic access) so requests can be
+re-serialized bit-for-bit; the tensorized scheduling core never sees these —
+it sees the dense mirrors built in ``models/state.py``.
+"""
+
+from platform_aware_scheduling_tpu.kube.objects import Node, Pod, object_key
+
+__all__ = ["Node", "Pod", "object_key"]

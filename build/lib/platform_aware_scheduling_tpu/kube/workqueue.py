@@ -1,0 +1,84 @@
+"""Rate-limited work queue with client-go semantics.
+
+GAS drains pod events through a ``workqueue.RateLimitingInterface`` with a
+single worker (reference gpu-aware-scheduling/pkg/gpuscheduler/
+node_resource_cache.go:403-449).  This reproduces the semantics that matter:
+items are deduplicated while pending, an item re-added while being processed
+is re-queued when ``done`` is called, ``forget`` resets its failure count,
+and re-adds after failures back off exponentially.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Hashable, Optional, Tuple
+
+
+class WorkQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1.0):
+        self._lock = threading.Condition()
+        self._queue: deque = deque()
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._failures: dict = {}
+        self._shutdown = False
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+
+    def add(self, item: Hashable) -> None:
+        with self._lock:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._lock.notify()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        """Re-add after a failure, with exponential backoff."""
+        failures = self._failures.get(item, 0)
+        self._failures[item] = failures + 1
+        delay = min(self._base_delay * (2**failures), self._max_delay)
+        timer = threading.Timer(delay, self.add, args=(item,))
+        timer.daemon = True
+        timer.start()
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        """Returns ``(item, shutdown)``; blocks until an item is available or
+        the queue shuts down (then ``(None, True)``)."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with self._lock:
+            while not self._queue and not self._shutdown:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None, False
+                self._lock.wait(remaining)
+            if not self._queue:
+                return None, True
+            item = self._queue.popleft()
+            self._dirty.discard(item)
+            self._processing.add(item)
+            return item, False
+
+    def done(self, item: Hashable) -> None:
+        with self._lock:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._lock.notify()
+
+    def forget(self, item: Hashable) -> None:
+        self._failures.pop(item, None)
+
+    def shut_down(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)

@@ -1,0 +1,5 @@
+"""Scheduling models: compositions of the ops/ kernels into full solves.
+
+``batch_scheduler`` is the flagship — the framework's "training step":
+one XLA program taking cluster state + the entire pending-pod set and
+producing a capacity-feasible assignment (filter -> score -> assign)."""

@@ -1,0 +1,137 @@
+"""Native (C) components: build-on-demand loader.
+
+The reference has no native code (SURVEY: 100% Go, zero C++/CUDA), but this
+framework's runtime keeps its wire tails native: ``_wirec`` removes the
+per-request JSON-object churn at 10k-node scale (see wirec.c).  The module
+is compiled on first use with the toolchain baked into the image (g++/cc);
+everything degrades gracefully to the pure-Python paths when no compiler
+is available (``get_wirec() -> None``).
+
+No binary is ever shipped or loaded blind: the build artifact is named by
+the SHA-256 of the source, so the loader only loads a ``.so`` that was
+compiled from the exact reviewed ``wirec.c`` on this machine (the round-2
+advisor flagged the prior mtime check, which could load a foreign-ABI
+binary after a fresh clone).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "wirec.c")
+
+_lock = threading.Lock()
+_loaded = False
+_module = None
+
+
+def _so_path() -> str:
+    """Build artifact path keyed by source content hash AND the
+    interpreter ABI — a checkout shared between Python versions must not
+    load an extension compiled against another interpreter's headers."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    soabi = sysconfig.get_config_var("SOABI") or "unknown-abi"
+    return os.path.join(_DIR, f"_wirec-{digest}-{soabi}.so")
+
+
+def _build(so_path: str) -> bool:
+    cc = os.environ.get("CC", "cc")
+    include = sysconfig.get_paths()["include"]
+    # per-process tmp name: concurrent cold-starting processes must not
+    # interleave compiler output into the same file (the winner's
+    # os.replace is atomic; losers just replace it with identical bytes)
+    tmp = f"{so_path}.{os.getpid()}.tmp"
+    cmd = [
+        cc,
+        "-O2",
+        "-fPIC",
+        "-shared",
+        f"-I{include}",
+        _SRC,
+        "-o",
+        tmp,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        import sys
+
+        print(f"_wirec build failed:\n{proc.stderr}", file=sys.stderr)
+        return False
+    try:
+        os.replace(tmp, so_path)
+    except OSError:
+        # a concurrent builder's cleanup may have removed our tmp; we
+        # only lost the race — the winner's artifact serves everyone
+        return os.path.exists(so_path)
+    # best-effort cleanup: artifacts from older source revisions, and tmp
+    # files orphaned by crashed builds (older than the 120 s build
+    # timeout — never a concurrent builder's in-progress tmp)
+    import time
+
+    now = time.time()
+    try:
+        for entry in os.listdir(_DIR):
+            path = os.path.join(_DIR, entry)
+            if not entry.startswith("_wirec"):
+                continue
+            stale_so = entry.endswith(".so") and path != so_path
+            orphan_tmp = False
+            if entry.endswith(".tmp"):
+                try:
+                    orphan_tmp = now - os.path.getmtime(path) > 120
+                except OSError:
+                    continue
+            if stale_so or orphan_tmp:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return True
+
+
+def get_wirec(allow_build: bool = True):
+    """The ``_wirec`` extension module, or None when unavailable.
+
+    Set ``PAS_TPU_NO_NATIVE=1`` to force the pure-Python paths (used by the
+    test matrix to keep both variants covered)."""
+    global _loaded, _module
+    if os.environ.get("PAS_TPU_NO_NATIVE") == "1":
+        return None
+    if _loaded:
+        return _module
+    with _lock:
+        if _loaded:
+            return _module
+        try:
+            so = _so_path()
+        except OSError:
+            _loaded = True
+            _module = None
+            return None
+        if not os.path.exists(so) and (not allow_build or not _build(so)):
+            _loaded = True
+            _module = None
+            return None
+        try:
+            spec = importlib.util.spec_from_file_location("_wirec", so)
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+        except Exception:
+            module = None
+        _loaded = True
+        _module = module
+        return _module

@@ -1,0 +1,1532 @@
+/* _wirec: native fast path for the scheduler-extender wire protocol.
+ *
+ * The per-request hot cost at 10k nodes is NOT the scheduling math (that
+ * is precomputed per state version, tas/fastpath.py) but the wire tails:
+ * json-decoding an Args body into ~10k Python dicts/objects and re-encoding
+ * ~10k HostPriority entries.  This module removes both:
+ *
+ *   parse_prioritize(body)        -> ParsedArgs (pod meta + node-name
+ *                                    slices captured zero-copy; no per-node
+ *                                    Python objects)
+ *   build_table(node_names)       -> NameTable (FNV-1a open-addressing
+ *                                    name->row map + pre-rendered per-row
+ *                                    JSON fragments), built once per state
+ *                                    version
+ *   select_encode(parsed, table, ranked, planned_row)
+ *                                 -> response bytes: global rank order
+ *                                    restricted to the request's candidate
+ *                                    set, ordinal 10-i scores, optional
+ *                                    batch-plan promotion to rank 1
+ *
+ * The JSON scanner is strict: any structural surprise raises ValueError and
+ * the caller falls back to the exact Python path (which reproduces every
+ * reference quirk).  Semantics mirror tas/fastpath.py byte-for-byte; the
+ * equivalence is pinned by tests/test_wirec.py.
+ *
+ * Reference for the wire shape: extender/types.go:26-64 (Args,
+ * HostPriorityList); scoring semantics telemetryscheduler.go:128-149.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+/* ------------------------------------------------------------------ */
+/* growable byte buffer                                                */
+
+typedef struct {
+    char *data;
+    size_t len;
+    size_t cap;
+} Buf;
+
+static int buf_init(Buf *b, size_t cap) {
+    b->data = malloc(cap ? cap : 64);
+    if (!b->data) return -1;
+    b->len = 0;
+    b->cap = cap ? cap : 64;
+    return 0;
+}
+
+static void buf_free(Buf *b) {
+    free(b->data);
+    b->data = NULL;
+}
+
+static int buf_reserve(Buf *b, size_t extra) {
+    if (b->len + extra <= b->cap) return 0;
+    size_t ncap = b->cap * 2;
+    while (ncap < b->len + extra) ncap *= 2;
+    char *nd = realloc(b->data, ncap);
+    if (!nd) return -1;
+    b->data = nd;
+    b->cap = ncap;
+    return 0;
+}
+
+static int buf_put(Buf *b, const char *src, size_t n) {
+    if (buf_reserve(b, n) < 0) return -1;
+    memcpy(b->data + b->len, src, n);
+    b->len += n;
+    return 0;
+}
+
+/* Process-wide pool of reusable scratch buffers for the per-request
+ * encode paths.
+ *
+ * A 10k-node response is ~400 KB; glibc malloc serves that size from
+ * mmap, so a fresh allocation per request means fresh pages — the
+ * page-fault + munmap churn lands straight in p99 on the cache-miss
+ * tier.  The pool keeps a handful of high-water buffers alive across
+ * requests AND across connections (the server is thread-per-connection,
+ * so thread-local scratch would leak per connection and never stay
+ * warm).  pool_get always returns an owned Buf (possibly freshly
+ * allocated; data==NULL only on OOM); pool_put returns it to a free
+ * slot or frees it when the pool is full — bounded memory, no leak. */
+#include <pthread.h>
+#define POOL_SLOTS 8
+static pthread_mutex_t pool_lock = PTHREAD_MUTEX_INITIALIZER;
+static Buf buf_pool[POOL_SLOTS];
+
+static Buf pool_get(size_t want) {
+    Buf b = {NULL, 0, 0};
+    pthread_mutex_lock(&pool_lock);
+    for (int i = 0; i < POOL_SLOTS; i++) {
+        if (buf_pool[i].data) {
+            b = buf_pool[i];
+            buf_pool[i].data = NULL;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&pool_lock);
+    if (b.data) {
+        b.len = 0;
+        if (want && buf_reserve(&b, want) < 0) {
+            buf_free(&b);
+            b.data = NULL;
+        }
+    } else if (buf_init(&b, want ? want : 4096) < 0) {
+        b.data = NULL;
+    }
+    return b;
+}
+
+static void pool_put(Buf *b) {
+    if (!b->data) return;
+    pthread_mutex_lock(&pool_lock);
+    for (int i = 0; i < POOL_SLOTS; i++) {
+        if (!buf_pool[i].data) {
+            buf_pool[i] = *b;
+            b->data = NULL;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&pool_lock);
+    if (b->data) buf_free(b);
+}
+
+/* ------------------------------------------------------------------ */
+/* JSON scanner over a byte body                                       */
+
+typedef struct {
+    const char *s;
+    Py_ssize_t n;
+    Py_ssize_t i;
+    const char *err;  /* static message; raised as ValueError by the caller
+                         (lets the scan run without the GIL) */
+} Scan;
+
+typedef struct {
+    Py_ssize_t off;   /* offset of first char INSIDE the quotes */
+    Py_ssize_t len;   /* raw length inside the quotes */
+    int escaped;      /* contains backslash escapes (slow-path materialize) */
+    int present;
+} StrSlice;
+
+static void skip_ws(Scan *sc) {
+    while (sc->i < sc->n) {
+        char c = sc->s[sc->i];
+        if (c == ' ' || c == '\t' || c == '\n' || c == '\r') sc->i++;
+        else break;
+    }
+}
+
+/* record the first error on the scan state; raised as ValueError by the
+ * entry point after the GIL is re-acquired */
+static int fail_raw(Scan *sc, const char *msg) {
+    if (!sc->err) sc->err = msg;
+    return -1;
+}
+
+#define fail(msg) fail_raw(sc, msg)
+
+/* any byte outside plain-ASCII string content: < 0x20 (control), '\\'
+ * (escape), or >= 0x80 (multibyte UTF-8) — found via an 8-byte SWAR
+ * sweep.  '"' cannot appear in the probed span (it is memchr's stop). */
+static int span_has_special(const char *s, Py_ssize_t n) {
+    const uint64_t ones = 0x0101010101010101ULL;
+    const uint64_t highs = 0x8080808080808080ULL;
+    Py_ssize_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t w;
+        memcpy(&w, s + i, 8);
+        uint64_t lt20 = (w - ones * 0x20) & ~w & highs;
+        uint64_t ge80 = w & highs;
+        uint64_t xbs = w ^ (ones * (unsigned char)'\\');
+        uint64_t isbs = (xbs - ones) & ~xbs & highs;
+        if (lt20 | ge80 | isbs) return 1;
+    }
+    for (; i < n; i++) {
+        unsigned char c = (unsigned char)s[i];
+        if (c < 0x20 || c >= 0x80 || c == '\\') return 1;
+    }
+    return 0;
+}
+
+/* scan a JSON string starting at the opening quote; record the slice.
+ *
+ * Escape sequences and UTF-8 well-formedness are validated HERE, exactly
+ * as strictly as json.loads over bytes (which UTF-8-decodes first): a body
+ * that json.loads would reject must fail the native parse too, so the
+ * exact Python path owns the response for it — never a silent divergence
+ * or a deferred exception at slice-materialization time.
+ *
+ * Fast path: memchr to the next '"', one SWAR sweep over the span; when
+ * the span is plain ASCII (the overwhelmingly common case for node
+ * names/keys) the per-byte validating loop is skipped entirely.  Any
+ * special byte — including an escaped quote, whose preceding backslash
+ * trips the sweep — falls back to the exact loop from the start. */
+static int scan_string(Scan *sc, StrSlice *out) {
+    if (sc->i >= sc->n || sc->s[sc->i] != '"') return fail("expected string");
+    sc->i++;
+    Py_ssize_t start = sc->i;
+    {
+        const char *base = sc->s + start;
+        const char *q = memchr(base, '"', (size_t)(sc->n - start));
+        if (q) {
+            Py_ssize_t len = (Py_ssize_t)(q - base);
+            if (!span_has_special(base, len)) {
+                if (out) {
+                    out->off = start;
+                    out->len = len;
+                    out->escaped = 0;
+                    out->present = 1;
+                }
+                sc->i = start + len + 1;
+                return 0;
+            }
+        }
+    }
+    int escaped = 0;
+    while (sc->i < sc->n) {
+        unsigned char c = (unsigned char)sc->s[sc->i];
+        if (c == '\\') {
+            escaped = 1;
+            if (sc->i + 1 >= sc->n) return fail("bad escape");
+            char e = sc->s[sc->i + 1];
+            if (e == 'u') {
+                if (sc->i + 5 >= sc->n) return fail("bad \\u escape");
+                for (int k = 2; k <= 5; k++) {
+                    char h = sc->s[sc->i + k];
+                    if (!((h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
+                          (h >= 'A' && h <= 'F')))
+                        return fail("bad \\u escape");
+                }
+                sc->i += 6;
+            } else if (e == '"' || e == '\\' || e == '/' || e == 'b' ||
+                       e == 'f' || e == 'n' || e == 'r' || e == 't') {
+                sc->i += 2;
+            } else {
+                return fail("bad escape");
+            }
+            continue;
+        }
+        if (c == '"') {
+            if (out) {
+                out->off = start;
+                out->len = sc->i - start;
+                out->escaped = escaped;
+                out->present = 1;
+            }
+            sc->i++;
+            return 0;
+        }
+        if (c < 0x20) return fail("control char in string");
+        if (c >= 0x80) {
+            /* strict UTF-8: reject bad lead/continuation bytes, overlong
+             * forms, surrogates, and code points past U+10FFFF — the same
+             * set CPython's strict utf-8 decoder rejects */
+            const unsigned char *p = (const unsigned char *)sc->s + sc->i;
+            Py_ssize_t left = sc->n - sc->i;
+            int len;
+            if ((p[0] & 0xE0) == 0xC0) {
+                if (p[0] < 0xC2) return fail("invalid UTF-8");
+                len = 2;
+            } else if ((p[0] & 0xF0) == 0xE0) {
+                len = 3;
+            } else if ((p[0] & 0xF8) == 0xF0) {
+                if (p[0] > 0xF4) return fail("invalid UTF-8");
+                len = 4;
+            } else {
+                return fail("invalid UTF-8");
+            }
+            if (left < len) return fail("invalid UTF-8");
+            for (int k = 1; k < len; k++)
+                if ((p[k] & 0xC0) != 0x80) return fail("invalid UTF-8");
+            if (len == 3) {
+                if (p[0] == 0xE0 && p[1] < 0xA0) return fail("invalid UTF-8");
+                if (p[0] == 0xED && p[1] >= 0xA0) return fail("invalid UTF-8");
+            } else if (len == 4) {
+                if (p[0] == 0xF0 && p[1] < 0x90) return fail("invalid UTF-8");
+                if (p[0] == 0xF4 && p[1] >= 0x90) return fail("invalid UTF-8");
+            }
+            sc->i += len;
+            continue;
+        }
+        sc->i++;
+    }
+    return fail("unterminated string");
+}
+
+static int skip_value(Scan *sc);
+
+/* ASCII-case-insensitive key match against a lowercase literal.  The
+ * real kube-scheduler marshals the upstream extender types (lowercase
+ * tags: "pod"/"nodes"/"nodenames"); the reference's untagged Go structs
+ * accept them through encoding/json's case-insensitive field matching,
+ * so the Args TOP-LEVEL keys must match case-insensitively here too
+ * (inner object keys are Go-marshaled v1 structs — always canonical
+ * lowercase on the wire — and stay exact, like the Python path). */
+static int key_is_ci(const char *s, Py_ssize_t len, const char *lower_lit,
+                     Py_ssize_t lit_len) {
+    if (len != lit_len) return 0;
+    for (Py_ssize_t i = 0; i < len; i++) {
+        char a = s[i];
+        if (a >= 'A' && a <= 'Z') a += 32;
+        if (a != lower_lit[i]) return 0;
+    }
+    return 1;
+}
+
+static int skip_object(Scan *sc) {
+    sc->i++; /* '{' */
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; return 0; }
+    for (;;) {
+        skip_ws(sc);
+        if (scan_string(sc, NULL) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        if (skip_value(sc) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated object");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; return 0; }
+        return fail("bad object");
+    }
+}
+
+static int skip_array(Scan *sc) {
+    sc->i++; /* '[' */
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == ']') { sc->i++; return 0; }
+    for (;;) {
+        if (skip_value(sc) < 0) return -1;
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated array");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == ']') { sc->i++; return 0; }
+        return fail("bad array");
+    }
+}
+
+static int skip_number(Scan *sc) {
+    if (sc->i < sc->n && sc->s[sc->i] == '-') sc->i++;
+    /* strict like json.loads: no leading zeros */
+    if (sc->i >= sc->n) return fail("bad number");
+    if (sc->s[sc->i] == '0') {
+        sc->i++;
+        if (sc->i < sc->n && sc->s[sc->i] >= '0' && sc->s[sc->i] <= '9')
+            return fail("leading zero");
+    } else if (sc->s[sc->i] >= '1' && sc->s[sc->i] <= '9') {
+        while (sc->i < sc->n && sc->s[sc->i] >= '0' && sc->s[sc->i] <= '9')
+            sc->i++;
+    } else {
+        return fail("bad number");
+    }
+    int digits;
+    if (sc->i < sc->n && sc->s[sc->i] == '.') {
+        sc->i++;
+        digits = 0;
+        while (sc->i < sc->n && sc->s[sc->i] >= '0' && sc->s[sc->i] <= '9') {
+            digits = 1; sc->i++;
+        }
+        if (!digits) return fail("bad number");
+    }
+    if (sc->i < sc->n && (sc->s[sc->i] == 'e' || sc->s[sc->i] == 'E')) {
+        sc->i++;
+        if (sc->i < sc->n && (sc->s[sc->i] == '+' || sc->s[sc->i] == '-')) sc->i++;
+        digits = 0;
+        while (sc->i < sc->n && sc->s[sc->i] >= '0' && sc->s[sc->i] <= '9') {
+            digits = 1; sc->i++;
+        }
+        if (!digits) return fail("bad number");
+    }
+    return 0;
+}
+
+static int skip_literal(Scan *sc, const char *lit, Py_ssize_t len) {
+    if (sc->i + len > sc->n || memcmp(sc->s + sc->i, lit, len) != 0)
+        return fail("bad literal");
+    sc->i += len;
+    return 0;
+}
+
+static int skip_value(Scan *sc) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("unexpected end");
+    switch (sc->s[sc->i]) {
+    case '{': return skip_object(sc);
+    case '[': return skip_array(sc);
+    case '"': return scan_string(sc, NULL);
+    case 't': return skip_literal(sc, "true", 4);
+    case 'f': return skip_literal(sc, "false", 5);
+    case 'n': return skip_literal(sc, "null", 4);
+    default:  return skip_number(sc);
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* ParsedArgs object                                                   */
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *body;        /* the bytes object; slices point into it */
+    StrSlice pod_name;
+    StrSlice pod_namespace;
+    StrSlice policy_label; /* labels["telemetry-policy"] */
+    int has_label;
+    int nodes_present;     /* "Nodes" was a non-null object with items */
+    StrSlice *names;       /* node name slices (Nodes.items[].metadata.name) */
+    Py_ssize_t num_names;
+    int node_names_present; /* "NodeNames" was a non-null array */
+    StrSlice *nn_names;     /* NodeNames[] string slices */
+    Py_ssize_t num_nn_names;
+    /* raw byte span [start, end) of the candidate-list JSON values —
+     * identical spans mean identical candidate sets, the key of the
+     * response-reuse cache (tas/fastpath.py); -1 when absent */
+    Py_ssize_t nodes_span_start, nodes_span_end;
+    Py_ssize_t nn_span_start, nn_span_end;
+} ParsedArgs;
+
+static void ParsedArgs_dealloc(ParsedArgs *self) {
+    Py_XDECREF(self->body);
+    free(self->names);  /* raw-allocated: grown while the GIL is released */
+    free(self->nn_names);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *slice_to_unicode(PyObject *body, const StrSlice *sl) {
+    if (!sl->present) Py_RETURN_NONE;
+    const char *base = PyBytes_AS_STRING(body);
+    if (!sl->escaped)
+        return PyUnicode_DecodeUTF8(base + sl->off, sl->len, "strict");
+    /* rare: route through the json module for exact escape handling */
+    PyObject *json_mod = PyImport_ImportModule("json");
+    if (!json_mod) return NULL;
+    PyObject *raw = PyUnicode_DecodeUTF8(base + sl->off - 1, sl->len + 2, "strict");
+    if (!raw) { Py_DECREF(json_mod); return NULL; }
+    PyObject *res = PyObject_CallMethod(json_mod, "loads", "O", raw);
+    Py_DECREF(raw);
+    Py_DECREF(json_mod);
+    return res;
+}
+
+static PyObject *ParsedArgs_get(ParsedArgs *self, void *closure) {
+    const char *which = (const char *)closure;
+    if (strcmp(which, "pod_name") == 0)
+        return slice_to_unicode(self->body, &self->pod_name);
+    if (strcmp(which, "pod_namespace") == 0)
+        return slice_to_unicode(self->body, &self->pod_namespace);
+    if (strcmp(which, "policy_label") == 0) {
+        if (!self->has_label) Py_RETURN_NONE;
+        return slice_to_unicode(self->body, &self->policy_label);
+    }
+    if (strcmp(which, "nodes_present") == 0)
+        return PyBool_FromLong(self->nodes_present);
+    if (strcmp(which, "num_nodes") == 0)
+        return PyLong_FromSsize_t(self->num_names);
+    if (strcmp(which, "node_names_present") == 0)
+        return PyBool_FromLong(self->node_names_present);
+    if (strcmp(which, "num_node_names") == 0)
+        return PyLong_FromSsize_t(self->num_nn_names);
+    Py_RETURN_NONE;
+}
+
+static PyObject *materialize_names(PyObject *body, const StrSlice *slices,
+                                   Py_ssize_t count) {
+    PyObject *list = PyList_New(count);
+    if (!list) return NULL;
+    for (Py_ssize_t k = 0; k < count; k++) {
+        PyObject *u = slice_to_unicode(body, &slices[k]);
+        if (!u) { Py_DECREF(list); return NULL; }
+        PyList_SET_ITEM(list, k, u);
+    }
+    return list;
+}
+
+static PyObject *ParsedArgs_node_names(ParsedArgs *self, PyObject *noargs) {
+    return materialize_names(self->body, self->names, self->num_names);
+}
+
+static PyObject *ParsedArgs_node_names_list(ParsedArgs *self, PyObject *noargs) {
+    return materialize_names(self->body, self->nn_names, self->num_nn_names);
+}
+
+static PyObject *span_copy(ParsedArgs *self, Py_ssize_t start, Py_ssize_t end) {
+    if (start < 0) Py_RETURN_NONE;
+    return PyBytes_FromStringAndSize(
+        PyBytes_AS_STRING(self->body) + start, end - start);
+}
+
+static PyObject *ParsedArgs_nodes_span(ParsedArgs *self, PyObject *noargs) {
+    return span_copy(self, self->nodes_span_start, self->nodes_span_end);
+}
+
+static PyObject *ParsedArgs_nn_span(ParsedArgs *self, PyObject *noargs) {
+    return span_copy(self, self->nn_span_start, self->nn_span_end);
+}
+
+static PyObject *ParsedArgs_span_matches(ParsedArgs *self, PyObject *args) {
+    /* span_matches(use_node_names, candidate: bytes) -> bool
+     * memcmp of the raw candidate-list span against a cached span — the
+     * zero-false-positive verify of the response-reuse cache, without
+     * materializing the span (memoryview __eq__ is per-byte-slow and
+     * bytes() would copy ~hundreds of KB per probe). */
+    int use_node_names;
+    PyObject *cand;
+    if (!PyArg_ParseTuple(args, "pO", &use_node_names, &cand)) return NULL;
+    if (!PyBytes_Check(cand)) {
+        PyErr_SetString(PyExc_TypeError, "candidate span must be bytes");
+        return NULL;
+    }
+    Py_ssize_t start = use_node_names ? self->nn_span_start
+                                      : self->nodes_span_start;
+    Py_ssize_t end = use_node_names ? self->nn_span_end : self->nodes_span_end;
+    if (start < 0) Py_RETURN_FALSE;
+    Py_ssize_t len = end - start;
+    if (len != PyBytes_GET_SIZE(cand)) Py_RETURN_FALSE;
+    int equal;
+    const char *a = PyBytes_AS_STRING(self->body) + start;
+    const char *b = PyBytes_AS_STRING(cand);
+    Py_BEGIN_ALLOW_THREADS
+    equal = memcmp(a, b, (size_t)len) == 0;
+    Py_END_ALLOW_THREADS
+    return PyBool_FromLong(equal);
+}
+
+static PyGetSetDef ParsedArgs_getset[] = {
+    {"pod_name", (getter)ParsedArgs_get, NULL, NULL, "pod_name"},
+    {"pod_namespace", (getter)ParsedArgs_get, NULL, NULL, "pod_namespace"},
+    {"policy_label", (getter)ParsedArgs_get, NULL, NULL, "policy_label"},
+    {"nodes_present", (getter)ParsedArgs_get, NULL, NULL, "nodes_present"},
+    {"num_nodes", (getter)ParsedArgs_get, NULL, NULL, "num_nodes"},
+    {"node_names_present", (getter)ParsedArgs_get, NULL, NULL,
+     "node_names_present"},
+    {"num_node_names", (getter)ParsedArgs_get, NULL, NULL, "num_node_names"},
+    {NULL},
+};
+
+static PyMethodDef ParsedArgs_methods[] = {
+    {"node_names", (PyCFunction)ParsedArgs_node_names, METH_NOARGS,
+     "Materialize the Nodes.items name list (slow path / debugging)."},
+    {"node_names_list", (PyCFunction)ParsedArgs_node_names_list, METH_NOARGS,
+     "Materialize the NodeNames list (nodeCacheCapable mode)."},
+    {"nodes_span", (PyCFunction)ParsedArgs_nodes_span, METH_NOARGS,
+     "Copy of the raw Nodes JSON value bytes, or None."},
+    {"node_names_span", (PyCFunction)ParsedArgs_nn_span, METH_NOARGS,
+     "Copy of the raw NodeNames JSON value bytes, or None."},
+    {"span_matches", (PyCFunction)ParsedArgs_span_matches, METH_VARARGS,
+     "memcmp the request's candidate span against cached span bytes."},
+    {NULL},
+};
+
+static PyTypeObject ParsedArgs_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_wirec.ParsedArgs",
+    .tp_basicsize = sizeof(ParsedArgs),
+    .tp_dealloc = (destructor)ParsedArgs_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_getset = ParsedArgs_getset,
+    .tp_methods = ParsedArgs_methods,
+};
+
+/* -- Args-shaped scanning ------------------------------------------- */
+
+#define NAME_CHUNK 1024
+
+static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("eof in metadata");
+    /* duplicate "metadata" keys: last wins like json.loads — the new value
+     * (object or null) fully replaces fields from an earlier occurrence */
+    memset(&pa->pod_name, 0, sizeof(StrSlice));
+    memset(&pa->pod_namespace, 0, sizeof(StrSlice));
+    memset(&pa->policy_label, 0, sizeof(StrSlice));
+    pa->has_label = 0;
+    if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
+    if (sc->s[sc->i] != '{') return fail("metadata not object");
+    sc->i++;
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; return 0; }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice key;
+        if (scan_string(sc, &key) < 0) return -1;
+        if (key.escaped) return fail("escaped key");
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        skip_ws(sc);
+        const char *kp = sc->s + key.off;
+        if (key.len == 4 && memcmp(kp, "name", 4) == 0) {
+            if (sc->i < sc->n && sc->s[sc->i] == '"') {
+                if (scan_string(sc, &pa->pod_name) < 0) return -1;
+            } else {
+                /* last wins: a repeated key with a non-string value
+                 * replaces (clears) an earlier captured string */
+                memset(&pa->pod_name, 0, sizeof(StrSlice));
+                if (skip_value(sc) < 0) return -1;
+            }
+        } else if (key.len == 9 && memcmp(kp, "namespace", 9) == 0) {
+            if (sc->i < sc->n && sc->s[sc->i] == '"') {
+                if (scan_string(sc, &pa->pod_namespace) < 0) return -1;
+            } else {
+                memset(&pa->pod_namespace, 0, sizeof(StrSlice));
+                if (skip_value(sc) < 0) return -1;
+            }
+        } else if (key.len == 6 && memcmp(kp, "labels", 6) == 0) {
+            /* scan the labels object for "telemetry-policy"; a repeated
+             * "labels" key replaces any label from an earlier occurrence */
+            memset(&pa->policy_label, 0, sizeof(StrSlice));
+            pa->has_label = 0;
+            skip_ws(sc);
+            if (sc->i < sc->n && sc->s[sc->i] == '{') {
+                sc->i++;
+                skip_ws(sc);
+                if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; }
+                else for (;;) {
+                    skip_ws(sc);
+                    StrSlice lkey;
+                    if (scan_string(sc, &lkey) < 0) return -1;
+                    if (lkey.escaped) return fail("escaped key");
+                    skip_ws(sc);
+                    if (sc->i >= sc->n || sc->s[sc->i] != ':')
+                        return fail("expected ':'");
+                    sc->i++;
+                    skip_ws(sc);
+                    if (lkey.len == 16 &&
+                        memcmp(sc->s + lkey.off, "telemetry-policy", 16) == 0) {
+                        /* non-string label values take the exact Python
+                         * path (status-code parity on absurd input) */
+                        if (sc->i >= sc->n || sc->s[sc->i] != '"')
+                            return fail("label not string");
+                        if (scan_string(sc, &pa->policy_label) < 0) return -1;
+                        pa->has_label = 1;
+                    } else if (skip_value(sc) < 0) return -1;
+                    skip_ws(sc);
+                    if (sc->i >= sc->n) return fail("unterminated labels");
+                    if (sc->s[sc->i] == ',') { sc->i++; continue; }
+                    if (sc->s[sc->i] == '}') { sc->i++; break; }
+                    return fail("bad labels");
+                }
+            } else if (skip_value(sc) < 0) return -1;
+        } else {
+            if (skip_value(sc) < 0) return -1;
+        }
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated metadata");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; return 0; }
+        return fail("bad metadata");
+    }
+}
+
+static int scan_pod(Scan *sc, ParsedArgs *pa) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("eof in Pod");
+    /* "Pod": null — Go decodes null into a VALUE struct as "no effect"
+     * (the reference's Args.Pod is v1.Pod by value), so fields captured
+     * from an earlier duplicate occurrence must survive; contrast the
+     * pointer-typed Nodes/NodeNames where null assigns nil */
+    if (sc->s[sc->i] == 'n') return skip_literal(sc, "null", 4);
+    /* duplicate top-level "Pod" keys carrying objects: last wins */
+    memset(&pa->pod_name, 0, sizeof(StrSlice));
+    memset(&pa->pod_namespace, 0, sizeof(StrSlice));
+    memset(&pa->policy_label, 0, sizeof(StrSlice));
+    pa->has_label = 0;
+    if (sc->s[sc->i] != '{') return fail("Pod not object");
+    sc->i++;
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; return 0; }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice key;
+        if (scan_string(sc, &key) < 0) return -1;
+        if (key.escaped) return fail("escaped key");
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        if (key.len == 8 &&
+            memcmp(sc->s + key.off, "metadata", 8) == 0) {
+            if (scan_pod_metadata(sc, pa) < 0) return -1;
+        } else {
+            if (skip_value(sc) < 0) return -1;
+        }
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated Pod");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; return 0; }
+        return fail("bad Pod");
+    }
+}
+
+/* process-wide high-water candidate count: the first growth of a name
+ * array jumps straight to the size recent requests needed, collapsing
+ * the realloc chain (each step past the mmap threshold is a fresh
+ * mapping + copy — p99 churn at 10k nodes).  Atomic because the server
+ * is thread-per-connection (a per-thread hint would reset every
+ * connection); relaxed ordering — the hint is only an optimization. */
+#include <stdatomic.h>
+static _Atomic Py_ssize_t names_hint = NAME_CHUNK;
+
+static Py_ssize_t grow_cap(Py_ssize_t cap) {
+    return cap ? cap * 2
+               : atomic_load_explicit(&names_hint, memory_order_relaxed);
+}
+
+static int push_name(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap,
+                     const StrSlice *sl) {
+    if (pa->num_names == *cap) {
+        Py_ssize_t ncap = grow_cap(*cap);
+        StrSlice *nn = realloc(pa->names, ncap * sizeof(StrSlice));
+        if (!nn) return fail("out of memory");
+        pa->names = nn;
+        *cap = ncap;
+    }
+    pa->names[pa->num_names++] = *sl;
+    return 0;
+}
+
+static int scan_node_item(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
+    /* one Nodes.items entry: capture metadata.name, skip the rest */
+    skip_ws(sc);
+    if (sc->i >= sc->n || sc->s[sc->i] != '{') return fail("node not object");
+    sc->i++;
+    skip_ws(sc);
+    StrSlice name = {0, 0, 0, 0};
+    if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; goto done; }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice key;
+        if (scan_string(sc, &key) < 0) return -1;
+        if (key.escaped) return fail("escaped key");
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        if (key.len == 8 &&
+            memcmp(sc->s + key.off, "metadata", 8) == 0) {
+            skip_ws(sc);
+            if (sc->i >= sc->n) return fail("eof in node metadata");
+            /* repeated "metadata" key: last wins — the new value replaces
+             * any name captured from an earlier occurrence */
+            memset(&name, 0, sizeof(StrSlice));
+            if (sc->s[sc->i] == '{') {
+                sc->i++;
+                skip_ws(sc);
+                if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; }
+                else for (;;) {
+                    skip_ws(sc);
+                    StrSlice mkey;
+                    if (scan_string(sc, &mkey) < 0) return -1;
+                    if (mkey.escaped) return fail("escaped key");
+                    skip_ws(sc);
+                    if (sc->i >= sc->n || sc->s[sc->i] != ':')
+                        return fail("expected ':'");
+                    sc->i++;
+                    skip_ws(sc);
+                    if (mkey.len == 4 &&
+                        memcmp(sc->s + mkey.off, "name", 4) == 0) {
+                        if (sc->i < sc->n && sc->s[sc->i] == '"') {
+                            if (scan_string(sc, &name) < 0) return -1;
+                        } else {
+                            memset(&name, 0, sizeof(StrSlice));
+                            if (skip_value(sc) < 0) return -1;
+                        }
+                    } else if (skip_value(sc) < 0) return -1;
+                    skip_ws(sc);
+                    if (sc->i >= sc->n) return fail("unterminated node metadata");
+                    if (sc->s[sc->i] == ',') { sc->i++; continue; }
+                    if (sc->s[sc->i] == '}') { sc->i++; break; }
+                    return fail("bad node metadata");
+                }
+            } else if (skip_value(sc) < 0) return -1;
+        } else {
+            if (skip_value(sc) < 0) return -1;
+        }
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated node");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') { sc->i++; break; }
+        return fail("bad node");
+    }
+done:
+    /* missing metadata.name encodes as empty slice at offset 0 */
+    return push_name(sc, pa, cap, &name);
+}
+
+/* "NodeNames": null | array of strings (nodeCacheCapable mode,
+ * extender/types.go:44-49); strict: non-string elements fail the parse */
+static int scan_node_names(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("eof in NodeNames");
+    /* duplicate "NodeNames" keys: last wins */
+    pa->node_names_present = 0;
+    pa->num_nn_names = 0;
+    pa->nn_span_start = sc->i;
+    if (sc->s[sc->i] == 'n') {
+        if (skip_literal(sc, "null", 4) < 0) return -1;
+        pa->nn_span_end = sc->i;
+        return 0;
+    }
+    if (sc->s[sc->i] != '[') return fail("NodeNames not array");
+    pa->node_names_present = 1;
+    sc->i++;
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == ']') {
+        sc->i++;
+        pa->nn_span_end = sc->i;
+        return 0;
+    }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice name;
+        if (scan_string(sc, &name) < 0) return -1;
+        if (pa->num_nn_names == *cap) {
+            Py_ssize_t ncap = grow_cap(*cap);
+            StrSlice *nn = realloc(pa->nn_names, ncap * sizeof(StrSlice));
+            if (!nn) return fail("out of memory");
+            pa->nn_names = nn;
+            *cap = ncap;
+        }
+        pa->nn_names[pa->num_nn_names++] = name;
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated NodeNames");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == ']') {
+            sc->i++;
+            pa->nn_span_end = sc->i;
+            return 0;
+        }
+        return fail("bad NodeNames");
+    }
+}
+
+static int scan_nodes(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
+    skip_ws(sc);
+    if (sc->i >= sc->n) return fail("eof in Nodes");
+    pa->nodes_span_start = sc->i;
+    if (sc->s[sc->i] == 'n') {
+        int rc = skip_literal(sc, "null", 4);
+        pa->nodes_span_end = sc->i;
+        return rc;
+    }
+    if (sc->s[sc->i] != '{') return fail("Nodes not object");
+    sc->i++;
+    skip_ws(sc);
+    if (sc->i < sc->n && sc->s[sc->i] == '}') {
+        sc->i++;
+        pa->nodes_span_end = sc->i;
+        return 0;
+    }
+    for (;;) {
+        skip_ws(sc);
+        StrSlice key;
+        if (scan_string(sc, &key) < 0) return -1;
+        if (key.escaped) return fail("escaped key");
+        skip_ws(sc);
+        if (sc->i >= sc->n || sc->s[sc->i] != ':') return fail("expected ':'");
+        sc->i++;
+        if (key.len == 5 &&
+            memcmp(sc->s + key.off, "items", 5) == 0) {
+            skip_ws(sc);
+            if (sc->i < sc->n && sc->s[sc->i] == 'n') {
+                if (skip_literal(sc, "null", 4) < 0) return -1;
+                pa->nodes_present = 1;  /* Nodes object exists, items null */
+                pa->num_names = 0;      /* last-wins: null replaces any array */
+            } else if (sc->i < sc->n && sc->s[sc->i] == '[') {
+                pa->nodes_present = 1;
+                /* duplicate "items" keys: last wins like json.loads */
+                pa->num_names = 0;
+                sc->i++;
+                skip_ws(sc);
+                if (sc->i < sc->n && sc->s[sc->i] == ']') { sc->i++; }
+                else for (;;) {
+                    if (scan_node_item(sc, pa, cap) < 0) return -1;
+                    skip_ws(sc);
+                    if (sc->i >= sc->n) return fail("unterminated items");
+                    if (sc->s[sc->i] == ',') { sc->i++; continue; }
+                    if (sc->s[sc->i] == ']') { sc->i++; break; }
+                    return fail("bad items");
+                }
+            } else {
+                return fail("items not array");
+            }
+        } else {
+            if (skip_value(sc) < 0) return -1;
+        }
+        skip_ws(sc);
+        if (sc->i >= sc->n) return fail("unterminated Nodes");
+        if (sc->s[sc->i] == ',') { sc->i++; continue; }
+        if (sc->s[sc->i] == '}') {
+            sc->i++;
+            pa->nodes_span_end = sc->i;
+            return 0;
+        }
+        return fail("bad Nodes");
+    }
+}
+
+static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
+    if (!PyBytes_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "body must be bytes");
+        return NULL;
+    }
+    ParsedArgs *pa = PyObject_New(ParsedArgs, &ParsedArgs_Type);
+    if (!pa) return NULL;
+    Py_INCREF(arg);
+    pa->body = arg;
+    memset(&pa->pod_name, 0, sizeof(StrSlice));
+    memset(&pa->pod_namespace, 0, sizeof(StrSlice));
+    memset(&pa->policy_label, 0, sizeof(StrSlice));
+    pa->has_label = 0;
+    pa->nodes_present = 0;
+    pa->names = NULL;
+    pa->num_names = 0;
+    pa->node_names_present = 0;
+    pa->nn_names = NULL;
+    pa->num_nn_names = 0;
+    pa->nodes_span_start = pa->nodes_span_end = -1;
+    pa->nn_span_start = pa->nn_span_end = -1;
+    Py_ssize_t cap = 0;
+    Py_ssize_t nn_cap = 0;
+
+    Scan scan_state = {PyBytes_AS_STRING(arg), PyBytes_GET_SIZE(arg), 0, NULL};
+    Scan *sc = &scan_state;
+    int ok = 1;
+    /* the scan touches only raw body bytes + raw-allocated name slices, so
+     * it runs without the GIL: concurrent requests parse in parallel */
+    Py_BEGIN_ALLOW_THREADS
+    skip_ws(sc);
+    if (sc->i >= sc->n || sc->s[sc->i] != '{') {
+        fail("body not a JSON object");
+        ok = 0;
+    } else {
+        sc->i++;
+        skip_ws(sc);
+        if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; }
+        else for (;;) {
+            skip_ws(sc);
+            StrSlice key;
+            if (scan_string(sc, &key) < 0) { ok = 0; break; }
+            if (key.escaped) { fail("escaped key"); ok = 0; break; }
+            skip_ws(sc);
+            if (sc->i >= sc->n || sc->s[sc->i] != ':') {
+                fail("expected ':'");
+                ok = 0;
+                break;
+            }
+            sc->i++;
+            const char *kp = sc->s + key.off;
+            int handled = 0;
+            if (key_is_ci(kp, key.len, "pod", 3)) {
+                if (scan_pod(sc, pa) < 0) { ok = 0; break; }
+                handled = 1;
+            } else if (key_is_ci(kp, key.len, "nodes", 5)) {
+                pa->nodes_present = 0;
+                pa->num_names = 0;
+                pa->nodes_span_start = pa->nodes_span_end = -1;
+                if (scan_nodes(sc, pa, &cap) < 0) { ok = 0; break; }
+                handled = 1;
+            } else if (key_is_ci(kp, key.len, "nodenames", 9)) {
+                if (scan_node_names(sc, pa, &nn_cap) < 0) { ok = 0; break; }
+                handled = 1;
+            }
+            if (!handled && skip_value(sc) < 0) { ok = 0; break; }
+            skip_ws(sc);
+            if (sc->i >= sc->n) { fail("unterminated body"); ok = 0; break; }
+            if (sc->s[sc->i] == ',') { sc->i++; continue; }
+            if (sc->s[sc->i] == '}') { sc->i++; break; }
+            fail("bad body");
+            ok = 0;
+            break;
+        }
+        if (ok) {
+            skip_ws(sc);
+            if (sc->i != sc->n) { fail("trailing data"); ok = 0; }
+        }
+    }
+    Py_END_ALLOW_THREADS
+    if (!ok) {
+        Py_DECREF(pa);
+        PyErr_SetString(PyExc_ValueError, sc->err ? sc->err : "parse error");
+        return NULL;
+    }
+    /* remember this request's candidate count so the next request's
+     * array starts at the right size (process-wide atomic, relaxed —
+     * the hint is only an allocation-size optimization) */
+    Py_ssize_t seen = pa->num_names > pa->num_nn_names ? pa->num_names
+                                                       : pa->num_nn_names;
+    if (seen > atomic_load_explicit(&names_hint, memory_order_relaxed)) {
+        Py_ssize_t h = NAME_CHUNK;
+        while (h < seen) h *= 2;
+        atomic_store_explicit(&names_hint, h, memory_order_relaxed);
+    }
+    return (PyObject *)pa;
+}
+
+/* ------------------------------------------------------------------ */
+/* NameTable: name -> row hash map + response fragments                */
+
+typedef struct {
+    PyObject_HEAD
+    Py_ssize_t n_rows;
+    /* open addressing table of 2^bits slots, each slot = row+1 (0=empty) */
+    uint32_t *slots;
+    uint32_t mask;
+    /* interned copies of names (concatenated) for collision verification */
+    char *name_bytes;
+    Py_ssize_t *name_off;  /* n_rows + 1 offsets */
+    /* pre-rendered fragments: {"Host": "<name>", "Score":  */
+    char *frag_bytes;
+    Py_ssize_t *frag_off;  /* n_rows + 1 offsets */
+} NameTable;
+
+static void NameTable_dealloc(NameTable *self) {
+    PyMem_Free(self->slots);
+    /* name_bytes/frag_bytes are Buf storage (malloc) — free with free();
+     * mixing allocators is undefined behavior under PYTHONMALLOC=debug */
+    free(self->name_bytes);
+    PyMem_Free(self->name_off);
+    free(self->frag_bytes);
+    PyMem_Free(self->frag_off);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static uint64_t fnv1a(const char *s, Py_ssize_t n) {
+    uint64_t h = 1469598103934665603ULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        h ^= (unsigned char)s[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+/* row lookup by raw (unescaped) name bytes; -1 if absent */
+static Py_ssize_t table_lookup(NameTable *t, const char *s, Py_ssize_t n) {
+    uint64_t h = fnv1a(s, n);
+    uint32_t idx = (uint32_t)h & t->mask;
+    for (;;) {
+        uint32_t slot = t->slots[idx];
+        if (slot == 0) return -1;
+        Py_ssize_t row = (Py_ssize_t)slot - 1;
+        Py_ssize_t off = t->name_off[row];
+        Py_ssize_t len = t->name_off[row + 1] - off;
+        if (len == n && memcmp(t->name_bytes + off, s, n) == 0) return row;
+        idx = (idx + 1) & t->mask;
+    }
+}
+
+static PyTypeObject NameTable_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_wirec.NameTable",
+    .tp_basicsize = sizeof(NameTable),
+    .tp_dealloc = (destructor)NameTable_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+};
+
+static PyObject *wirec_build_table(PyObject *mod, PyObject *arg) {
+    /* arg: sequence of str node names in row order; fragments use
+     * json-exact escaping via json.dumps for non-ASCII-simple names */
+    PyObject *seq = PySequence_Fast(arg, "expected a sequence of names");
+    if (!seq) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    NameTable *t = PyObject_New(NameTable, &NameTable_Type);
+    if (!t) { Py_DECREF(seq); return NULL; }
+    t->n_rows = n;
+    t->slots = NULL;
+    t->name_bytes = NULL;
+    t->name_off = NULL;
+    t->frag_bytes = NULL;
+    t->frag_off = NULL;
+
+    uint32_t bits = 3;
+    while ((1u << bits) < (uint32_t)(n * 2 + 4)) bits++;
+    uint32_t size = 1u << bits;
+    t->mask = size - 1;
+    t->slots = PyMem_Calloc(size, sizeof(uint32_t));
+    t->name_off = PyMem_Malloc((n + 1) * sizeof(Py_ssize_t));
+    t->frag_off = PyMem_Malloc((n + 1) * sizeof(Py_ssize_t));
+    if (!t->slots || !t->name_off || !t->frag_off) {
+        PyErr_NoMemory();
+        goto error;
+    }
+
+    Buf names_buf, frag_buf;
+    if (buf_init(&names_buf, 64 * (n + 1)) < 0) { PyErr_NoMemory(); goto error; }
+    if (buf_init(&frag_buf, 96 * (n + 1)) < 0) {
+        buf_free(&names_buf);
+        PyErr_NoMemory();
+        goto error;
+    }
+
+    PyObject *json_mod = NULL;
+    for (Py_ssize_t row = 0; row < n; row++) {
+        PyObject *name = PySequence_Fast_GET_ITEM(seq, row);
+        Py_ssize_t nlen;
+        const char *ns = PyUnicode_AsUTF8AndSize(name, &nlen);
+        if (!ns) goto error_bufs;
+        t->name_off[row] = (Py_ssize_t)names_buf.len;
+        if (buf_put(&names_buf, ns, nlen) < 0) goto error_bufs;
+
+        /* fragment */
+        t->frag_off[row] = (Py_ssize_t)frag_buf.len;
+        int needs_escape = 0;
+        for (Py_ssize_t k = 0; k < nlen; k++) {
+            unsigned char c = (unsigned char)ns[k];
+            if (c == '"' || c == '\\' || c < 0x20 || c >= 0x7f) {
+                needs_escape = 1;
+                break;
+            }
+        }
+        if (buf_put(&frag_buf, "{\"Host\": ", 9) < 0) goto error_bufs;
+        if (!needs_escape) {
+            if (buf_put(&frag_buf, "\"", 1) < 0) goto error_bufs;
+            if (buf_put(&frag_buf, ns, nlen) < 0) goto error_bufs;
+            if (buf_put(&frag_buf, "\"", 1) < 0) goto error_bufs;
+        } else {
+            if (!json_mod) {
+                json_mod = PyImport_ImportModule("json");
+                if (!json_mod) goto error_bufs;
+            }
+            PyObject *enc = PyObject_CallMethod(json_mod, "dumps", "O", name);
+            if (!enc) goto error_bufs;
+            Py_ssize_t elen;
+            const char *es = PyUnicode_AsUTF8AndSize(enc, &elen);
+            if (!es || buf_put(&frag_buf, es, elen) < 0) {
+                Py_DECREF(enc);
+                goto error_bufs;
+            }
+            Py_DECREF(enc);
+        }
+        if (buf_put(&frag_buf, ", \"Score\": ", 11) < 0) goto error_bufs;
+    }
+    t->name_off[n] = (Py_ssize_t)names_buf.len;
+    t->frag_off[n] = (Py_ssize_t)frag_buf.len;
+    Py_XDECREF(json_mod);
+    json_mod = NULL;
+
+    t->name_bytes = names_buf.data;  /* ownership moves */
+    t->frag_bytes = frag_buf.data;
+
+    /* populate hash slots (first writer wins; duplicate names share the
+     * earlier row, which matches dict interning order semantics) */
+    for (Py_ssize_t row = 0; row < n; row++) {
+        Py_ssize_t off = t->name_off[row];
+        Py_ssize_t len = t->name_off[row + 1] - off;
+        uint64_t h = fnv1a(t->name_bytes + off, len);
+        uint32_t idx = (uint32_t)h & t->mask;
+        for (;;) {
+            if (t->slots[idx] == 0) {
+                t->slots[idx] = (uint32_t)(row + 1);
+                break;
+            }
+            Py_ssize_t prow = (Py_ssize_t)t->slots[idx] - 1;
+            Py_ssize_t poff = t->name_off[prow];
+            Py_ssize_t plen = t->name_off[prow + 1] - poff;
+            if (plen == len &&
+                memcmp(t->name_bytes + poff, t->name_bytes + off, len) == 0)
+                break;  /* duplicate name: keep first row */
+            idx = (idx + 1) & t->mask;
+        }
+    }
+    Py_DECREF(seq);
+    return (PyObject *)t;
+
+error_bufs:
+    Py_XDECREF(json_mod);
+    buf_free(&names_buf);
+    buf_free(&frag_buf);
+error:
+    Py_DECREF(seq);
+    Py_DECREF(t);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
+/* select_encode                                                       */
+
+/* decimal render of score + '}' — snprintf is ~10x slower and sits on the
+ * per-row hot path of a 10k-entry response */
+static int put_score(Buf *b, long score) {
+    char tmp[24];
+    char *end = tmp + sizeof(tmp);
+    char *p = end;
+    *--p = '}';
+    unsigned long v = score < 0 ? (unsigned long)(-score) : (unsigned long)score;
+    do {
+        *--p = (char)('0' + (v % 10));
+        v /= 10;
+    } while (v);
+    if (score < 0) *--p = '-';
+    return buf_put(b, p, (size_t)(end - p));
+}
+
+static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
+    PyObject *parsed_obj, *table_obj, *ranked_obj;
+    Py_ssize_t planned_row = -1;
+    int use_node_names = 0;
+    if (!PyArg_ParseTuple(args, "OOO|np", &parsed_obj, &table_obj, &ranked_obj,
+                          &planned_row, &use_node_names))
+        return NULL;
+    if (!PyObject_TypeCheck(parsed_obj, &ParsedArgs_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected ParsedArgs");
+        return NULL;
+    }
+    if (!PyObject_TypeCheck(table_obj, &NameTable_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected NameTable");
+        return NULL;
+    }
+    ParsedArgs *pa = (ParsedArgs *)parsed_obj;
+    NameTable *t = (NameTable *)table_obj;
+
+    Py_buffer ranked;
+    if (PyObject_GetBuffer(ranked_obj, &ranked, PyBUF_SIMPLE) < 0)
+        return NULL;
+    if (ranked.len % sizeof(int64_t) != 0) {
+        PyBuffer_Release(&ranked);
+        PyErr_SetString(PyExc_ValueError, "ranked must be int64 buffer");
+        return NULL;
+    }
+    const int64_t *order = (const int64_t *)ranked.buf;
+    Py_ssize_t n_ranked = ranked.len / sizeof(int64_t);
+
+    /* candidate source: Nodes.items names, or the NodeNames array in
+     * nodeCacheCapable mode */
+    const StrSlice *cand = use_node_names ? pa->nn_names : pa->names;
+    Py_ssize_t num_cand = use_node_names ? pa->num_nn_names : pa->num_names;
+
+    /* candidate mask over rows; escaped names (rare) resolve under the
+     * GIL first, everything else runs GIL-free below.  The mask comes
+     * from the process-wide buffer pool (stale bytes cleared here) — a
+     * fresh calloc per request at 10k rows churns pages into p99 */
+    Buf mask_buf = pool_get((size_t)t->n_rows + 1);
+    if (!mask_buf.data) {
+        PyBuffer_Release(&ranked);
+        return PyErr_NoMemory();
+    }
+    uint8_t *mask = (uint8_t *)mask_buf.data;
+    memset(mask, 0, (size_t)t->n_rows + 1);
+    for (Py_ssize_t k = 0; k < num_cand; k++) {
+        const StrSlice *sl = &cand[k];
+        if (sl->present && sl->escaped) {
+            PyObject *u = slice_to_unicode(pa->body, sl);
+            if (!u) goto error;
+            Py_ssize_t ulen;
+            const char *us = PyUnicode_AsUTF8AndSize(u, &ulen);
+            if (!us) { Py_DECREF(u); goto error; }
+            Py_ssize_t row = table_lookup(t, us, ulen);
+            Py_DECREF(u);
+            if (row >= 0) mask[row] = 1;
+        }
+    }
+
+    const char *body = PyBytes_AS_STRING(pa->body);
+    Buf out_buf = {NULL, 0, 0};
+    Buf *out = &out_buf;
+    int oom = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t k = 0; k < num_cand; k++) {
+        const StrSlice *sl = &cand[k];
+        if (!sl->present || sl->escaped) continue;
+        Py_ssize_t row = table_lookup(t, body + sl->off, sl->len);
+        if (row >= 0) mask[row] = 1;
+    }
+
+    /* size the output exactly: masked fragments + score/separator slack */
+    size_t est = 8;
+    for (Py_ssize_t row = 0; row < t->n_rows; row++)
+        if (mask[row])
+            est += (size_t)(t->frag_off[row + 1] - t->frag_off[row]) + 16;
+    out_buf = pool_get(est);
+    if (!out_buf.data) oom = 1;
+
+    if (!oom) {
+        int promote = 0;
+        if (planned_row >= 0 && planned_row < t->n_rows && mask[planned_row]) {
+            /* planned node goes first iff it appears in the ranked order */
+            for (Py_ssize_t k = 0; k < n_ranked; k++) {
+                if (order[k] == planned_row) { promote = 1; break; }
+            }
+        }
+        long rank = 0;
+        int first = 1;
+        if (buf_put(out, "[", 1) < 0) oom = 1;
+        if (!oom && promote) {
+            Py_ssize_t off = t->frag_off[planned_row];
+            if (buf_put(out, t->frag_bytes + off,
+                        (size_t)(t->frag_off[planned_row + 1] - off)) < 0 ||
+                put_score(out, 10) < 0)
+                oom = 1;
+            rank = 1;
+            first = 0;
+        }
+        for (Py_ssize_t k = 0; !oom && k < n_ranked; k++) {
+            int64_t row = order[k];
+            if (row < 0 || row >= t->n_rows || !mask[row]) continue;
+            if (promote && row == planned_row) continue;
+            if (!first && buf_put(out, ", ", 2) < 0) { oom = 1; break; }
+            first = 0;
+            Py_ssize_t off = t->frag_off[row];
+            if (buf_put(out, t->frag_bytes + off,
+                        (size_t)(t->frag_off[row + 1] - off)) < 0 ||
+                put_score(out, 10 - rank) < 0) {
+                oom = 1;
+                break;
+            }
+            rank++;
+        }
+        if (!oom && buf_put(out, "]\n", 2) < 0) oom = 1;
+    }
+    Py_END_ALLOW_THREADS
+
+    pool_put(&mask_buf);
+    PyBuffer_Release(&ranked);
+    if (oom) {
+        pool_put(&out_buf);
+        return PyErr_NoMemory();
+    }
+    PyObject *res = PyBytes_FromStringAndSize(out->data, (Py_ssize_t)out->len);
+    pool_put(&out_buf);
+    return res;
+
+error:
+    pool_put(&mask_buf);
+    PyBuffer_Release(&ranked);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
+/* filter_encode                                                       */
+
+/* Build the NodeNames-mode FilterResult response straight from the
+ * parsed body + name table + a per-row violation bitmask:
+ *
+ *   {"Nodes": null, "NodeNames": [...passing...],
+ *    "FailedNodes": {"<name>": "Node violates", ...}, "Error": ""}\n
+ *
+ * Byte-identical to FilterResult.to_json() over the exact Python path's
+ * result for the same request (json.dumps separators/ensure_ascii):
+ * candidates keep request order; a name can be emitted raw iff its slice
+ * has no escapes and every byte is in [0x20,0x7e] (exactly the set
+ * json.dumps re-emits unchanged); duplicate violating names collapse to
+ * one FailedNodes entry at first-occurrence position (dict semantics);
+ * names absent from the table never violate (they pass through). */
+static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
+    PyObject *parsed_obj, *table_obj, *mask_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &parsed_obj, &table_obj, &mask_obj))
+        return NULL;
+    if (!PyObject_TypeCheck(parsed_obj, &ParsedArgs_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected ParsedArgs");
+        return NULL;
+    }
+    if (!PyObject_TypeCheck(table_obj, &NameTable_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected NameTable");
+        return NULL;
+    }
+    ParsedArgs *pa = (ParsedArgs *)parsed_obj;
+    NameTable *t = (NameTable *)table_obj;
+    Py_buffer viol;
+    if (PyObject_GetBuffer(mask_obj, &viol, PyBUF_SIMPLE) < 0) return NULL;
+    if (viol.len < t->n_rows) {
+        PyBuffer_Release(&viol);
+        PyErr_SetString(PyExc_ValueError, "violation mask shorter than table");
+        return NULL;
+    }
+    const uint8_t *vmask = (const uint8_t *)viol.buf;
+    const StrSlice *cand = pa->nn_names;  /* NodeNames mode only */
+    Py_ssize_t num = pa->num_nn_names;
+    const char *body = PyBytes_AS_STRING(pa->body);
+
+    /* per-candidate resolution: row (or -1) and, for slices json.dumps
+     * would re-escape, a pre-encoded buffer built under the GIL */
+    Py_ssize_t *rows = NULL;
+    uint8_t *raw_ok = NULL;
+    uint8_t *seen = NULL;          /* FailedNodes dedup by row */
+    const char **enc_ptr = NULL;   /* encoded bytes for non-raw slices */
+    Py_ssize_t *enc_len = NULL;
+    PyObject **enc_obj = NULL;     /* owned refs backing enc_ptr */
+    Py_ssize_t n_enc = 0;
+    PyObject *json_mod = NULL, *res = NULL;
+    Buf out_buf = {NULL, 0, 0};
+    Buf *out = &out_buf;
+    int oom = 0;
+
+    rows = PyMem_Malloc((size_t)(num ? num : 1) * sizeof(Py_ssize_t));
+    raw_ok = PyMem_Malloc((size_t)(num ? num : 1));
+    seen = PyMem_Calloc((size_t)t->n_rows + 1, 1);
+    if (!rows || !raw_ok || !seen) { PyErr_NoMemory(); goto done; }
+
+    size_t span_bytes = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t k = 0; k < num; k++) {
+        const StrSlice *sl = &cand[k];
+        int ok = !sl->escaped;
+        if (ok) {
+            const unsigned char *p = (const unsigned char *)body + sl->off;
+            for (Py_ssize_t j = 0; j < sl->len; j++) {
+                if (p[j] < 0x20 || p[j] >= 0x7f) { ok = 0; break; }
+            }
+        }
+        raw_ok[k] = (uint8_t)ok;
+        if (ok) {
+            rows[k] = table_lookup(t, body + sl->off, sl->len);
+            span_bytes += (size_t)sl->len;
+        } else {
+            rows[k] = -1;  /* resolved under the GIL below */
+            n_enc++;
+        }
+    }
+    Py_END_ALLOW_THREADS
+
+    if (n_enc) {
+        enc_ptr = PyMem_Calloc((size_t)num, sizeof(char *));
+        enc_len = PyMem_Calloc((size_t)num, sizeof(Py_ssize_t));
+        enc_obj = PyMem_Calloc((size_t)num, sizeof(PyObject *));
+        if (!enc_ptr || !enc_len || !enc_obj) { PyErr_NoMemory(); goto done; }
+        json_mod = PyImport_ImportModule("json");
+        if (!json_mod) goto done;
+        for (Py_ssize_t k = 0; k < num; k++) {
+            if (raw_ok[k]) continue;
+            PyObject *u = slice_to_unicode(pa->body, &cand[k]);
+            if (!u) goto done;
+            Py_ssize_t ulen;
+            const char *us = PyUnicode_AsUTF8AndSize(u, &ulen);
+            if (!us) { Py_DECREF(u); goto done; }
+            rows[k] = table_lookup(t, us, ulen);
+            PyObject *e = PyObject_CallMethod(json_mod, "dumps", "O", u);
+            Py_DECREF(u);
+            if (!e) goto done;
+            /* keep the utf-8 of the encoded form alive via a bytes ref */
+            PyObject *eb = PyUnicode_AsUTF8String(e);
+            Py_DECREF(e);
+            if (!eb) goto done;
+            enc_obj[k] = eb;
+            enc_ptr[k] = PyBytes_AS_STRING(eb);
+            enc_len[k] = PyBytes_GET_SIZE(eb);
+            span_bytes += (size_t)enc_len[k];
+        }
+    }
+
+    Py_BEGIN_ALLOW_THREADS
+    /* "name", -> len+4 each; failed entry adds ': "Node violates"' (18) */
+    out_buf = pool_get(96 + span_bytes + (size_t)num * 24);
+    if (!out_buf.data) oom = 1;
+    if (!oom && buf_put(out, "{\"Nodes\": null, \"NodeNames\": [", 30) < 0)
+        oom = 1;
+    int first = 1;
+    for (Py_ssize_t k = 0; !oom && k < num; k++) {
+        Py_ssize_t row = rows[k];
+        if (row >= 0 && vmask[row]) continue;  /* violating -> FailedNodes */
+        if (!first && buf_put(out, ", ", 2) < 0) { oom = 1; break; }
+        first = 0;
+        if (raw_ok[k]) {
+            const StrSlice *sl = &cand[k];
+            if (buf_put(out, "\"", 1) < 0 ||
+                buf_put(out, body + sl->off, (size_t)sl->len) < 0 ||
+                buf_put(out, "\"", 1) < 0)
+                oom = 1;
+        } else {
+            if (buf_put(out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
+        }
+    }
+    if (!oom && buf_put(out, "], \"FailedNodes\": {", 19) < 0) oom = 1;
+    first = 1;
+    for (Py_ssize_t k = 0; !oom && k < num; k++) {
+        Py_ssize_t row = rows[k];
+        if (row < 0 || !vmask[row] || seen[row]) continue;
+        seen[row] = 1;
+        if (!first && buf_put(out, ", ", 2) < 0) { oom = 1; break; }
+        first = 0;
+        if (raw_ok[k]) {
+            const StrSlice *sl = &cand[k];
+            if (buf_put(out, "\"", 1) < 0 ||
+                buf_put(out, body + sl->off, (size_t)sl->len) < 0 ||
+                buf_put(out, "\"", 1) < 0)
+                oom = 1;
+        } else {
+            if (buf_put(out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
+        }
+        if (!oom && buf_put(out, ": \"Node violates\"", 17) < 0) oom = 1;
+    }
+    if (!oom && buf_put(out, "}, \"Error\": \"\"}\n", 16) < 0) oom = 1;
+    Py_END_ALLOW_THREADS
+
+    if (oom) PyErr_NoMemory();
+    else res = PyBytes_FromStringAndSize(out->data, (Py_ssize_t)out->len);
+
+done:
+    pool_put(&out_buf);
+    if (enc_obj) {
+        for (Py_ssize_t k = 0; k < num; k++) Py_XDECREF(enc_obj[k]);
+    }
+    PyMem_Free(enc_ptr);
+    PyMem_Free(enc_len);
+    PyMem_Free(enc_obj);
+    Py_XDECREF(json_mod);
+    PyMem_Free(rows);
+    PyMem_Free(raw_ok);
+    PyMem_Free(seen);
+    PyBuffer_Release(&viol);
+    return res;
+}
+
+/* ------------------------------------------------------------------ */
+
+static PyMethodDef wirec_methods[] = {
+    {"parse_prioritize", wirec_parse_prioritize, METH_O,
+     "Strict zero-copy scan of a scheduler-extender Args body."},
+    {"build_table", wirec_build_table, METH_O,
+     "Build a name->row table + response fragments for one state version."},
+    {"select_encode", wirec_select_encode, METH_VARARGS,
+     "Assemble the Prioritize response bytes from a parsed body, a name "
+     "table, and the global rank order (optional planned row promotion)."},
+    {"filter_encode", wirec_filter_encode, METH_VARARGS,
+     "Assemble the NodeNames-mode FilterResult response bytes from a "
+     "parsed body, a name table, and a per-row violation bitmask."},
+    {NULL},
+};
+
+static struct PyModuleDef wirec_module = {
+    PyModuleDef_HEAD_INIT, "_wirec",
+    "Native wire-protocol fast path for the TPU scheduler extender.",
+    -1, wirec_methods,
+};
+
+PyMODINIT_FUNC PyInit__wirec(void) {
+    if (PyType_Ready(&ParsedArgs_Type) < 0) return NULL;
+    if (PyType_Ready(&NameTable_Type) < 0) return NULL;
+    return PyModule_Create(&wirec_module);
+}

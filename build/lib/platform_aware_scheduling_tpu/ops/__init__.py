@@ -1,0 +1,14 @@
+"""Device-side (JAX/XLA) kernels: the tensorized scheduling core.
+
+The reference's entire mathematical core is ``EvaluateRule`` +
+``OrderedList`` (reference telemetry-aware-scheduling/pkg/strategies/core/
+operator.go:13-42) executed per pod per node in Go.  Here those become
+batched XLA programs over dense ``[metrics, nodes]`` tensors:
+
+- :mod:`ops.i64`     — exact int64 semantics on TPU via (hi i32, lo u32) pairs
+- :mod:`ops.rules`   — vectorized rule evaluation / violation masks
+- :mod:`ops.scoring` — ordinal Prioritize ranking via multi-key lax.sort
+- :mod:`ops.state`   — host mirror: interning tables + dense device tensors
+- :mod:`ops.binpack` — GAS per-card first-fit as a vectorized constraint mask
+- :mod:`ops.assign`  — batched pods x nodes assignment solve
+"""

@@ -1,0 +1,160 @@
+"""GAS first-fit card bin-packing as a batched XLA program.
+
+Reference semantics (gpu-aware-scheduling/pkg/gpuscheduler/scheduler.go:
+200-257, 341-383): per container, the per-GPU share of the request is
+placed on the first card (sorted name order) whose ``used + need <= cap``
+for every requested resource; a card can be picked repeatedly for one
+container when it has room for several shares; capacity missing or <= 0
+for any requested resource fails; int64 overflow of used+need fails.
+
+The reference runs this per node, sequentially, under a global lock
+(scheduler.go:463-473).  Here one jitted program evaluates EVERY candidate
+node at once: ``vmap`` over the node axis of a ``[nodes, cards, resources]``
+usage tensor, ``lax.scan`` over the (small, static) container and GPU-count
+axes.  Values are exact int64 in split (hi, lo) form (ops/i64.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.ops import i64
+
+NO_CARD = jnp.int32(-1)
+
+
+class BinpackRequest(NamedTuple):
+    """Per-container per-GPU shares, padded to T containers x R resources."""
+
+    need: i64.I64  # [T, R] per-GPU request share (host-divided, exact)
+    need_active: jax.Array  # bool [T, R] — resource present in the request
+    num_gpus: jax.Array  # int32 [T] — the container's i915 count
+    container_active: jax.Array  # bool [T] — real (non-padding) container
+
+
+class BinpackNodeState(NamedTuple):
+    """Per-node card state, padded to N nodes x C cards x R resources."""
+
+    used: i64.I64  # [N, C, R] booked usage
+    capacity: i64.I64  # [N, R] per-GPU capacity (homogeneous cards)
+    cap_present: jax.Array  # bool [N, R] — resource exists in node capacity
+    card_valid: jax.Array  # bool [N, C] — card still in the node's GPU label
+    card_real: jax.Array  # bool [N, C] — non-padding lane
+    # first-fit priority of each card lane (lower = earlier).  The
+    # reference iterates cards in sorted-name order (scheduler.go:216-224);
+    # a persistent mirror interns card lanes append-only, so name order is
+    # carried explicitly instead of assuming lane order.
+    card_order: jax.Array  # int32 [N, C]
+
+
+class BinpackResult(NamedTuple):
+    fits: jax.Array  # bool [N]
+    cards: jax.Array  # int32 [N, T, K] chosen card index per GPU, -1 = none
+
+
+def _card_fits(
+    used: i64.I64,  # [C, R]
+    need: i64.I64,  # [R]
+    need_active: jax.Array,  # [R]
+    capacity: i64.I64,  # [R]
+    cap_present: jax.Array,  # [R]
+    card_ok: jax.Array,  # [C]
+) -> jax.Array:
+    """checkResourceCapacity (scheduler.go:341-383) for every card at once.
+    Returns bool [C]."""
+    zero = i64.I64(
+        hi=jnp.zeros_like(capacity.hi), lo=jnp.zeros_like(capacity.lo)
+    )
+    need_b = i64.I64(hi=need.hi[None, :], lo=need.lo[None, :])  # [1, R]
+    cap_b = i64.I64(hi=capacity.hi[None, :], lo=capacity.lo[None, :])
+    total = i64.add(used, need_b)  # [C, R]
+    need_neg = need.hi < 0  # [R]
+    cap_ok = cap_present & (i64.cmp(capacity, zero) == 1)  # [R]
+    used_neg = used.hi < 0  # [C, R]
+    # need >= 0 and used >= 0 here, so overflow <=> sum sign flipped negative
+    overflow = (~used_neg) & (total.hi < 0)
+    enough = i64.cmp(total, cap_b) <= 0
+    per_resource = (
+        (~need_neg[None, :])
+        & cap_ok[None, :]
+        & (~used_neg)
+        & (~overflow)
+        & enough
+    )
+    resource_ok = jnp.all(per_resource | ~need_active[None, :], axis=-1)  # [C]
+    return card_ok & resource_ok
+
+
+def _fit_one_node(
+    used: i64.I64,  # [C, R]
+    capacity: i64.I64,  # [R]
+    cap_present: jax.Array,  # [R]
+    card_ok: jax.Array,  # [C]
+    card_order: jax.Array,  # int32 [C]
+    request: BinpackRequest,
+    max_gpus: int,
+) -> tuple:
+    """runSchedulingLogic's card selection for one node
+    (scheduler.go:313-338 + 200-257): scan containers, scan GPU picks."""
+    num_cards = card_ok.shape[0]
+    card_iota = jnp.arange(num_cards, dtype=jnp.int32)
+    big_order = jnp.int32(2**30)
+
+    def per_container(carry, request_t):
+        used, ok = carry
+        need, need_active, num_gpus, active = request_t
+
+        def per_gpu(carry2, step):
+            used2, ok2 = carry2
+            fits = _card_fits(used2, need, need_active, capacity, cap_present, card_ok)
+            # first-fit = smallest card_order among fitting lanes
+            best_order = jnp.min(jnp.where(fits, card_order, big_order))
+            on_best = fits & (card_order == best_order)
+            chosen = jnp.min(jnp.where(on_best, card_iota, jnp.int32(num_cards)))
+            fitted = chosen < num_cards
+            wanted = active & (step < num_gpus)
+            book = wanted & fitted
+            sel = (card_iota == chosen) & book  # [C]
+            total = i64.add(
+                used2, i64.I64(hi=need.hi[None, :], lo=need.lo[None, :])
+            )
+            used2 = i64.select(sel[:, None], total, used2)
+            ok2 = ok2 & (fitted | ~wanted)
+            picked = jnp.where(book, chosen, NO_CARD)
+            return (used2, ok2), picked
+
+        (used, ok_inner), picks = jax.lax.scan(
+            per_gpu, (used, ok), jnp.arange(max_gpus, dtype=jnp.int32)
+        )
+        return (used, ok_inner), picks
+
+    (_, ok), all_picks = jax.lax.scan(
+        per_container,
+        (used, jnp.array(True)),
+        (request.need, request.need_active, request.num_gpus,
+         request.container_active),
+    )
+    return ok, all_picks  # [T, K]
+
+
+@partial(jax.jit, static_argnames=("max_gpus",))
+def binpack_kernel(
+    state: BinpackNodeState, request: BinpackRequest, max_gpus: int
+) -> BinpackResult:
+    """Fit ``request`` against every node at once (the batched Filter)."""
+    fits, cards = jax.vmap(
+        lambda used, cap, cap_p, ok, order: _fit_one_node(
+            used, cap, cap_p, ok, order, request, max_gpus
+        )
+    )(
+        state.used,
+        state.capacity,
+        state.cap_present,
+        state.card_valid & state.card_real,
+        state.card_order,
+    )
+    return BinpackResult(fits=fits, cards=cards)

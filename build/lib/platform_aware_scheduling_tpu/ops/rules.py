@@ -1,0 +1,76 @@
+"""Vectorized TAS rule evaluation: EvaluateRule over dense tensors.
+
+Host control: ``tas.strategies.core.evaluate_rule`` (exact semantics of
+reference pkg/strategies/core/operator.go:13-26).  Here a rule set is three
+aligned arrays — ``metric_row [R]`` (row index into the metric matrix),
+``op_id [R]``, ``target [R] (I64 milli-units)`` — and evaluation of all R
+rules over all N nodes is one fused compare/select pass on the
+``[M, N]`` metric matrix.  Violation semantics are OR-across-rules with a
+node only participating in a rule when it is present in that rule's metric
+map (reference pkg/strategies/dontschedule/strategy.go:25-44).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.ops import i64
+
+OP_LESS_THAN = 0
+OP_GREATER_THAN = 1
+OP_EQUALS = 2
+
+OP_IDS = {"LessThan": OP_LESS_THAN, "GreaterThan": OP_GREATER_THAN, "Equals": OP_EQUALS}
+
+
+class RuleSet(NamedTuple):
+    """Dense device form of ``[]TASPolicyRule`` (reference
+    pkg/telemetrypolicy/api/v1alpha1/types.go:31-40).  All arrays share
+    leading dim R (padded; ``active`` masks real rules)."""
+
+    metric_row: jax.Array  # int32 [R] — row in the metric matrix
+    op_id: jax.Array  # int32 [R]
+    target: i64.I64  # [R] milli-units
+    active: jax.Array  # bool [R]
+
+
+def rule_matches(value: i64.I64, op_id: jax.Array, target: i64.I64) -> jax.Array:
+    """``value <op> target`` elementwise; broadcastable.  The device analog
+    of evaluate_rule (operator.go:13-26)."""
+    sign = i64.cmp(value, target)
+    return jnp.where(
+        op_id == OP_LESS_THAN,
+        sign == -1,
+        jnp.where(op_id == OP_GREATER_THAN, sign == 1, sign == 0),
+    )
+
+
+def evaluate_rules(
+    metric_values: i64.I64,  # [M, N] milli-units
+    metric_present: jax.Array,  # bool [M, N] — node present in metric map
+    rules: RuleSet,  # R rules
+) -> jax.Array:
+    """Per-rule match mask ``[R, N]``: node n matches rule r iff the node is
+    present in rule r's metric and the compare holds."""
+    values = i64.I64(
+        hi=metric_values.hi[rules.metric_row], lo=metric_values.lo[rules.metric_row]
+    )  # [R, N]
+    present = metric_present[rules.metric_row]  # [R, N]
+    target = i64.I64(hi=rules.target.hi[:, None], lo=rules.target.lo[:, None])
+    matched = rule_matches(values, rules.op_id[:, None], target)
+    return matched & present & rules.active[:, None]
+
+
+def violated_nodes(
+    metric_values: i64.I64,
+    metric_present: jax.Array,
+    rules: RuleSet,
+) -> jax.Array:
+    """OR-of-rules violation mask ``[N]`` — the batched ``Violated`` of the
+    dontschedule/deschedule strategies (dontschedule/strategy.go:25-44,
+    deschedule/strategy.go:31-49; OR semantics per
+    telemetry-aware-scheduling/README.md:133)."""
+    return jnp.any(evaluate_rules(metric_values, metric_present, rules), axis=0)

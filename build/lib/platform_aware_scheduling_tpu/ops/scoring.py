@@ -1,0 +1,132 @@
+"""Ordinal Prioritize scoring: OrderedList + 10-rank as one sort pass.
+
+Reference hot loop (pkg/telemetryscheduler/telemetryscheduler.go:128-149):
+read one metric, intersect candidates with the metric map, sort by value
+(GreaterThan -> descending, LessThan -> ascending, otherwise input order,
+operator.go:30-42), then emit ``Score = 10 - rank`` (``:145`` — ordinal,
+goes negative past rank 10).
+
+Device version: one multi-key ``lax.sort`` over (key_hi, key_lo, index)
+where invalid lanes (not a candidate / absent from the metric map / padding)
+carry a +inf sentinel so they sort last; ranks come back via a scatter of
+iota through the sort permutation.  Ties break by node index — deterministic
+where the reference's unstable Go sort is arbitrary.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops.rules import (
+    OP_GREATER_THAN,
+    OP_LESS_THAN,
+    RuleSet,
+    violated_nodes,
+)
+
+
+class PrioritizeResult(NamedTuple):
+    scores: jax.Array  # int32 [N] — 10 - rank, valid lanes only
+    valid: jax.Array  # bool [N] — candidate ∩ metric-present
+    perm: jax.Array  # int32 [N] — node indices in rank order (valid first)
+    valid_count: jax.Array  # int32 scalar — number of valid lanes
+
+
+def _rank_keys(
+    value: i64.I64,  # [N] metric values, milli-units
+    valid: jax.Array,  # bool [N]
+    op_id: jax.Array,  # scalar int32
+    index: jax.Array,  # int32 [N] iota
+) -> i64.I64:
+    """Build the exact-int64 sort key for one rule's ordering.
+
+    GreaterThan: descending by value  -> key = flip(value)
+    LessThan:    ascending by value   -> key = value
+    other:       input (index) order  -> key = index   (operator.go:40-41)
+    Invalid lanes get INT64_MAX so they land after every valid lane; the
+    caller's tiebreak additionally orders valid lanes ahead of invalid ones
+    on key collision (flip(INT64_MIN) == INT64_MAX).
+    """
+    flipped = i64.flip(value)
+    by_value = i64.select(op_id == OP_GREATER_THAN, flipped, value)
+    index_key = i64.I64(hi=jnp.zeros_like(value.hi), lo=index.astype(jnp.uint32))
+    sorts_by_value = (op_id == OP_LESS_THAN) | (op_id == OP_GREATER_THAN)
+    key = i64.select(sorts_by_value, by_value, index_key)
+    return i64.select(valid, key, i64.full_like(key, i64.INT64_MAX))
+
+
+def ordinal_scores(
+    value: i64.I64,  # [N]
+    valid: jax.Array,  # bool [N]
+    op_id: jax.Array,  # scalar
+) -> PrioritizeResult:
+    """Scores for one scheduling rule over all (padded) nodes."""
+    n = value.hi.shape[-1]
+    index = jnp.arange(n, dtype=jnp.int32)
+    key = _rank_keys(value, valid, op_id, index)
+    # valid lanes win key ties against invalid sentinels; ties between valid
+    # lanes break by node index (deterministic where Go's sort is unstable)
+    tiebreak = jnp.where(valid, index, index + jnp.int32(n))
+    (perm,) = i64.sort_by_key(key, index, tiebreak=tiebreak)
+    ranks = jnp.zeros(n, dtype=jnp.int32).at[perm].set(index)
+    scores = jnp.int32(10) - ranks
+    return PrioritizeResult(
+        scores=scores,
+        valid=valid,
+        perm=perm,
+        valid_count=jnp.sum(valid).astype(jnp.int32),
+    )
+
+
+@partial(jax.jit, static_argnames=())
+def prioritize_kernel(
+    metric_values: i64.I64,  # [M, N]
+    metric_present: jax.Array,  # bool [M, N]
+    metric_row: jax.Array,  # scalar int32 — scheduleonmetric rule[0] metric
+    op_id: jax.Array,  # scalar int32
+    candidate_mask: jax.Array,  # bool [N]
+) -> PrioritizeResult:
+    """The full Prioritize verb for one pod (telemetryscheduler.go:128-149):
+    candidate ∩ metric-present intersection, ordering, ordinal scores."""
+    value = i64.I64(
+        hi=metric_values.hi[metric_row], lo=metric_values.lo[metric_row]
+    )
+    valid = candidate_mask & metric_present[metric_row]
+    return ordinal_scores(value, valid, op_id)
+
+
+@jax.jit
+def filter_kernel(
+    metric_values: i64.I64,  # [M, N]
+    metric_present: jax.Array,  # bool [M, N]
+    rules: RuleSet,
+    candidate_mask: jax.Array,  # bool [N]
+) -> jax.Array:
+    """The Filter verb for one pod (telemetryscheduler.go:184-225): a
+    candidate passes unless the dontschedule strategy marks it violating.
+    Violations are computed over *all* nodes (request-independent, cacheable
+    — noted at SURVEY §3.3) and intersected with the candidates here."""
+    violating = violated_nodes(metric_values, metric_present, rules)
+    return candidate_mask & ~violating
+
+
+@jax.jit
+def batch_prioritize_kernel(
+    metric_values: i64.I64,  # [M, N]
+    metric_present: jax.Array,  # bool [M, N]
+    metric_row: jax.Array,  # int32 [P] — per-pod rule metric
+    op_id: jax.Array,  # int32 [P]
+    candidate_mask: jax.Array,  # bool [P, N]
+) -> PrioritizeResult:
+    """All pending pods at once — the batched form the Go loop cannot do.
+    vmap over the pod axis; one XLA program scores P pods x N nodes."""
+    return jax.vmap(
+        lambda row, op, cand: prioritize_kernel(
+            metric_values, metric_present, row, op, cand
+        )
+    )(metric_row, op_id, candidate_mask)

@@ -1,0 +1,10 @@
+"""Multi-chip scaling: device meshes + sharded scheduling kernels.
+
+The reference scales only via ``nodeCacheCapable`` and informer caches
+(SURVEY §5.7); its cross-process backend is HTTP/JSON + k8s watches
+(§2a).  Here the scaling axis of the problem — the cluster node count —
+is sharded across a ``jax.sharding.Mesh``: the ``[metrics, nodes]`` state
+and the ``[pods, nodes]`` score grid split over the ``nodes`` mesh axis
+(pods over ``pods``), with XLA collectives (all_gather / psum over ICI,
+DCN across slices) doing what the reference's webhook fan-in cannot —
+one fused multi-chip scheduling solve."""

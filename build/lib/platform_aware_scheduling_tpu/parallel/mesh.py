@@ -1,0 +1,100 @@
+"""Mesh construction + sharding specs for the scheduling tensors.
+
+Axes:
+  * ``nodes`` — the cluster-node axis (the problem's scaling dimension;
+    the analog of sequence parallelism: candidate sets shard like tokens,
+    SURVEY §5.7);
+  * ``pods``  — the pending-pod axis (data-parallel-like).
+
+``pad_to_multiple`` keeps shard shapes static per bucket so XLA compiles
+once per bucket, not per cluster-size change.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+POD_AXIS = "pods"
+
+
+def make_mesh(
+    n_node_shards: Optional[int] = None,
+    n_pod_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if n_node_shards is None:
+        n_node_shards = len(devices) // n_pod_shards
+    grid = np.array(devices[: n_pod_shards * n_node_shards]).reshape(
+        n_pod_shards, n_node_shards
+    )
+    return Mesh(grid, (POD_AXIS, NODE_AXIS))
+
+
+def make_multislice_mesh(
+    n_pod_shards_per_slice: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh: the ``pods`` axis spans slices (DCN — the
+    infrequent, replicable axis) while ``nodes`` stays inside each slice
+    (ICI — where the rank/assign collectives live).  Uses
+    ``mesh_utils.create_hybrid_device_mesh`` when slice topology is
+    exposed; degenerates to :func:`make_mesh` on a single slice or CPU.
+    """
+    from jax.experimental import mesh_utils
+
+    from platform_aware_scheduling_tpu.utils import klog
+
+    devices = list(devices if devices is not None else jax.devices())
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    n_slices = max(len(slice_ids), 1)
+    per_slice = len(devices) // max(n_slices, 1)
+    uneven = len(devices) % n_slices != 0
+    indivisible = (
+        per_slice == 0 or per_slice % max(n_pod_shards_per_slice, 1) != 0
+    )
+    if n_slices <= 1 or uneven or indivisible:
+        if n_slices > 1:
+            klog.warning(
+                "multi-slice topology (%d slices x %d devices) does not "
+                "factor as (%d pods x nodes); using a flat mesh",
+                n_slices,
+                per_slice,
+                n_pod_shards_per_slice,
+            )
+        return make_mesh(n_pod_shards=n_pod_shards_per_slice, devices=devices)
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(n_pod_shards_per_slice, per_slice // n_pod_shards_per_slice),
+        dcn_mesh_shape=(n_slices, 1),
+        devices=devices,
+    )
+    return Mesh(grid, (POD_AXIS, NODE_AXIS))
+
+
+def node_sharded(mesh: Mesh) -> NamedSharding:
+    """[..., nodes] arrays: shard the trailing axis over ``nodes``."""
+    return NamedSharding(mesh, P(None, NODE_AXIS))
+
+
+def grid_sharded(mesh: Mesh) -> NamedSharding:
+    """[pods, nodes] arrays: shard both axes."""
+    return NamedSharding(mesh, P(POD_AXIS, NODE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: np.ndarray, axis: int, multiple: int, fill=0) -> np.ndarray:
+    size = arr.shape[axis]
+    target = ((size + multiple - 1) // multiple) * multiple
+    if target == size:
+        return arr
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, target - size)
+    return np.pad(arr, pad, constant_values=fill)

@@ -1,0 +1,333 @@
+"""Sharded scheduling kernels: shard_map over the ``nodes`` mesh axis.
+
+Three building blocks, each the multi-chip form of an ops/ kernel:
+
+  * :func:`sharded_violations` — rule evaluation is elementwise over nodes,
+    so the sharded form needs NO collectives at all: each chip filters its
+    node shard independently (the embarrassingly-parallel half);
+  * :func:`sharded_prioritize` — exact global ordinal ranks without a
+    global sort: all_gather the (tiny) score keys over ICI, then each chip
+    rank-by-counting its local lanes against the global key set —
+    rank_i = |{j : key_j < key_i or (key_j = key_i and j < i)}|,
+    identical to the single-chip sort's ranks;
+  * :func:`sharded_greedy_assign` — the sequential-in-pods greedy solve:
+    each step reduces a per-shard lexicographic argmin, all_gathers the
+    per-chip candidates (4 scalars per chip), and every chip deterministically
+    agrees on the winner; only the owning shard books the capacity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops.assign import UNASSIGNED
+from platform_aware_scheduling_tpu.ops.rules import (
+    OP_GREATER_THAN,
+    OP_LESS_THAN,
+    RuleSet,
+    violated_nodes,
+)
+from platform_aware_scheduling_tpu.parallel.mesh import NODE_AXIS, POD_AXIS
+
+
+def sharded_violations(mesh: Mesh, metric_values: i64.I64, metric_present, rules: RuleSet):
+    """dontschedule violation mask with the node axis sharded; pure local
+    compute (rule tensors replicated, metric matrix sharded on nodes)."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            i64.I64(hi=P(None, NODE_AXIS), lo=P(None, NODE_AXIS)),
+            P(None, NODE_AXIS),
+            RuleSet(metric_row=P(), op_id=P(),
+                    target=i64.I64(hi=P(), lo=P()), active=P()),
+        ),
+        out_specs=P(NODE_AXIS),
+    )
+    def _impl(values, present, ruleset):
+        return violated_nodes(values, present, ruleset)
+
+    return _impl(metric_values, metric_present, rules)
+
+
+def _rank_key(value: i64.I64, valid, op_id, index):
+    """Sort key for ranking (same construction as ops/scoring._rank_keys);
+    ``index`` must be the GLOBAL node index of each lane."""
+    flipped = i64.flip(value)
+    by_value = i64.select(op_id == OP_GREATER_THAN, flipped, value)
+    index_key = i64.I64(hi=jnp.zeros_like(value.hi), lo=index.astype(jnp.uint32))
+    sorts = (op_id == OP_LESS_THAN) | (op_id == OP_GREATER_THAN)
+    key = i64.select(sorts, by_value, index_key)
+    return i64.select(valid, key, i64.full_like(key, i64.INT64_MAX))
+
+
+def sharded_prioritize(mesh: Mesh, value: i64.I64, valid, op_id):
+    """Exact ordinal scores (10 - global rank) for a node-sharded metric
+    row.  One all_gather of the key limbs; ranks by counting."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            i64.I64(hi=P(NODE_AXIS), lo=P(NODE_AXIS)),
+            P(NODE_AXIS),
+            P(),
+        ),
+        out_specs=(P(NODE_AXIS), P(NODE_AXIS)),
+    )
+    def _impl(value_loc, valid_loc, op):
+        n_loc = value_loc.hi.shape[-1]
+        shard = jax.lax.axis_index(NODE_AXIS)
+        offset = (shard * n_loc).astype(jnp.int32)
+        local_idx = jnp.arange(n_loc, dtype=jnp.int32) + offset
+        key_loc = _rank_key(value_loc, valid_loc, op, local_idx)
+        # invalid lanes sort after valid ones on key collision: index + N
+        n_total = n_loc * jax.lax.axis_size(NODE_AXIS)
+        tie_loc = jnp.where(valid_loc, local_idx, local_idx + n_total)
+
+        g_hi = jax.lax.all_gather(key_loc.hi, NODE_AXIS, tiled=True)
+        g_lo = jax.lax.all_gather(key_loc.lo, NODE_AXIS, tiled=True)
+        g_tie = jax.lax.all_gather(tie_loc, NODE_AXIS, tiled=True)
+
+        gk = i64.I64(hi=g_hi[None, :], lo=g_lo[None, :])
+        lk = i64.I64(hi=key_loc.hi[:, None], lo=key_loc.lo[:, None])
+        cmp = i64.cmp(gk, lk)  # [n_loc, N]
+        before = (cmp == -1) | ((cmp == 0) & (g_tie[None, :] < tie_loc[:, None]))
+        ranks = jnp.sum(before, axis=-1, dtype=jnp.int32)
+        return jnp.int32(10) - ranks, valid_loc
+
+    return _impl(value, valid, op_id)
+
+
+def sharded_prioritize_ring(mesh: Mesh, value: i64.I64, valid, op_id):
+    """Ring-pass form of :func:`sharded_prioritize` — identical results.
+
+    Instead of all_gathering the full key set (O(N) memory per chip), each
+    chip's key block circulates the ring via ``ppermute`` while every chip
+    accumulates how many circulating keys rank before each of its local
+    lanes; after D hops the counts are exact global ranks.  This is the
+    ring-attention/sequence-parallel communication pattern (blockwise
+    compute overlapped with neighbor exchange over ICI) applied to the
+    node axis — the memory-scalable path for very large clusters.
+    """
+    n_shards = dict(zip(mesh.axis_names, mesh.devices.shape))[NODE_AXIS]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            i64.I64(hi=P(NODE_AXIS), lo=P(NODE_AXIS)),
+            P(NODE_AXIS),
+            P(),
+        ),
+        out_specs=(P(NODE_AXIS), P(NODE_AXIS)),
+    )
+    def _impl(value_loc, valid_loc, op):
+        n_loc = value_loc.hi.shape[-1]
+        shard = jax.lax.axis_index(NODE_AXIS)
+        offset = (shard * n_loc).astype(jnp.int32)
+        local_idx = jnp.arange(n_loc, dtype=jnp.int32) + offset
+        key_loc = _rank_key(value_loc, valid_loc, op, local_idx)
+        n_total = n_loc * n_shards
+        tie_loc = jnp.where(valid_loc, local_idx, local_idx + n_total)
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+        def hop(carry, _):
+            blk_hi, blk_lo, blk_tie, counts = carry
+            gk = i64.I64(hi=blk_hi[None, :], lo=blk_lo[None, :])
+            lk = i64.I64(hi=key_loc.hi[:, None], lo=key_loc.lo[:, None])
+            cmp = i64.cmp(gk, lk)  # [n_loc, n_loc]
+            before = (cmp == -1) | (
+                (cmp == 0) & (blk_tie[None, :] < tie_loc[:, None])
+            )
+            counts = counts + jnp.sum(before, axis=-1, dtype=jnp.int32)
+            blk_hi = jax.lax.ppermute(blk_hi, NODE_AXIS, perm)
+            blk_lo = jax.lax.ppermute(blk_lo, NODE_AXIS, perm)
+            blk_tie = jax.lax.ppermute(blk_tie, NODE_AXIS, perm)
+            return (blk_hi, blk_lo, blk_tie, counts), None
+
+        zero_counts = jax.lax.pcast(
+            jnp.zeros(n_loc, jnp.int32), (NODE_AXIS,), to="varying"
+        )
+        init = (key_loc.hi, key_loc.lo, tie_loc, zero_counts)
+        (_, _, _, ranks), _ = jax.lax.scan(hop, init, None, length=n_shards)
+        return jnp.int32(10) - ranks, valid_loc
+
+    return _impl(value, valid, op_id)
+
+
+def greedy_assign_collective_count(num_pods: int, block_size: int = 32) -> int:
+    """all_gathers :func:`sharded_greedy_assign` issues for ``num_pods``."""
+    padded = -(-num_pods // block_size) * block_size
+    return padded // block_size
+
+
+def sharded_greedy_assign(
+    mesh: Mesh, score: i64.I64, eligible, capacity, block_size: int = 32
+):
+    """Greedy batch assignment with the node axis sharded, chunked into
+    pod blocks: ONE all_gather per ``block_size`` pods instead of the
+    per-pod gather the round-2/3 verdicts flagged (1k sequential
+    collectives at target scale -> ~32).
+
+    Per block of B pods, each shard extracts its top-B local candidates
+    per pod (score order, block-start capacity attached), gathers the
+    [B, B, 5] payload once, and every chip deterministically REPLAYS the
+    block's greedy decisions from the merged candidate lists — bookings
+    within the block are counted against each candidate's block-start
+    capacity, so the replay reproduces the sequential solve exactly.
+
+    Top-B per shard suffices for exactness: making a shard's j-th best
+    candidate for some pod infeasible takes >= j bookings, and a block
+    books at most B-1 times before any pod's turn, so the block winner is
+    always within the shard's top-B (equality with the single-chip kernel
+    is pinned by tests/test_parallel.py at 1k pods x 8k nodes).
+    """
+    n_shards = dict(zip(mesh.axis_names, mesh.devices.shape))[NODE_AXIS]
+    num_pods = score.hi.shape[0]
+    padded = -(-num_pods // block_size) * block_size
+    pad = padded - num_pods
+    if pad:
+        # padding pods are ineligible everywhere -> UNASSIGNED, no effect
+        score = i64.I64(
+            hi=jnp.pad(score.hi, ((0, pad), (0, 0))),
+            lo=jnp.pad(score.lo, ((0, pad), (0, 0))),
+        )
+        eligible = jnp.pad(eligible, ((0, pad), (0, 0)))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            i64.I64(hi=P(None, NODE_AXIS), lo=P(None, NODE_AXIS)),
+            P(None, NODE_AXIS),
+            P(NODE_AXIS),
+        ),
+        out_specs=(P(), P(NODE_AXIS)),
+        # `assigned` is replicated by construction (every chip replays the
+        # same decision from the same gathered candidates); the static
+        # varying-axes check can't see that
+        check_vma=False,
+    )
+    def _impl(s, elig, cap):
+        n_loc = cap.shape[-1]
+        b_top = min(block_size, n_loc)
+        shard = jax.lax.axis_index(NODE_AXIS)
+        offset = (shard * n_loc).astype(jnp.int32)
+        big_hi = jnp.int32(2**31 - 1)
+        big_lo = jnp.uint32(2**32 - 1)
+        big_idx = jnp.int32(2**30)
+        iota_loc = jnp.arange(n_loc, dtype=jnp.int32)
+        num_blocks = padded // block_size
+        s_hi = s.hi.reshape(num_blocks, block_size, n_loc)
+        s_lo = s.lo.reshape(num_blocks, block_size, n_loc)
+        elig_b = elig.reshape(num_blocks, block_size, n_loc)
+
+        def block_step(cap, blk):
+            b_hi, b_lo, b_elig = blk
+            flipped = i64.flip(i64.I64(hi=b_hi, lo=b_lo))  # lex-min = best
+            avail = b_elig & (cap > 0)[None, :]  # [B, n_loc]
+
+            def extract(taken, _):
+                ok = avail & ~taken
+                hi = jnp.where(ok, flipped.hi, big_hi)
+                m_hi = jnp.min(hi, axis=-1, keepdims=True)
+                on_hi = ok & (flipped.hi == m_hi)
+                lo = jnp.where(on_hi, flipped.lo, big_lo)
+                m_lo = jnp.min(lo, axis=-1, keepdims=True)
+                on_lo = on_hi & (flipped.lo == m_lo)
+                pick = jnp.min(
+                    jnp.where(on_lo, iota_loc[None, :], jnp.int32(n_loc)),
+                    axis=-1,
+                )  # [B] local index (n_loc when none)
+                found = jnp.any(ok, axis=-1)  # [B]
+                safe = jnp.minimum(pick, jnp.int32(n_loc - 1))
+                row = jnp.arange(block_size, dtype=jnp.int32)
+                cand = jnp.stack(
+                    [
+                        jnp.where(found, flipped.hi[row, safe], big_hi),
+                        jnp.where(
+                            found,
+                            flipped.lo[row, safe],
+                            big_lo,
+                        ).astype(jnp.int32),
+                        jnp.where(found, safe + offset, big_idx),
+                        jnp.where(found, cap[safe], jnp.int32(0)),
+                        found.astype(jnp.int32),
+                    ],
+                    axis=-1,
+                )  # [B, 5]
+                taken = taken | (
+                    found[:, None] & (iota_loc[None, :] == safe[:, None])
+                )
+                return taken, cand
+
+            _, cands = jax.lax.scan(
+                extract,
+                jnp.zeros_like(avail),
+                None,
+                length=b_top,
+            )  # [b_top, B, 5]
+            payload = jnp.transpose(cands, (1, 0, 2))  # [B, b_top, 5]
+            gathered = jax.lax.all_gather(payload, NODE_AXIS)  # [D, B, b_top, 5]
+            merged = jnp.transpose(gathered, (1, 0, 2, 3)).reshape(
+                block_size, n_shards * b_top, 5
+            )
+            c_hi = merged[..., 0]
+            c_lo = merged[..., 1].astype(jnp.uint32)
+            c_idx = merged[..., 2]
+            c_cap = merged[..., 3]
+            c_valid = merged[..., 4] > 0
+
+            def replay(chosen, pod):
+                step_i, f_hi, f_lo, idx, cap0, valid = pod
+                booked = jnp.sum(
+                    (chosen[:, None] == idx[None, :]) & (chosen >= 0)[:, None],
+                    axis=0,
+                    dtype=jnp.int32,
+                )
+                feas = valid & (cap0 - booked > 0)
+                hi = jnp.where(feas, f_hi, big_hi)
+                m_hi = jnp.min(hi)
+                on_hi = feas & (f_hi == m_hi)
+                lo = jnp.where(on_hi, f_lo, big_lo)
+                m_lo = jnp.min(lo)
+                on_lo = on_hi & (f_lo == m_lo)
+                winner = jnp.min(jnp.where(on_lo, idx, big_idx))
+                choice = jnp.where(jnp.any(feas), winner, UNASSIGNED)
+                chosen = chosen.at[step_i].set(choice)
+                return chosen, choice
+
+            init = jnp.full(block_size, UNASSIGNED, dtype=jnp.int32)
+            _, choices = jax.lax.scan(
+                replay,
+                init,
+                (
+                    jnp.arange(block_size, dtype=jnp.int32),
+                    c_hi,
+                    c_lo,
+                    c_idx,
+                    c_cap,
+                    c_valid,
+                ),
+            )
+            mine = (choices >= offset) & (choices < offset + n_loc)
+            local = jnp.where(mine, choices - offset, jnp.int32(n_loc))
+            delta = jnp.sum(
+                jax.nn.one_hot(local, n_loc, dtype=cap.dtype), axis=0
+            )  # out-of-range rows are all-zero
+            return cap - delta, choices
+
+        cap_left, chosen = jax.lax.scan(block_step, cap, (s_hi, s_lo, elig_b))
+        return chosen.reshape(padded), cap_left
+
+    assigned, cap_left = _impl(score, eligible, capacity)
+    return assigned[:num_pods], cap_left

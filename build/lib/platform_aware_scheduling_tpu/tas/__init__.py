@@ -1,0 +1,6 @@
+"""Telemetry-Aware Scheduling (TAS): policy-driven filter/prioritize/deschedule
+on live platform telemetry from the custom-metrics API.
+
+Reference module: telemetry-aware-scheduling/ (survey §1 L2-L6).  The scoring
+hot loop is replaced by the batched JAX path in ``models/tas_model.py``.
+"""

@@ -1,0 +1,197 @@
+"""TAS state cache: policies + refcounted, self-updating metrics.
+
+Reference: telemetry-aware-scheduling/pkg/cache/.  The reference serializes
+all access through a single goroutine reading a request channel
+(cache.go:20-63); here the same observable semantics — serialized reads and
+writes, WRITE-with-nil-payload preserving the existing value (cache.go:52-57)
+— are provided by a mutex-guarded store (the idiomatic Python translation;
+there is no perf reason for channel hand-off since the hot path reads the
+tensorized mirror, not this cache).
+
+On top sits :class:`AutoUpdatingCache` (autoupdating.go:20-137): two
+keyspaces ``policies/<ns>/<name>`` and ``metrics/<metric>``, a refcount map
+so a metric shared by several policies is only evicted when the last one is
+deleted, and ``periodic_update`` re-fetching every registered metric each
+sync period.  Mutation listeners let the device-tensor mirror
+(models/state.py) track changes without polling.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from platform_aware_scheduling_tpu.tas.metrics import Client, NodeMetricsInfo
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.utils import klog
+
+POLICY_PATH = "policies/{}/{}"
+METRIC_PATH = "metrics/{}"
+
+
+class CacheMissError(KeyError):
+    pass
+
+
+class _SerializedStore:
+    """Serialized KV with the reference's write-nil-preserves rule."""
+
+    def __init__(self):
+        self._data: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def add(self, key: str, payload: Any) -> None:
+        with self._lock:
+            if payload is None and key in self._data:
+                return  # nil write preserves existing value (cache.go:52-57)
+            self._data[key] = payload
+
+    def read(self, key: str) -> Any:
+        with self._lock:
+            return self._data.get(key)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+
+class AutoUpdatingCache:
+    """Reader/Writer/SelfUpdating cache (reference pkg/cache/types.go)."""
+
+    def __init__(self):
+        self._store = _SerializedStore()
+        self._metric_refcounts: Dict[str, int] = {}
+        self._mtx = threading.Lock()
+        # held across store mutation + hook delivery so mirror subscribers
+        # observe mutations in store order (the reference gets this from its
+        # single cache goroutine, cache.go:43-63)
+        self._mutation_lock = threading.RLock()
+        # mirror hooks: fired after a successful mutation
+        self.on_metric_write: List[Callable[[str, Optional[NodeMetricsInfo]], None]] = []
+        self.on_metric_delete: List[Callable[[str], None]] = []
+        self.on_policy_write: List[Callable[[str, str, TASPolicy], None]] = []
+        self.on_policy_delete: List[Callable[[str, str], None]] = []
+
+    # -- Reader ---------------------------------------------------------------
+
+    def read_metric(self, metric_name: str) -> NodeMetricsInfo:
+        value = self._store.read(METRIC_PATH.format(metric_name))
+        if isinstance(value, dict) and value:
+            return value
+        raise CacheMissError(f"no metric {metric_name} found")
+
+    def read_policy(self, namespace: str, policy_name: str) -> TASPolicy:
+        value = self._store.read(POLICY_PATH.format(namespace, policy_name))
+        if isinstance(value, TASPolicy):
+            return value
+        raise CacheMissError(f"no policy {policy_name} found")
+
+    # -- Writer ---------------------------------------------------------------
+
+    def write_policy(self, namespace: str, policy_name: str, policy: TASPolicy) -> None:
+        with self._mutation_lock:
+            self._store.add(POLICY_PATH.format(namespace, policy_name), policy)
+            for hook in self.on_policy_write:
+                hook(namespace, policy_name, policy)
+
+    def write_metric(
+        self, metric_name: str, data: Optional[NodeMetricsInfo] = None
+    ) -> None:
+        """Empty/None data registers the metric (incrementing its refcount)
+        without clobbering current values (autoupdating.go:105-122)."""
+        payload = data if data else None
+        with self._mutation_lock:
+            self._store.add(METRIC_PATH.format(metric_name), payload)
+            if payload is None:
+                with self._mtx:
+                    self._metric_refcounts[metric_name] = (
+                        self._metric_refcounts.get(metric_name, 0) + 1
+                    )
+            for hook in self.on_metric_write:
+                hook(metric_name, payload)
+
+    def delete_policy(self, namespace: str, policy_name: str) -> None:
+        klog.v(2).info_s(
+            "deleting " + POLICY_PATH.format(namespace, policy_name),
+            component="controller",
+        )
+        with self._mutation_lock:
+            self._store.delete(POLICY_PATH.format(namespace, policy_name))
+            for hook in self.on_policy_delete:
+                hook(namespace, policy_name)
+
+    def delete_metric(self, metric_name: str) -> None:
+        """Refcounted delete: evicted only when the last registered policy
+        using it is removed (autoupdating.go:124-137)."""
+        with self._mutation_lock:
+            evicted = False
+            with self._mtx:
+                total = self._metric_refcounts.get(metric_name)
+                if total == 1:
+                    del self._metric_refcounts[metric_name]
+                    self._store.delete(METRIC_PATH.format(metric_name))
+                    evicted = True
+                elif total is not None:
+                    self._metric_refcounts[metric_name] = total - 1
+                else:
+                    self._metric_refcounts[metric_name] = -1
+            if evicted:
+                for hook in self.on_metric_delete:
+                    hook(metric_name)
+
+    # -- SelfUpdating -----------------------------------------------------------
+
+    def registered_metric_names(self) -> List[str]:
+        with self._mtx:
+            return [name for name in self._metric_refcounts if name]
+
+    def update_all_metrics(self, client: Client) -> None:
+        with self._mtx:
+            names = list(self._metric_refcounts)
+        for name in names:
+            if not name:
+                with self._mtx:
+                    self._metric_refcounts.pop(name, None)
+                continue
+            try:
+                self._update_metric(client, name)
+            except Exception as exc:
+                klog.v(2).info_s(str(exc), component="controller")
+
+    def _update_metric(self, client: Client, metric_name: str) -> None:
+        info = client.get_node_metric(metric_name)
+        self.write_metric(metric_name, info)
+
+    def periodic_update(
+        self,
+        period_seconds: float,
+        client: Client,
+        initial_data: Optional[Dict[str, Any]] = None,
+        stop: Optional[threading.Event] = None,
+    ) -> None:
+        """Refresh every registered metric each period until ``stop`` is set
+        (autoupdating.go:37-43: update first, then wait the tick)."""
+        for key, value in (initial_data or {}).items():
+            self._store.add(key, value)
+        stop = stop or threading.Event()
+        while not stop.is_set():
+            self.update_all_metrics(client)
+            stop.wait(period_seconds)
+
+    def start_periodic_update(
+        self,
+        period_seconds: float,
+        client: Client,
+        initial_data: Optional[Dict[str, Any]] = None,
+        stop: Optional[threading.Event] = None,
+    ) -> threading.Event:
+        """Run :meth:`periodic_update` on a daemon thread; returns the stop
+        event (caller-supplied ``stop`` is used when given)."""
+        stop = stop or threading.Event()
+        thread = threading.Thread(
+            target=self.periodic_update,
+            args=(period_seconds, client, initial_data, stop),
+            daemon=True,
+        )
+        thread.start()
+        return stop

@@ -1,0 +1,435 @@
+"""Per-request fast path for the Prioritize/Filter verbs.
+
+The reference re-sorts per HTTP request (telemetryscheduler.go:128-149).
+But the ordering is *request-independent*: for one (metric, operator) the
+rank order over all nodes is fixed until the cluster state changes, and a
+request's answer is exactly the global order restricted to its candidate
+set (the sort key — metric value with node-index tiebreak, ops/scoring.py
+— does not depend on which candidates are present).  Same for Filter's
+violation set (noted request-independent at SURVEY §3.3).
+
+So the device work moves OFF the request path entirely:
+
+  * on a state-version change, ``prioritize_kernel`` ranks ALL nodes in
+    one XLA pass per (metric row, op) in use — amortized over every
+    request in the sync window (the reference recomputes per request);
+  * a request then costs: candidate-row lookup (dict), a vectorized
+    subsequence selection (numpy), and JSON assembly from per-node byte
+    fragments pre-rendered at view-build time.
+
+No host↔device round trip, no sort, no per-node Python objects at
+request time — this is what makes p99 at 10k nodes flat.
+
+Byte-for-byte output parity with ``encode_host_priority_list`` over the
+equivalent HostPriority list is covered by tests/test_fastpath.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.ops.scoring import (
+    filter_kernel,
+    prioritize_kernel,
+)
+from platform_aware_scheduling_tpu.ops.state import CompiledPolicy, DeviceView
+
+# rank -> b'<score>}' suffix bytes; grown on demand (scores are ordinal
+# 10 - rank and go negative past rank 10, telemetryscheduler.go:145)
+_SCORE_SUFFIX: List[bytes] = []
+_SCORE_LOCK = threading.Lock()
+
+
+def _score_suffixes(n: int) -> List[bytes]:
+    if len(_SCORE_SUFFIX) < n:
+        with _SCORE_LOCK:
+            for i in range(len(_SCORE_SUFFIX), n):
+                _SCORE_SUFFIX.append(f"{10 - i}}}".encode())
+    return _SCORE_SUFFIX
+
+
+def _response_cache_size(default: int = 32) -> int:
+    """PAS_TPU_RESPONSE_CACHE, validated: malformed or non-positive
+    values fall back to the default rather than crashing the import or
+    silently disabling the caches via negative slice bounds."""
+    raw = os.environ.get("PAS_TPU_RESPONSE_CACHE", "")
+    try:
+        value = int(raw)
+    except ValueError:
+        return default
+    return value if value >= 1 else default
+
+
+class _ViewTable:
+    """Per-interning-version request-time tables: name->row index,
+    pre-rendered JSON fragments (Python path), and the native NameTable
+    (_wirec path).  Keyed by the view's ``intern_version`` — pure metric
+    value churn does not invalidate name tables/fragments, so the encode
+    table survives every sync period until a new node actually appears.
+    Both table kinds build lazily — only the serving variant in use pays."""
+
+    __slots__ = (
+        "version",
+        "node_index",
+        "node_names",
+        "node_capacity",
+        "_fragments",
+        "_native",
+    )
+
+    def __init__(self, view: DeviceView):
+        self.version = view.intern_version
+        self.node_index = view.node_index  # immutable snapshot dict
+        self.node_names = view.node_names
+        self.node_capacity = view.node_capacity
+        self._fragments: Optional[List[bytes]] = None
+        self._native = None
+
+    @property
+    def fragments(self) -> List[bytes]:
+        fragments = self._fragments
+        if fragments is None:
+            # json.dumps handles any escaping exactly like the slow path
+            fragments = [
+                f'{{"Host": {json.dumps(name)}, "Score": '.encode()
+                for name in self.node_names
+            ]
+            self._fragments = fragments
+        return fragments
+
+    def native(self, wirec):
+        table = self._native
+        if table is None:
+            table = wirec.build_table(self.node_names)
+            self._native = table
+        return table
+
+
+class PrioritizeFastPath:
+    """Caches global rankings + violation sets per state version and
+    answers verbs with numpy selections over them."""
+
+    # response-reuse entries kept per fastpath (each ~ request span +
+    # response bytes — ~0.5 MB at 10k nodes, so the default 32 costs at
+    # most ~17 MB per verb).  The round-3 verdict flagged 8 as thrashable
+    # by more than 8 interleaved candidate sets; override via
+    # PAS_TPU_RESPONSE_CACHE for constrained deployments.
+    RESPONSE_CACHE_SIZE = _response_cache_size()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Optional[_ViewTable] = None
+        # (row_content_version, metric_row, op) -> int64 np global order
+        self._rank: Dict[Tuple[int, int, int], np.ndarray] = {}
+        # (row-version tuple, rows, ruleset tensors) -> frozenset of
+        # violating row indices
+        self._violations: Dict[Tuple, frozenset] = {}
+        # response-reuse cache: the kube-scheduler prioritizes every
+        # pending pod against the same filter result, so consecutive
+        # requests carry byte-identical candidate lists; entries are keyed
+        # by (ranking identity, table identity, planned row) and VERIFIED
+        # by comparing the raw candidate-span bytes — identical span +
+        # identical ranking implies a byte-identical response, with zero
+        # false positives (no hashing trust).  List of
+        # [ranked, table, planned_row, span_bytes, response], MRU first.
+        self._responses: List[list] = []
+        # same idea for Filter: [violation_set, use_nn, span_bytes, body]
+        self._filter_responses: List[list] = []
+        # violation frozenset -> uint8-per-row bitmask bytes for the
+        # native filter_encode; keyed by OBJECT identity (sets are
+        # identity-stable per state) with the set itself held in the
+        # entry so an id can never alias a collected set
+        self._viol_masks: List[list] = []
+
+    # -- table/cache maintenance ----------------------------------------------
+
+    def _table_for(self, view: DeviceView) -> _ViewTable:
+        """The encode table for this view's interning.  Forward-only: a
+        stale in-flight request (view older than the installed table) gets
+        a throwaway table and must never displace the warmed current one
+        — otherwise one slow request would make the next request pay the
+        rebuild the warmer already did."""
+        table = self._table
+        if table is not None and table.version == view.intern_version:
+            return table
+        if table is not None and view.intern_version < table.version:
+            return _ViewTable(view)
+        with self._lock:
+            current = self._table
+            if current is None or current.version < view.intern_version:
+                current = _ViewTable(view)
+                self._table = current
+            elif current.version > view.intern_version:  # raced past us
+                return _ViewTable(view)
+            return current
+
+    def _ranking(self, view: DeviceView, row: int, op: int) -> np.ndarray:
+        # keyed by the ROW's content version: metric churn on other rows
+        # (or node interning alone) leaves this ranking valid
+        key = (view.row_version(row), row, op)
+        ranked = self._rank.get(key)
+        if ranked is None:
+            # ONE device pass ranks all nodes; every request until this
+            # row's next content change reuses it
+            res = prioritize_kernel(
+                view.values,
+                view.present,
+                jnp.int32(row),
+                jnp.int32(op),
+                jnp.ones(view.node_capacity, dtype=bool),
+            )
+            count = int(res.valid_count)
+            ranked = np.asarray(res.perm)[:count].astype(np.int64)
+            with self._lock:
+                self._rank[key] = ranked
+        return ranked
+
+    def precompute(self, view: DeviceView, pairs, wirec=None) -> None:
+        """Warm the request-time state for (metric_row, op) pairs: the
+        ranking cache (one device pass per pair whose row actually
+        changed), plus the response table for whichever encoder will serve
+        (native NameTable when ``wirec`` is given, fragments otherwise).
+
+        Called from state-refresh threads via the mirror's post-publish
+        hook (TensorStateMirror.on_state_change) so steady-state requests
+        never pay a device pass or a table build.  Also prunes cache
+        entries whose row content (or interning) has moved on."""
+        table = self._table_for(view)
+        if wirec is not None:
+            table.native(wirec)
+        else:
+            table.fragments
+        for row, op in pairs:
+            self._ranking(view, int(row), int(op))
+        with self._lock:
+            self._rank = {
+                k: v
+                for k, v in self._rank.items()
+                if k[0] == view.row_version(k[1])
+            }
+            self._violations = {
+                k: v
+                for k, v in self._violations.items()
+                if k[0] == tuple(view.row_version(r) for r in k[1])
+            }
+
+    # -- prioritize ------------------------------------------------------------
+
+    def prioritize_parsed(
+        self,
+        wirec,
+        compiled: CompiledPolicy,
+        view: DeviceView,
+        parsed,
+        planned: Optional[str] = None,
+        use_node_names: bool = False,
+    ) -> bytes:
+        """Native variant: candidate lookup + selection + byte assembly all
+        happen in ``_wirec.select_encode`` over the parsed body's zero-copy
+        name slices — no per-node Python objects at any point.  When the
+        request's raw candidate span matches a cached one under the same
+        ranking/table/plan, the stored response is returned without any
+        selection or encoding at all (see _responses)."""
+        table = self._table_for(view)
+        ranked = self._ranking(
+            view, compiled.scheduleonmetric_row, compiled.scheduleonmetric_op
+        )
+        planned_row = -1
+        if planned is not None:
+            planned_row = table.node_index.get(planned, -1)
+        with self._lock:
+            responses = self._responses
+            for idx, entry in enumerate(responses):
+                if (
+                    entry[0] is ranked
+                    and entry[1] is table
+                    and entry[2] == planned_row
+                    and parsed.span_matches(use_node_names, entry[3])
+                ):
+                    if idx:  # move to front (MRU)
+                        responses.insert(0, responses.pop(idx))
+                    return entry[4]
+        response = wirec.select_encode(
+            parsed, table.native(wirec), ranked, planned_row, use_node_names
+        )
+        span = (
+            parsed.node_names_span() if use_node_names else parsed.nodes_span()
+        )
+        if span is not None:
+            entry = [ranked, table, planned_row, span, response]
+            with self._lock:
+                self._responses.insert(0, entry)
+                del self._responses[self.RESPONSE_CACHE_SIZE :]
+        return response
+
+    def prioritize_bytes(
+        self,
+        compiled: CompiledPolicy,
+        view: DeviceView,
+        names: List[str],
+        planned: Optional[str] = None,
+    ) -> bytes:
+        """The full Prioritize response body for one request: global order
+        restricted to ``names`` (candidate ∩ metric-present), ordinal
+        scores, optional batch-plan promotion to rank 1."""
+        table = self._table_for(view)
+        ranked = self._ranking(
+            view, compiled.scheduleonmetric_row, compiled.scheduleonmetric_op
+        )
+        index = table.node_index
+        sentinel = table.node_capacity
+        mask = np.zeros(sentinel + 1, dtype=bool)
+        rows = np.fromiter(
+            (index.get(n, sentinel) for n in names),
+            dtype=np.int64,
+            count=len(names),
+        )
+        mask[rows] = True
+        mask[sentinel] = False
+        sel = ranked[mask[ranked]]
+        if planned is not None:
+            prow = index.get(planned)
+            if prow is not None:
+                at = np.nonzero(sel == prow)[0]
+                if at.size:
+                    sel = np.concatenate(([prow], np.delete(sel, at[0])))
+        return self._encode(table, sel)
+
+    @staticmethod
+    def _encode(table: _ViewTable, sel: np.ndarray) -> bytes:
+        if sel.size == 0:
+            return b"[]\n"
+        fragments = table.fragments
+        suffix = _score_suffixes(sel.size)
+        parts = [fragments[r] + suffix[i] for i, r in enumerate(sel.tolist())]
+        return b"[" + b", ".join(parts) + b"]\n"
+
+    # -- filter ----------------------------------------------------------------
+
+    def violating_names(
+        self, compiled: CompiledPolicy, view: DeviceView
+    ) -> Optional[Dict[str, None]]:
+        """The dontschedule violation set over all nodes, cached per rule
+        rows' content versions (request-independent, SURVEY §3.3); None
+        when the policy has no device-evaluable dontschedule rules."""
+        cached = self.violation_set(compiled, view)
+        if cached is None:
+            return None
+        # resolve rows back to names through the view (rows past the interned
+        # range are padding and never violate real nodes)
+        return {
+            view.node_names[i]: None
+            for i in cached
+            if i < len(view.node_names)
+        }
+
+    def violation_set(
+        self, compiled: CompiledPolicy, view: DeviceView
+    ) -> Optional[frozenset]:
+        """Identity-stable violating-row frozenset for this policy at this
+        state — the Filter response cache keys on the OBJECT identity, so
+        a state change (new frozenset) can never serve stale bytes."""
+        rules = compiled.dontschedule
+        if rules is None:
+            return None
+        # keyed by the rule rows' content versions (not the global state
+        # version): churn on unrelated metrics keeps this set warm
+        rule_rows = tuple(int(r) for r in rules.metric_rows[rules.active])
+        sig = (
+            tuple(view.row_version(r) for r in rule_rows),
+            rule_rows,
+            rules.op_ids.tobytes(),
+            rules.targets.tobytes(),
+            rules.active.tobytes(),
+        )
+        cached = self._violations.get(sig)
+        if cached is None:
+            device_rules = compiled.device_rules("dontschedule")
+            if device_rules is None:
+                return None
+            passing = filter_kernel(
+                view.values,
+                view.present,
+                device_rules,
+                jnp.ones(view.node_capacity, dtype=bool),
+            )
+            bad = ~np.asarray(passing)
+            cached = frozenset(int(i) for i in np.nonzero(bad)[0])
+            with self._lock:
+                self._violations[sig] = cached
+        return cached
+
+    def _violation_mask(self, violations: frozenset, n_rows: int) -> bytes:
+        """uint8-per-row bitmask form of a violation frozenset (the shape
+        ``_wirec.filter_encode`` consumes); cached per set identity."""
+        with self._lock:
+            for idx, entry in enumerate(self._viol_masks):
+                if entry[0] is violations and entry[1] == n_rows:
+                    if idx:
+                        self._viol_masks.insert(0, self._viol_masks.pop(idx))
+                    return entry[2]
+        mask = np.zeros(n_rows, dtype=np.uint8)
+        if violations:
+            rows = np.fromiter(
+                (i for i in violations if i < n_rows), dtype=np.int64
+            )
+            if rows.size:
+                mask[rows] = 1
+        mask_bytes = mask.tobytes()
+        with self._lock:
+            self._viol_masks.insert(0, [violations, n_rows, mask_bytes])
+            del self._viol_masks[self.RESPONSE_CACHE_SIZE :]
+        return mask_bytes
+
+    def filter_parsed(
+        self, wirec, view: DeviceView, parsed, violations: frozenset
+    ) -> bytes:
+        """Native NodeNames-mode Filter response: candidate row lookup,
+        violation partition, and byte assembly all happen in
+        ``_wirec.filter_encode`` over the parsed body's zero-copy name
+        slices — the Filter analog of :meth:`prioritize_parsed` (byte
+        parity with the exact path pinned by tests/test_wirec.py)."""
+        table = self._table_for(view)
+        mask = self._violation_mask(violations, len(table.node_names))
+        return wirec.filter_encode(parsed, table.native(wirec), mask)
+
+    # -- filter response reuse -------------------------------------------------
+
+    def filter_lookup(
+        self, violations: frozenset, use_node_names: bool, parsed
+    ) -> Optional[bytes]:
+        """Cached Filter response bytes for this exact candidate span under
+        this exact violation set, or None."""
+        with self._lock:
+            responses = self._filter_responses
+            for idx, entry in enumerate(responses):
+                if (
+                    entry[0] is violations
+                    and entry[1] == use_node_names
+                    and parsed.span_matches(use_node_names, entry[2])
+                ):
+                    if idx:
+                        responses.insert(0, responses.pop(idx))
+                    return entry[3]
+        return None
+
+    def filter_store(
+        self, violations: frozenset, use_node_names: bool, parsed, body: bytes
+    ) -> None:
+        span = (
+            parsed.node_names_span() if use_node_names else parsed.nodes_span()
+        )
+        if span is None:
+            return
+        with self._lock:
+            self._filter_responses.insert(
+                0, [violations, use_node_names, span, body]
+            )
+            del self._filter_responses[self.RESPONSE_CACHE_SIZE :]

@@ -1,0 +1,98 @@
+"""Node-metric ingestion from the custom-metrics API.
+
+Reference: telemetry-aware-scheduling/pkg/metrics/client.go.  ``NodeMetric``
+carries timestamp / window / value (client.go:25-32); ``get_node_metric``
+queries root-scoped Node metrics with empty selectors (client.go:51-61) and
+``wrap_metrics`` converts the MetricValueList with a default 60 s window
+(client.go:64-78).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Protocol
+
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+
+@dataclass
+class NodeMetric:
+    """One piece of telemetry for one node."""
+
+    value: Quantity
+    timestamp: str = ""
+    window_seconds: float = 60.0
+
+
+# node name -> NodeMetric (reference client.go:34-35)
+NodeMetricsInfo = Dict[str, NodeMetric]
+
+
+class MetricsError(Exception):
+    pass
+
+
+class Client(Protocol):
+    """Knows how to fetch one named metric for every node
+    (reference client.go:20-22)."""
+
+    def get_node_metric(self, metric_name: str) -> NodeMetricsInfo: ...
+
+
+def wrap_metrics(metric_value_list: Dict[str, Any]) -> NodeMetricsInfo:
+    """MetricValueList -> NodeMetricsInfo (reference client.go:64-78);
+    default window one minute when windowSeconds is absent."""
+    result: NodeMetricsInfo = {}
+    for item in metric_value_list.get("items") or []:
+        window = item.get("windowSeconds")
+        result[(item.get("describedObject") or {}).get("name", "")] = NodeMetric(
+            value=Quantity(str(item.get("value", "0"))),
+            timestamp=item.get("timestamp", ""),
+            window_seconds=float(window) if window is not None else 60.0,
+        )
+    return result
+
+
+class CustomMetricsClient:
+    """Live client over the kube custom-metrics API
+    (reference client.go:38-61)."""
+
+    def __init__(self, kube_client):
+        self._kube = kube_client
+
+    def get_node_metric(self, metric_name: str) -> NodeMetricsInfo:
+        try:
+            value_list = self._kube.get_node_custom_metric(metric_name)
+        except Exception as exc:
+            raise MetricsError(
+                "unable to fetch metrics from custom metrics API: " + str(exc)
+            ) from exc
+        if not (value_list.get("items") or []):
+            raise MetricsError("no metrics returned from custom metrics API")
+        return wrap_metrics(value_list)
+
+
+class DummyMetricsClient:
+    """Canned metrics client (the reference's test fake,
+    pkg/metrics/mocks.go:40-75)."""
+
+    def __init__(self, store: Dict[str, NodeMetricsInfo] | None = None):
+        self.store: Dict[str, NodeMetricsInfo] = store if store is not None else {}
+
+    def get_node_metric(self, metric_name: str) -> NodeMetricsInfo:
+        if metric_name not in self.store:
+            raise MetricsError(f"no metric {metric_name} found")
+        return dict(self.store[metric_name])
+
+
+def instance_of_mock_metric_client_map(
+    metric_name: str = "dummyMetric1",
+) -> Dict[str, NodeMetricsInfo]:
+    """Pre-seeded per-node metric vectors in the spirit of the reference's
+    ``InstanceOfMockMetricClientMap`` / ``TestNodeMetricCustomInfo``."""
+    return {
+        metric_name: {
+            "node A": NodeMetric(value=Quantity("100")),
+            "node B": NodeMetric(value=Quantity("200")),
+        }
+    }

@@ -1,0 +1,375 @@
+"""Batch planner: the whole-pending-set solve wired into the service.
+
+SURVEY §7 step 4's product form.  kube-scheduler's protocol is one pod
+per round-trip; the planner watches pending pods carrying the
+``telemetry-policy`` label, solves the ENTIRE set each sync period with
+``models/batch_scheduler.scheduling_step``, and lets the per-pod verbs be
+answered from the precomputed solution: when Prioritize arrives for a
+planned pod, its batch-assigned node gets the top score, steering the
+sequential scheduler onto the coordinated plan (capacity-aware placement
+the per-pod ordinal scores alone cannot express).
+
+OPT-IN (``--batchPlanner`` on cmd/tas.py): with the planner off the verbs
+behave exactly like the reference.  Planner answers degrade gracefully:
+unknown pod / stale plan / no assignment -> the ordinary per-request path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.kube.objects import Pod, object_key
+from platform_aware_scheduling_tpu.models.batch_scheduler import (
+    ClusterState,
+    PendingPods,
+    scheduling_step,
+    score_and_filter,
+)
+from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops.rules import OP_IDS, RuleSet
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+TAS_POLICY_LABEL = "telemetry-policy"
+DEFAULT_NODE_CAPACITY = 110  # kubelet's default max pods per node
+
+
+class _InformerGroup:
+    """Stop-handle over the planner's pod + node informers."""
+
+    def __init__(self, *informers):
+        self._informers = informers
+
+    def stop(self) -> None:
+        for informer in self._informers:
+            informer.stop()
+
+    def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
+        return all(i.wait_for_cache_sync(timeout) for i in self._informers)
+
+
+class BatchPlanner:
+    """Maintains the batch solution over the current pending set."""
+
+    def __init__(
+        self,
+        cache: AutoUpdatingCache,
+        mirror: TensorStateMirror,
+        node_capacity: int = DEFAULT_NODE_CAPACITY,
+        solver: str = "greedy",
+    ):
+        """``solver``: "greedy" reproduces what the sequential scheduler
+        would do; "sinkhorn" globally coordinates the batch
+        (ops/sinkhorn.py) — strictly an enhancement over the reference.
+
+        ``node_capacity`` is only the fallback for nodes whose allocatable
+        pod count hasn't been observed; observed nodes use
+        ``allocatable.pods - bound pods`` (kube-scheduler's own NodePods
+        predicate semantics), fed by :meth:`node_changed` /
+        :meth:`pod_observed` (wired to informers by :meth:`watch`)."""
+        self.cache = cache
+        self.mirror = mirror
+        self.node_capacity = node_capacity
+        self.solver = solver
+        self._lock = threading.Lock()
+        self._pending: Dict[str, Pod] = {}
+        # pod key -> (assigned node name, mirror version it was solved at)
+        self._plan: Dict[str, Tuple[str, int]] = {}
+        self._plan_version = -1
+        # cluster capacity state: allocatable pods per node + bound pods
+        self._cap_lock = threading.Lock()
+        self._node_alloc: Dict[str, int] = {}
+        self._bound_pods: Dict[str, str] = {}  # pod key -> node name
+        self._bound_counts: Dict[str, int] = {}
+
+    # -- pending-set maintenance ----------------------------------------------
+
+    def pod_added(self, pod: Pod) -> None:
+        if pod.spec_node_name or TAS_POLICY_LABEL not in pod.get_labels():
+            return
+        with self._lock:
+            self._pending[object_key(pod)] = pod
+
+    def pod_removed(self, pod: Pod) -> None:
+        with self._lock:
+            self._pending.pop(object_key(pod), None)
+            self._plan.pop(object_key(pod), None)
+
+    def pod_bound(self, pod: Pod) -> None:
+        self.pod_removed(pod)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- cluster capacity feed ---------------------------------------------------
+
+    def node_changed(self, node, deleted: bool = False) -> None:
+        """Track a node's allocatable pod slots (``status.allocatable.pods``)."""
+        with self._cap_lock:
+            if deleted:
+                self._node_alloc.pop(node.name, None)
+                return
+            pods = node.allocatable.get("pods")
+            if pods is None:
+                self._node_alloc.pop(node.name, None)
+            else:
+                try:
+                    alloc, _exact = Quantity(str(pods)).as_int64()
+                    self._node_alloc[node.name] = int(alloc)
+                except Exception:
+                    self._node_alloc.pop(node.name, None)
+
+    def pod_observed(self, pod: Pod, deleted: bool = False) -> None:
+        """Track every pod's binding so per-node remaining capacity is
+        allocatable − bound (terminated pods free their slot)."""
+        key = object_key(pod)
+        node = pod.spec_node_name
+        active = (
+            not deleted and node and pod.phase not in ("Succeeded", "Failed")
+        )
+        with self._cap_lock:
+            prev = self._bound_pods.pop(key, None)
+            if prev is not None:
+                remaining = self._bound_counts.get(prev, 1) - 1
+                if remaining > 0:
+                    self._bound_counts[prev] = remaining
+                else:
+                    self._bound_counts.pop(prev, None)
+            if active:
+                self._bound_pods[key] = node
+                self._bound_counts[node] = self._bound_counts.get(node, 0) + 1
+
+    def _remaining_capacity(self, view) -> np.ndarray:
+        """int32 [node_capacity] remaining pod slots per interned node —
+        observed nodes use allocatable − bound, unknown nodes fall back to
+        the kubelet default (the plan systematically overcommitted hot
+        nodes when this was a constant — VERDICT r1)."""
+        cap = np.full(view.node_capacity, self.node_capacity, dtype=np.int64)
+        with self._cap_lock:
+            alloc = dict(self._node_alloc)
+            counts = dict(self._bound_counts)
+        for name, idx in view.node_index.items():
+            if idx < cap.shape[0]:
+                a = alloc.get(name, self.node_capacity)
+                cap[idx] = a - counts.get(name, 0)
+        return np.clip(cap, 0, np.iinfo(np.int32).max).astype(np.int32)
+
+    # -- solve ----------------------------------------------------------------
+
+    def replan(self) -> int:
+        """Solve the current pending set; returns the number of planned
+        pods.  Called from the sync-period loop (and on demand in tests)."""
+        with self._lock:
+            pods = list(self._pending.items())
+        if not pods:
+            with self._lock:
+                self._plan = {}
+            return 0
+        # ONE atomic snapshot: every pod's compiled rule rows must resolve
+        # against the same view the solve uses (a metric delete + row reuse
+        # mid-loop would silently rebind earlier rows — ADVICE r1)
+        policy_keys = {
+            (pod.namespace, pod.get_labels().get(TAS_POLICY_LABEL))
+            for _key, pod in pods
+        }
+        policies, view, host_only = self.mirror.policies_with_view(
+            list(policy_keys)
+        )
+        compiled_rows: List[Tuple[str, int, int]] = []  # key, row, op
+        for key, pod in pods:
+            policy_name = pod.get_labels().get(TAS_POLICY_LABEL)
+            compiled = policies.get((pod.namespace, policy_name))
+            if compiled is None or compiled.scheduleonmetric_row < 0:
+                continue
+            if compiled.scheduleonmetric_metric in host_only:
+                continue
+            compiled_rows.append(
+                (key, compiled.scheduleonmetric_row, compiled.scheduleonmetric_op)
+            )
+        if not compiled_rows:
+            with self._lock:
+                self._plan = {}
+            return 0
+        n_cap = view.node_capacity
+        p = len(compiled_rows)
+        metric_row = np.array([r for _, r, _ in compiled_rows], dtype=np.int32)
+        op_id = np.array([o for _, _, o in compiled_rows], dtype=np.int32)
+        candidates = np.zeros((p, n_cap), dtype=bool)
+        candidates[:, : len(view.node_names)] = True
+        # dontschedule filtering happens inside scheduling_step; here every
+        # known node is a candidate (kube-scheduler's own predicates will
+        # re-check its side)
+        dontschedule = self._merged_dontschedule(pods, policies)
+        state = ClusterState(
+            metric_values=view.values,
+            metric_present=view.present,
+            dontschedule=dontschedule,
+            capacity=jnp.asarray(self._remaining_capacity(view)),
+        )
+        batch = PendingPods(
+            metric_row=jnp.asarray(metric_row),
+            op_id=jnp.asarray(op_id),
+            candidates=jnp.asarray(candidates),
+        )
+        if self.solver == "sinkhorn":
+            from platform_aware_scheduling_tpu.ops.sinkhorn import (
+                sinkhorn_assign_kernel,
+            )
+
+            _violating, score, eligible = score_and_filter(state, batch)
+            sink = sinkhorn_assign_kernel(score, eligible, state.capacity)
+            assigned = np.asarray(sink.assignment.node_for_pod)
+        else:
+            out = scheduling_step(state, batch)
+            assigned = np.asarray(out.assignment.node_for_pod)
+        plan: Dict[str, Tuple[str, int]] = {}
+        for i, (key, _row, _op) in enumerate(compiled_rows):
+            node_idx = int(assigned[i])
+            if 0 <= node_idx < len(view.node_names):
+                plan[key] = (view.node_names[node_idx], view.version)
+        with self._lock:
+            self._plan = plan
+            self._plan_version = view.version
+        klog.v(4).info_s(
+            f"batch plan: {len(plan)}/{p} pods assigned", component="planner"
+        )
+        return len(plan)
+
+    def _merged_dontschedule(self, pods, policies) -> RuleSet:
+        """Union of the pending pods' dontschedule rules (deduped), resolved
+        against the compiled policies of the replan's atomic snapshot."""
+        seen = set()
+        rows, ops, targets = [], [], []
+        for _key, pod in pods:
+            policy_name = pod.get_labels().get(TAS_POLICY_LABEL)
+            compiled = policies.get((pod.namespace, policy_name))
+            if compiled is None or compiled.dontschedule is None:
+                continue
+            rs = compiled.dontschedule
+            if rs.host_only:
+                continue
+            for i, name in enumerate(rs.metric_names):
+                sig = (int(rs.metric_rows[i]), int(rs.op_ids[i]), int(rs.targets[i]))
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                rows.append(sig[0])
+                ops.append(sig[1])
+                targets.append(sig[2])
+        pad = max(8, -(-max(len(rows), 1) // 8) * 8)
+        metric_rows = np.zeros(pad, dtype=np.int32)
+        op_ids = np.zeros(pad, dtype=np.int32)
+        t = np.zeros(pad, dtype=np.int64)
+        active = np.zeros(pad, dtype=bool)
+        for i, (r, o, tgt) in enumerate(zip(rows, ops, targets)):
+            metric_rows[i], op_ids[i], t[i], active[i] = r, o, tgt, True
+        t_hi, t_lo = i64.split_int64_np(t)
+        return RuleSet(
+            metric_row=jnp.asarray(metric_rows),
+            op_id=jnp.asarray(op_ids),
+            target=i64.I64(hi=jnp.asarray(t_hi), lo=jnp.asarray(t_lo)),
+            active=jnp.asarray(active),
+        )
+
+    # -- serving --------------------------------------------------------------
+
+    def planned_node(self, pod: Pod) -> Optional[str]:
+        """The batch-assigned node for this pod, if the plan is current
+        against the mirror (otherwise None -> per-request path)."""
+        with self._lock:
+            entry = self._plan.get(object_key(pod))
+        if entry is None:
+            return None
+        node, version = entry
+        if version != self.mirror.version:
+            return None  # cluster state moved since the solve
+        return node
+
+    # -- pending-pod feed -------------------------------------------------------
+
+    def watch(self, kube_client):
+        """Informers over pods (pending set + per-node bound counts) and
+        nodes (allocatable pod slots); returns a handle with ``.stop()``."""
+        from platform_aware_scheduling_tpu.kube.informer import (
+            DeletedFinalStateUnknown,
+            Informer,
+            ListWatch,
+        )
+        from platform_aware_scheduling_tpu.kube.objects import Node
+
+        def on_event(pod: Pod) -> None:
+            self.pod_observed(pod)
+            if TAS_POLICY_LABEL not in pod.get_labels():
+                # the label may have been removed while the pod was pending
+                self.pod_removed(pod)
+                return
+            if pod.spec_node_name or pod.phase in ("Succeeded", "Failed"):
+                self.pod_removed(pod)
+            else:
+                self.pod_added(pod)
+
+        def on_delete(obj) -> None:
+            if isinstance(obj, DeletedFinalStateUnknown):
+                obj = obj.obj
+            if isinstance(obj, Pod):
+                self.pod_observed(obj, deleted=True)
+                self.pod_removed(obj)
+
+        pod_informer = Informer(
+            ListWatch(
+                lambda: (kube_client.list_pods(), ""),
+                lambda rv: (
+                    (etype, Pod(raw)) for etype, raw in kube_client.watch_pods()
+                ),
+                object_key,
+            ),
+            on_add=on_event,
+            on_update=lambda _old, new: on_event(new),
+            on_delete=on_delete,
+        )
+
+        def on_node_delete(obj) -> None:
+            if isinstance(obj, DeletedFinalStateUnknown):
+                obj = obj.obj
+            if isinstance(obj, Node):
+                self.node_changed(obj, deleted=True)
+
+        node_informer = Informer(
+            ListWatch(
+                lambda: (kube_client.list_nodes(), ""),
+                lambda rv: (
+                    (etype, Node(raw)) for etype, raw in kube_client.watch_nodes()
+                ),
+                lambda node: node.name,
+            ),
+            on_add=self.node_changed,
+            on_update=lambda _old, new: self.node_changed(new),
+            on_delete=on_node_delete,
+        )
+        pod_informer.start()
+        node_informer.start()
+        return _InformerGroup(pod_informer, node_informer)
+
+    # -- background loop -------------------------------------------------------
+
+    def start(self, period_seconds: float) -> threading.Event:
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(period_seconds):
+                try:
+                    self.replan()
+                except Exception as exc:
+                    klog.error("replan failed: %s", exc)
+
+        threading.Thread(target=loop, daemon=True).start()
+        return stop

@@ -1,0 +1,19 @@
+"""TASPolicy CRD: types and REST client (group telemetry.intel.com/v1alpha1)."""
+
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
+    GROUP,
+    PLURAL,
+    VERSION,
+    TASPolicy,
+    TASPolicyRule,
+    TASPolicyStrategy,
+)
+
+__all__ = [
+    "TASPolicy",
+    "TASPolicyRule",
+    "TASPolicyStrategy",
+    "GROUP",
+    "VERSION",
+    "PLURAL",
+]

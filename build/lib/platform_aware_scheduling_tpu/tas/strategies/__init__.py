@@ -1,0 +1,2 @@
+"""TAS policy strategies: scheduleonmetric, dontschedule, deschedule
+(reference telemetry-aware-scheduling/pkg/strategies/)."""

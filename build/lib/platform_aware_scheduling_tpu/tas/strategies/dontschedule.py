@@ -1,0 +1,69 @@
+"""dontschedule strategy: nodes violating any rule are filtered out.
+
+Reference: telemetry-aware-scheduling/pkg/strategies/dontschedule/strategy.go.
+OR-semantics across rules: a node violating ANY rule is in the violation set
+(strategy.go:25-44).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
+    TASPolicyRule,
+    TASPolicyStrategy,
+)
+from platform_aware_scheduling_tpu.tas.strategies import core
+from platform_aware_scheduling_tpu.utils import klog
+
+STRATEGY_TYPE = "dontschedule"
+
+
+@dataclass
+class Strategy:
+    policy_name: str = ""
+    rules: List[TASPolicyRule] = field(default_factory=list)
+
+    @classmethod
+    def from_policy_strategy(cls, strat: TASPolicyStrategy) -> "Strategy":
+        return cls(policy_name=strat.policy_name, rules=list(strat.rules))
+
+    def violated(self, cache) -> Dict[str, None]:
+        """Nodes whose current metric values violate any rule
+        (strategy.go:25-44).  Unreadable metrics are skipped."""
+        violating: Dict[str, None] = {}
+        for rule in self.rules:
+            try:
+                node_metrics = cache.read_metric(rule.metricname)
+            except Exception as exc:
+                klog.v(2).info_s(str(exc), component="controller")
+                continue
+            for node_name, node_metric in node_metrics.items():
+                if core.evaluate_rule(node_metric.value, rule):
+                    klog.v(2).info_s(
+                        f"{node_name} violating {self.policy_name}: "
+                        f"{rule.metricname} {rule.operator} {rule.target}",
+                        component="controller",
+                    )
+                    violating[node_name] = None
+        return violating
+
+    def enforce(self, enforcer, cache) -> int:
+        """Unimplemented for dontschedule (strategy.go:47-49)."""
+        return 0
+
+    def cleanup(self, enforcer, policy_name: str) -> None:
+        return None
+
+    def strategy_type(self) -> str:
+        return STRATEGY_TYPE
+
+    def equals(self, other) -> bool:
+        return isinstance(other, Strategy) and core.rules_equal(self, other)
+
+    def get_policy_name(self) -> str:
+        return self.policy_name
+
+    def set_policy_name(self, name: str) -> None:
+        self.policy_name = name

@@ -1,0 +1,50 @@
+"""scheduleonmetric strategy: a marker type whose first rule drives
+Prioritize ordering; Violated/Enforce are no-ops.
+
+Reference: telemetry-aware-scheduling/pkg/strategies/scheduleonmetric/
+strategy.go (no-ops at 20-28).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
+    TASPolicyRule,
+    TASPolicyStrategy,
+)
+from platform_aware_scheduling_tpu.tas.strategies import core
+
+STRATEGY_TYPE = "scheduleonmetric"
+
+
+@dataclass
+class Strategy:
+    policy_name: str = ""
+    rules: List[TASPolicyRule] = field(default_factory=list)
+
+    @classmethod
+    def from_policy_strategy(cls, strat: TASPolicyStrategy) -> "Strategy":
+        return cls(policy_name=strat.policy_name, rules=list(strat.rules))
+
+    def violated(self, cache) -> Dict[str, None]:
+        return {}
+
+    def enforce(self, enforcer, cache) -> int:
+        return 0
+
+    def cleanup(self, enforcer, policy_name: str) -> None:
+        return None
+
+    def strategy_type(self) -> str:
+        return STRATEGY_TYPE
+
+    def equals(self, other) -> bool:
+        return isinstance(other, Strategy) and core.rules_equal(self, other)
+
+    def get_policy_name(self) -> str:
+        return self.policy_name
+
+    def set_policy_name(self, name: str) -> None:
+        self.policy_name = name

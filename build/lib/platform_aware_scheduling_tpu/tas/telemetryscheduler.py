@@ -1,0 +1,593 @@
+"""TAS scheduling logic: the Prioritize/Filter/Bind verbs over policy rules.
+
+Reference: telemetry-aware-scheduling/pkg/telemetryscheduler/
+telemetryscheduler.go.  Wire behavior is reproduced quirk-for-quirk
+(callers depend on it):
+
+  * decode failures and empty node lists return an empty 200 body
+    (telemetryscheduler.go:41-48 — the Go handler just returns);
+  * a pod without the ``telemetry-policy`` label gets status 400 but the
+    handler STILL runs and writes ``[]`` (no return after WriteHeader,
+    telemetryscheduler.go:50-53);
+  * a nil filter result is 404 with body ``null`` (:170-175);
+  * FailedNodes messages are the literal "Node violates" (the reference's
+    one-element strings.Join never uses its separator, :206);
+  * in the legacy Nodes branch FilterResult.NodeNames is built by
+    splitting "n1 n2 " on spaces and so carries a trailing empty string
+    (:212) — harmless there because the scheduler ignores NodeNames; the
+    nodeCacheCapable branch instead emits exactly the passing names (the
+    scheduler consumes them and rejects unknown entries);
+  * Bind is 404 — TAS does not bind (:179-181).
+
+Two execution paths produce identical wire bytes:
+
+  * **device path** (default): the jitted kernels of ops/scoring.py over the
+    TensorStateMirror — one fused XLA pass instead of the per-node Go loop;
+  * **host path**: exact-semantics Python (strategies/core.py), used as
+    fallback whenever the mirror marks a policy/metric host-only (inexact
+    milli conversion, unknown operator) and as the control in tests.
+
+For non-sorting operators the reference's output order is Go map iteration
+— randomized per process.  The device path is deterministic (node interning
+order), which is within the reference's behavior envelope.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    HTTPResponse,
+)
+from platform_aware_scheduling_tpu.extender.types import (
+    Args,
+    FilterResult,
+    HostPriority,
+    encode_host_priority_list,
+)
+from platform_aware_scheduling_tpu.kube.objects import Node, Pod
+from platform_aware_scheduling_tpu.ops.state import (
+    CompiledPolicy,
+    DeviceView,
+    TensorStateMirror,
+)
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
+from platform_aware_scheduling_tpu.native import get_wirec
+from platform_aware_scheduling_tpu.tas.fastpath import PrioritizeFastPath
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
+from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
+from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
+
+import jax.numpy as jnp
+
+TAS_POLICY_LABEL = "telemetry-policy"
+
+
+class MetricsExtender:
+    """extender.Scheduler implementation for TAS
+    (reference telemetryscheduler.go:25-34)."""
+
+    def __init__(
+        self,
+        cache: AutoUpdatingCache,
+        mirror: Optional[TensorStateMirror] = None,
+        recorder: Optional[LatencyRecorder] = None,
+        planner=None,
+        node_cache_capable: bool = False,
+    ):
+        """``node_cache_capable``: serve Prioritize/Filter from
+        ``Args.NodeNames`` when ``Args.Nodes`` is absent — the wire mode a
+        ``nodeCacheCapable: true`` extender registration receives
+        (extender/types.go:44-49; required by GAS, scheduler.go:455-461).
+        The reference TAS ignores NodeNames and returns the empty-200
+        quirk; that behavior is preserved when this flag is off (the
+        default), so large clusters opt in via --nodeCacheCapable."""
+        self.cache = cache
+        self.mirror = mirror
+        self.node_cache_capable = node_cache_capable
+        self.recorder = recorder or LatencyRecorder()
+        # opt-in tas.planner.BatchPlanner: prioritize answers steer planned
+        # pods onto their batch-assigned node (see planner module doc)
+        self.planner = planner
+        # request-independent ranking/violation caches + byte-fragment
+        # encoder (tas/fastpath.py) — the per-request device dispatch and
+        # per-node Python objects the round-1 verdict flagged are gone
+        self.fastpath = PrioritizeFastPath() if mirror is not None else None
+        if mirror is not None:
+            # warm the fastpath from the state-refresh threads: every
+            # mirror publish precomputes rankings/violations/tables for the
+            # new version, so under metric churn (2-5 s syncPeriod,
+            # tas-deployment.yaml) no request pays the device dispatch
+            mirror.on_state_change.append(self.warm_fastpath)
+            self.warm_fastpath()  # cover state written before construction
+
+    # -- fastpath warming ------------------------------------------------------
+
+    def warm_fastpath(self) -> None:
+        """Precompute the request-time caches for the mirror's current
+        state: one ranking pass per in-use (metric row, op) pair, the
+        dontschedule violation sets, and the response-encode table.  Runs
+        in whatever thread published the state change (the metric-refresh
+        loop in production, reference cmd/main.go:76-78), keeping the
+        device dispatch off the request path entirely."""
+        fastpath = self.fastpath
+        if fastpath is None:
+            return
+        try:
+            policies, view, host_only_map = self.mirror.policies_snapshot()
+
+            def host_only(name: str) -> bool:
+                return host_only_map.get(name, False)
+
+            pairs = {
+                (compiled.scheduleonmetric_row, compiled.scheduleonmetric_op)
+                for compiled in policies
+                if self._prioritize_device_eligible(compiled, host_only)
+            }
+            fastpath.precompute(view, pairs, wirec=get_wirec())
+            for compiled in policies:
+                if self._filter_device_eligible(compiled, host_only):
+                    fastpath.violating_names(compiled, view)
+        except Exception as exc:  # warming must never break the writer
+            klog.error("fastpath warm failed: %s", exc)
+
+    # -- verbs ----------------------------------------------------------------
+
+    def prioritize(self, request: HTTPRequest) -> HTTPResponse:
+        start = time.perf_counter()
+        try:
+            response = self._prioritize_native(request)
+            if response is not None:
+                return response
+            klog.v(2).info_s("Received prioritize request", component="extender")
+            args = self._decode(request)
+            if args is None:
+                return HTTPResponse()
+            names = self._candidate_names(args)
+            if not names:
+                klog.v(2).info_s(
+                    "bad extender arguments. No nodes in list", component="extender"
+                )
+                return HTTPResponse()
+            status = 200
+            if TAS_POLICY_LABEL not in args.pod.get_labels():
+                klog.v(2).info_s("no policy associated with pod", component="extender")
+                status = 400  # and still prioritize (telemetryscheduler.go:50-54)
+            return HTTPResponse.json(
+                self._prioritize_body(args, names), status=status
+            )
+        finally:
+            self.recorder.observe("prioritize", time.perf_counter() - start)
+
+    def filter(self, request: HTTPRequest) -> HTTPResponse:
+        start = time.perf_counter()
+        try:
+            klog.v(2).info_s("Filter request received", component="extender")
+            probe = self._filter_cache_probe(request)
+            if isinstance(probe, HTTPResponse):
+                return probe
+            args = self._decode(request)
+            if args is None:
+                return HTTPResponse()
+            result = self._filter_nodes(args)
+            if result is None:
+                klog.v(2).info_s("No filtered nodes returned", component="extender")
+                return HTTPResponse.json(b"null\n", status=404)
+            body = result.to_json()
+            if probe is not None:
+                parsed, violations, use_node_names = probe
+                self.fastpath.filter_store(
+                    violations, use_node_names, parsed, body
+                )
+            return HTTPResponse.json(body)
+        finally:
+            self.recorder.observe("filter", time.perf_counter() - start)
+
+    def _filter_cache_probe(self, request: HTTPRequest):
+        """Filter response reuse (same burst-amortization as Prioritize's
+        span cache): a cached HTTPResponse on hit; a (parsed, violations,
+        use_node_names) token when cacheable but missed (the verb stores
+        its exact Python-built bytes under that key); None when the
+        request isn't cacheable (host-only policy, odd shapes, no native
+        scanner) — the exact path then owns the response alone.
+
+        Correctness: the key pairs the request's raw candidate-span bytes
+        (memcmp, zero false positives) with the IDENTITY of the device
+        violation frozenset — any state change produces a new frozenset,
+        so stale bytes can never match."""
+        if self.fastpath is None:
+            return None
+        wirec = get_wirec()
+        if wirec is None:
+            return None
+        try:
+            parsed = wirec.parse_prioritize(request.body)
+            use_node_names = False
+            if not parsed.nodes_present or parsed.num_nodes == 0:
+                if (
+                    self.node_cache_capable
+                    and parsed.node_names_present
+                    and parsed.num_node_names > 0
+                ):
+                    use_node_names = True
+                else:
+                    return None
+            policy_name = parsed.policy_label
+            if policy_name is None:
+                return None
+            try:
+                policy = self.cache.read_policy(
+                    parsed.pod_namespace or "", policy_name
+                )
+            except Exception:
+                return None
+            compiled, view = self._device_policy(policy)
+            if compiled is None or not self._device_filter_ok(compiled):
+                return None
+            violations = self.fastpath.violation_set(compiled, view)
+            if violations is None:
+                return None
+            body = self.fastpath.filter_lookup(
+                violations, use_node_names, parsed
+            )
+            if body is not None:
+                return HTTPResponse.json(body)
+            if use_node_names and hasattr(wirec, "filter_encode"):
+                # span-cache miss, NodeNames mode: build the response
+                # natively (row lookup + violation partition + byte
+                # assembly in C) instead of paying the exact path's
+                # full Python decode; the result seeds the span cache
+                body = self.fastpath.filter_parsed(
+                    wirec, view, parsed, violations
+                )
+                self.fastpath.filter_store(
+                    violations, use_node_names, parsed, body
+                )
+                return HTTPResponse.json(body)
+            return parsed, violations, use_node_names
+        except (ValueError, TypeError):
+            return None
+        except Exception as exc:
+            # device trouble (XlaRuntimeError, OOM, ...) must never fail
+            # the verb: degrade to the exact path, whose host fallback
+            # owns the response — same invariant Prioritize keeps
+            klog.error("filter cache probe failed, exact path: %s", exc)
+            return None
+
+    def bind(self, request: HTTPRequest) -> HTTPResponse:
+        # TAS does not implement Bind (telemetryscheduler.go:179-181)
+        return HTTPResponse(status=404)
+
+    # -- native fast path ------------------------------------------------------
+
+    def _prioritize_native(self, request: HTTPRequest) -> Optional[HTTPResponse]:
+        """Serve Prioritize through the _wirec zero-copy scanner when the
+        body has the common well-formed shape; None -> exact Python path
+        (which owns every decode-failure/empty-list wire quirk).  Byte
+        parity between the two is pinned by tests/test_wirec.py.
+
+        The whole native body is guarded by ValueError (which covers
+        JSONDecodeError, UnicodeDecodeError, and UnicodeEncodeError): the
+        scanner validates escapes/UTF-8 at parse time (wirec.c
+        scan_string), so most malformed bodies fail the parse up front —
+        but slice materialization can still raise on inputs the scan
+        cannot reject, e.g. a ``\\u``-escaped lone surrogate whose
+        materialized str cannot UTF-8-encode for the name-table lookup.
+        Either way the request must fall back to the exact path, never
+        drop the connection (round-2 advisor finding)."""
+        if self.fastpath is None:
+            return None
+        wirec = get_wirec()
+        if wirec is None:
+            return None
+        try:
+            return self._prioritize_native_inner(wirec, request)
+        except (ValueError, TypeError):
+            return None
+
+    def _prioritize_native_inner(
+        self, wirec, request: HTTPRequest
+    ) -> Optional[HTTPResponse]:
+        # parse errors (ValueError/TypeError) propagate to the outer guard
+        parsed = wirec.parse_prioritize(request.body)
+        use_node_names = False
+        if not parsed.nodes_present or parsed.num_nodes == 0:
+            if (
+                self.node_cache_capable
+                and parsed.node_names_present
+                and parsed.num_node_names > 0
+            ):
+                use_node_names = True
+            else:
+                return None  # empty-200 quirks belong to the exact path
+        status = 200
+        policy_name = parsed.policy_label
+        if policy_name is None:
+            status = 400  # no label: 400 but still prioritize (-> empty)
+            return HTTPResponse.json(encode_host_priority_list([]), status)
+        namespace = parsed.pod_namespace or ""
+        try:
+            policy = self.cache.read_policy(namespace, policy_name)
+        except Exception:
+            return HTTPResponse.json(encode_host_priority_list([]), status)
+        rule = self._scheduling_rule(policy)
+        if rule is None:
+            return HTTPResponse.json(encode_host_priority_list([]), status)
+        pod = Pod(
+            {"metadata": {"name": parsed.pod_name or "", "namespace": namespace}}
+        )
+        planned = (
+            self.planner.planned_node(pod) if self.planner is not None else None
+        )
+        compiled, view = self._device_policy(policy)
+        if compiled is not None and self._device_prioritize_ok(compiled, rule):
+            try:
+                body = self.fastpath.prioritize_parsed(
+                    wirec, compiled, view, parsed, planned, use_node_names
+                )
+                return HTTPResponse.json(body, status)
+            except Exception as exc:
+                klog.error("native prioritize failed, host fallback: %s", exc)
+        # host-only policy/metric: exact host semantics over the parsed names
+        names = (
+            parsed.node_names_list() if use_node_names else parsed.node_names()
+        )
+        result = self._apply_plan(pod, self._prioritize_host(rule, names))
+        return HTTPResponse.json(encode_host_priority_list(result), status)
+
+    # -- decode ---------------------------------------------------------------
+
+    def _decode(self, request: HTTPRequest) -> Optional[Args]:
+        """DecodeExtenderRequest (telemetryscheduler.go:63-78): errors —
+        including a missing Nodes list — log and produce an empty 200.
+        With node_cache_capable, a body carrying only NodeNames is valid."""
+        if not request.body:
+            klog.v(2).info_s("request body empty", component="extender")
+            return None
+        try:
+            args = Args.from_json(request.body)
+        except Exception as exc:
+            klog.v(2).info_s(f"error decoding request: {exc}", component="extender")
+            return None
+        if args.nodes is None:
+            if self.node_cache_capable and args.node_names is not None:
+                return args
+            klog.v(2).info_s("no nodes in list", component="extender")
+            return None
+        return args
+
+    def _candidate_names(self, args: Args) -> List[str]:
+        """The request's candidate node names: Nodes.items when present,
+        else (nodeCacheCapable only) the NodeNames list."""
+        if args.nodes:
+            return [node.name for node in args.nodes]
+        if self.node_cache_capable and args.node_names:
+            return list(args.node_names)
+        return []
+
+    # -- prioritize logic ------------------------------------------------------
+
+    def _prioritize_body(self, args: Args, names: List[str]) -> bytes:
+        """prioritizeNodes (telemetryscheduler.go:81-100) down to response
+        bytes: any failure degrades to an empty priority list."""
+        try:
+            policy = self._policy_from_pod(args.pod)
+        except Exception as exc:
+            klog.v(2).info_s(
+                f"get policy from pod failed: {exc}", component="extender"
+            )
+            return encode_host_priority_list([])
+        rule = self._scheduling_rule(policy)
+        if rule is None:
+            klog.v(2).info_s(
+                "get scheduling rule from policy failed: no scheduling rule found",
+                component="extender",
+            )
+            return encode_host_priority_list([])
+        compiled, view = self._device_policy(policy)
+        if compiled is not None and self._device_prioritize_ok(compiled, rule):
+            try:
+                planned = (
+                    self.planner.planned_node(args.pod) if self.planner else None
+                )
+                return self.fastpath.prioritize_bytes(
+                    compiled, view, names, planned
+                )
+            except Exception as exc:  # device trouble must never fail the verb
+                klog.error("device prioritize failed, host fallback: %s", exc)
+        result = self._apply_plan(args.pod, self._prioritize_host(rule, names))
+        return encode_host_priority_list(result)
+
+    def _apply_plan(
+        self, pod: Pod, result: List[HostPriority]
+    ) -> List[HostPriority]:
+        """Promote the batch-planned node (if any, current, and among the
+        scored candidates) to rank 1; scores stay ordinal 10-i."""
+        if self.planner is None or not result:
+            return result
+        planned = self.planner.planned_node(pod)
+        if planned is None:
+            return result
+        hosts = [hp.host for hp in result]
+        if planned not in hosts:
+            return result
+        reordered = [planned] + [h for h in hosts if h != planned]
+        return [
+            HostPriority(host=h, score=10 - i) for i, h in enumerate(reordered)
+        ]
+
+    def _prioritize_host(
+        self, rule: TASPolicyRule, candidate_names: List[str]
+    ) -> List[HostPriority]:
+        """prioritizeNodesForRule (telemetryscheduler.go:128-149), exact
+        host semantics."""
+        try:
+            node_data = self.cache.read_metric(rule.metricname)
+        except CacheMissError as exc:
+            klog.v(2).info_s(
+                f"failed to prioritize: {exc}, {rule.metricname}",
+                component="extender",
+            )
+            return []
+        filtered = {
+            name: node_data[name] for name in candidate_names if name in node_data
+        }
+        ordered = core.ordered_list(filtered, rule.operator)
+        return [
+            HostPriority(host=entry.node_name, score=10 - i)
+            for i, entry in enumerate(ordered)
+        ]
+
+    # -- filter logic ----------------------------------------------------------
+
+    def _filter_nodes(self, args: Args) -> Optional[FilterResult]:
+        """filterNodes (telemetryscheduler.go:184-225)."""
+        try:
+            policy = self._policy_from_pod(args.pod)
+        except Exception as exc:
+            klog.v(2).info_s(
+                f"get policy from pod failed {exc}", component="extender"
+            )
+            return None
+        strategy = self._dontschedule_strategy(policy)
+        if strategy is None:
+            klog.v(2).info_s(
+                "Don't scheduler strategy failed no dontschedule strategy found",
+                component="extender",
+            )
+            return None
+        violating = self._violating_nodes(policy, strategy)
+        if not args.nodes:
+            if self.node_cache_capable and args.node_names:
+                return self._filter_node_names(policy, args.node_names, violating)
+            klog.v(2).info_s("No nodes to compare", component="extender")
+            return None
+        filtered: List[Node] = []
+        failed: Dict[str, str] = {}
+        available = ""
+        for node in args.nodes:
+            if node.name in violating:
+                failed[node.name] = "Node violates"
+            else:
+                filtered.append(node)
+                available += node.name + " "
+        node_names = available.split(" ")  # trailing "" kept (see module doc)
+        if available:
+            klog.v(2).info_s(
+                f"Filtered nodes for {policy.name}: {available}",
+                component="extender",
+            )
+        return FilterResult(
+            nodes=filtered, node_names=node_names, failed_nodes=failed, error=""
+        )
+
+    def _filter_node_names(
+        self, policy: TASPolicy, names: List[str], violating: Dict[str, None]
+    ) -> FilterResult:
+        """nodeCacheCapable Filter: answer with NodeNames only (the
+        kube-scheduler reads NodeNames from a nodeCacheCapable extender;
+        Nodes stays null).  Unlike the legacy Nodes branch — where the
+        scheduler ignores NodeNames and the trailing-"" split quirk is
+        harmless wire trivia — here kube-scheduler consumes every entry
+        and rejects names absent from its input list, so the list must
+        hold exactly the passing names (the reference's own
+        nodeCacheCapable extender appends cleanly, GAS scheduler.go:
+        467-476)."""
+        failed: Dict[str, str] = {}
+        node_names: List[str] = []
+        for name in names:
+            if name in violating:
+                failed[name] = "Node violates"
+            else:
+                node_names.append(name)
+        if node_names:
+            available = " ".join(node_names)
+            klog.v(2).info_s(
+                f"Filtered nodes for {policy.name}: {available}",
+                component="extender",
+            )
+        return FilterResult(
+            nodes=None, node_names=node_names, failed_nodes=failed, error=""
+        )
+
+    def _violating_nodes(
+        self, policy: TASPolicy, strategy: dontschedule.Strategy
+    ) -> Dict[str, None]:
+        compiled, view = self._device_policy(policy)
+        if compiled is not None and self._device_filter_ok(compiled):
+            try:
+                violating = self.fastpath.violating_names(compiled, view)
+                if violating is not None:
+                    return violating
+            except Exception as exc:
+                klog.error("device filter failed, host fallback: %s", exc)
+        return strategy.violated(self.cache)
+
+    # -- shared helpers --------------------------------------------------------
+
+    def _policy_from_pod(self, pod: Pod) -> TASPolicy:
+        """getPolicyFromPod (telemetryscheduler.go:103-112)."""
+        policy_name = pod.get_labels().get(TAS_POLICY_LABEL)
+        if policy_name is None:
+            raise CacheMissError(f"no policy found in pod spec for pod {pod.name}")
+        return self.cache.read_policy(pod.namespace, policy_name)
+
+    def _scheduling_rule(self, policy: TASPolicy) -> Optional[TASPolicyRule]:
+        """getSchedulingRule (telemetryscheduler.go:115-124): rule[0] of
+        scheduleonmetric, requiring a non-empty metric name."""
+        strat = policy.strategies.get("scheduleonmetric")
+        if strat and strat.rules and strat.rules[0].metricname:
+            return strat.rules[0]
+        return None
+
+    def _dontschedule_strategy(
+        self, policy: TASPolicy
+    ) -> Optional[dontschedule.Strategy]:
+        """getDontScheduleStrategy (telemetryscheduler.go:228-235)."""
+        strat = policy.strategies.get("dontschedule")
+        if strat is None or not strat.rules:
+            return None
+        return dontschedule.Strategy.from_policy_strategy(strat)
+
+    # -- device-path eligibility ----------------------------------------------
+
+    def _device_policy(self, policy: TASPolicy):
+        """Atomic (compiled, view) snapshot — see
+        TensorStateMirror.policy_with_view for why both come from one lock
+        acquisition."""
+        if self.mirror is None:
+            return None, None
+        return self.mirror.policy_with_view(policy.namespace, policy.name)
+
+    # the single source of truth for "can the device fastpath serve this
+    # policy", shared between the request path (host_only = live mirror
+    # lookup) and the warmer (host_only = snapshotted map) so the warmed
+    # set can never drift from what requests actually use
+
+    @staticmethod
+    def _prioritize_device_eligible(compiled: CompiledPolicy, host_only) -> bool:
+        return compiled.scheduleonmetric_row >= 0 and not host_only(
+            compiled.scheduleonmetric_metric
+        )
+
+    @staticmethod
+    def _filter_device_eligible(compiled: CompiledPolicy, host_only) -> bool:
+        rules = compiled.dontschedule
+        if rules is None or rules.host_only or not rules.active.any():
+            return False
+        return not any(host_only(name) for name in rules.metric_names)
+
+    def _device_prioritize_ok(
+        self, compiled: CompiledPolicy, rule: TASPolicyRule
+    ) -> bool:
+        return self._prioritize_device_eligible(
+            compiled, self.mirror.metric_host_only
+        )
+
+    def _device_filter_ok(self, compiled: CompiledPolicy) -> bool:
+        return self._filter_device_eligible(
+            compiled, self.mirror.metric_host_only
+        )

@@ -1,0 +1,12 @@
+"""Test doubles: in-memory fake kube API, fake metrics backends, builders.
+
+The functional equivalent of the reference's fixture inventory (survey §4):
+client-go ``fake.NewSimpleClientset`` -> :class:`FakeKubeClient`;
+``metrics.DummyMetricsClient`` -> :class:`DummyMetricsClient`;
+mock caches/strategies live next to the code they fake.
+"""
+
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.testing.builders import make_node, make_pod, make_policy
+
+__all__ = ["FakeKubeClient", "make_node", "make_pod", "make_policy"]

@@ -1,0 +1,96 @@
+"""Pre-seeded test doubles.
+
+Functional parity with the reference's hand-written fakes (SURVEY §4
+fixtures inventory): ``mock_self_updating_cache`` mirrors
+``cache.MockSelfUpdatingCache`` (reference pkg/cache/mocks.go:16-39 — a
+live cache pre-seeded with dummy metrics), ``dummy_metrics_client`` mirrors
+``metrics.DummyMetricsClient`` + ``InstanceOfMockMetricClientMap``
+(pkg/metrics/mocks.go:40-75), ``test_node_metric_custom_info`` mirrors
+``TestNodeMetricCustomInfo``, and ``MockStrategy`` mirrors
+``core.MockStrategy`` (pkg/strategies/core/mocks.go).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import (
+    DummyMetricsClient,
+    NodeMetric,
+    NodeMetricsInfo,
+)
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+
+def test_node_metric_custom_info(
+    node_names: Sequence[str], values: Sequence[int]
+) -> NodeMetricsInfo:
+    """Canned per-node metric vectors (reference metrics/mocks.go)."""
+    return {
+        name: NodeMetric(value=Quantity(str(value)))
+        for name, value in zip(node_names, values)
+    }
+
+
+def instance_of_mock_metric_client_map() -> Dict[str, NodeMetricsInfo]:
+    return {
+        "dummyMetric1": test_node_metric_custom_info(["node A", "node B"], [1, 2]),
+        "dummyMetric2": test_node_metric_custom_info(["node A", "node B"], [3, 4]),
+        "dummyMetric3": test_node_metric_custom_info(["node A", "node B"], [5, 6]),
+    }
+
+
+def dummy_metrics_client() -> DummyMetricsClient:
+    return DummyMetricsClient(instance_of_mock_metric_client_map())
+
+
+def mock_self_updating_cache() -> AutoUpdatingCache:
+    """A live cache pre-seeded with the dummy metrics
+    (reference cache/mocks.go MockSelfUpdatingCache)."""
+    cache = AutoUpdatingCache()
+    for name, info in instance_of_mock_metric_client_map().items():
+        cache.write_metric(name, info)
+    return cache
+
+
+def mock_empty_self_updating_cache() -> AutoUpdatingCache:
+    """(reference cache/mocks.go MockEmptySelfUpdatingCache)"""
+    return AutoUpdatingCache()
+
+
+class MockStrategy:
+    """Registry/enforcer test double (reference core/mocks.go)."""
+
+    def __init__(self, strategy_type: str = "mock", policy_name: str = "mock"):
+        self._type = strategy_type
+        self.policy_name = policy_name
+        self.rules: List = []
+        self.enforce_calls = 0
+        self.cleanup_calls = 0
+
+    def violated(self, cache) -> Dict[str, None]:
+        return {}
+
+    def strategy_type(self) -> str:
+        return self._type
+
+    def equals(self, other) -> bool:
+        return (
+            isinstance(other, MockStrategy)
+            and other._type == self._type
+            and other.policy_name == self.policy_name
+        )
+
+    def get_policy_name(self) -> str:
+        return self.policy_name
+
+    def set_policy_name(self, name: str) -> None:
+        self.policy_name = name
+
+    def enforce(self, enforcer, cache) -> int:
+        self.enforce_calls += 1
+        return 0
+
+    def cleanup(self, enforcer, policy_name: str) -> None:
+        self.cleanup_calls += 1

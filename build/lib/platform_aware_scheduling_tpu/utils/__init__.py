@@ -1,0 +1,6 @@
+"""Shared host-side utilities: logging, quantities, durations."""
+
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+__all__ = ["Quantity", "parse_duration"]

@@ -1,0 +1,34 @@
+"""Latency-service GC posture for the extender processes.
+
+Request handling allocates bulk bytes (parsed bodies, response buffers)
+but creates no reference cycles, so CPython's default generational
+thresholds only add tail latency: every young-gen collection scans a
+JAX-sized module graph for garbage that is reclaimed by refcounting
+anyway.  The standard tuning for this shape of service — freeze the
+warmed startup heap out of collection and raise the gen-0 threshold — is
+applied once, after assembly, before serving.
+
+Opt out with ``PAS_TPU_NO_GC_TUNING=1`` (e.g. when embedding the
+extender in a host application that owns GC policy).
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+
+from platform_aware_scheduling_tpu.utils import klog
+
+
+def tune_for_serving() -> bool:
+    """Apply the serving GC posture; returns whether it was applied."""
+    if os.environ.get("PAS_TPU_NO_GC_TUNING") == "1":
+        return False
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(100_000, 50, 50)
+    klog.v(2).info_s(
+        "GC tuned for serving (startup heap frozen, gen0 threshold 100k)",
+        component="extender",
+    )
+    return True

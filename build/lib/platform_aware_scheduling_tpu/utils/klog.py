@@ -1,0 +1,107 @@
+"""Leveled, structured logging in the style of k8s klog.
+
+The reference logs through klog with verbosity levels 1-5 and a
+``"component"`` key on most lines (e.g. reference
+telemetry-aware-scheduling/pkg/telemetryscheduler/telemetryscheduler.go:40).
+This module provides the same surface — ``v(level).info_s(msg, component=..)``
+— on top of the stdlib ``logging`` module, with the verbosity controlled by
+``set_verbosity`` (the ``--v`` flag) or the ``PAS_TPU_LOG_LEVEL`` env var.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+
+_logger = logging.getLogger("pas_tpu")
+_lock = threading.Lock()
+_verbosity = int(os.environ.get("PAS_TPU_LOG_LEVEL", "0") or 0)
+_configured = False
+
+
+def _ensure_configured() -> None:
+    global _configured
+    with _lock:
+        if _configured:
+            return
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname).1s %(message)s")
+        )
+        _logger.addHandler(handler)
+        _logger.setLevel(logging.INFO)
+        _logger.propagate = False
+        _configured = True
+
+
+def set_verbosity(level: int) -> None:
+    """Set the global verbosity (the ``--v`` flag of the reference binaries)."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def verbosity() -> int:
+    return _verbosity
+
+
+def _fmt(msg: str, kv: dict) -> str:
+    if not kv:
+        return msg
+    pairs = " ".join(f'{k}="{v}"' for k, v in kv.items())
+    return f"{msg} {pairs}"
+
+
+class _Verbose:
+    __slots__ = ("_enabled",)
+
+    def __init__(self, enabled: bool):
+        self._enabled = enabled
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def info_s(self, msg: str, **kv) -> None:
+        if self._enabled:
+            _ensure_configured()
+            _logger.info(_fmt(msg, kv))
+
+    # klog.V(n).Infof-style formatting
+    def infof(self, fmt: str, *args) -> None:
+        if self._enabled:
+            _ensure_configured()
+            _logger.info(fmt % args if args else fmt)
+
+    info = infof
+
+
+def v(level: int) -> _Verbose:
+    return _Verbose(level <= _verbosity)
+
+
+def info_s(msg: str, **kv) -> None:
+    _ensure_configured()
+    _logger.info(_fmt(msg, kv))
+
+
+def warning(msg: str, *args) -> None:
+    _ensure_configured()
+    _logger.warning(msg % args if args else msg)
+
+
+warningf = warning
+
+
+def error(msg: str, *args) -> None:
+    _ensure_configured()
+    _logger.error(msg % args if args else msg)
+
+
+errorf = error
+
+
+def fatal(msg: str, *args) -> None:
+    _ensure_configured()
+    _logger.critical(msg % args if args else msg)
+    raise SystemExit(255)

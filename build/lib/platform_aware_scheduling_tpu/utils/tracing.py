@@ -1,0 +1,99 @@
+"""Per-request latency tracing.
+
+The reference has no tracing/profiling at all (SURVEY §5.1: no pprof, no
+OpenTelemetry — only klog verbosity).  Since this framework's north-star
+metric is p99 Prioritize latency, latency histograms are built in: every
+extender verb records into a :class:`LatencyRecorder`, exposed as a
+Prometheus-style text dump (and consumed by bench.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, Iterable, List, Tuple
+
+# exponential bucket bounds in seconds: 100us .. ~105s
+_BUCKETS: List[float] = [0.0001 * (2**i) for i in range(21)]
+
+
+def quantile(sorted_values: List[float], q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, max(0, int(q * len(sorted_values))))
+    return sorted_values[idx]
+
+
+class LatencyRecorder:
+    """Thread-safe per-label latency stats: histogram buckets plus a bounded
+    window of raw samples for exact quantiles."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._window = window
+        self._samples: Dict[str, Deque[float]] = {}
+        self._counts: Dict[str, int] = {}
+        self._sums: Dict[str, float] = {}
+        self._buckets: Dict[str, List[int]] = {}
+
+    def observe(self, label: str, seconds: float) -> None:
+        with self._lock:
+            if label not in self._samples:
+                self._samples[label] = deque(maxlen=self._window)
+                self._counts[label] = 0
+                self._sums[label] = 0.0
+                self._buckets[label] = [0] * (len(_BUCKETS) + 1)
+            self._samples[label].append(seconds)
+            self._counts[label] += 1
+            self._sums[label] += seconds
+            for i, bound in enumerate(_BUCKETS):
+                if seconds <= bound:
+                    self._buckets[label][i] += 1
+                    break
+            else:
+                self._buckets[label][-1] += 1
+
+    def labels(self) -> List[str]:
+        with self._lock:
+            return list(self._counts)
+
+    def summary(self, label: str) -> Dict[str, float]:
+        with self._lock:
+            samples = sorted(self._samples.get(label, ()))
+            count = self._counts.get(label, 0)
+            total = self._sums.get(label, 0.0)
+        return {
+            "count": count,
+            "mean": (total / count) if count else 0.0,
+            "p50": quantile(samples, 0.50),
+            "p90": quantile(samples, 0.90),
+            "p99": quantile(samples, 0.99),
+            "max": samples[-1] if samples else 0.0,
+        }
+
+    def prometheus_text(self) -> str:
+        """Cumulative-histogram text exposition (the format the reference's
+        own metrics pipeline scrapes, docs/custom-metrics.md)."""
+        lines: List[str] = []
+        with self._lock:
+            items: Iterable[Tuple[str, List[int]]] = list(self._buckets.items())
+            counts = dict(self._counts)
+            sums = dict(self._sums)
+        for label, buckets in items:
+            cumulative = 0
+            for bound, n in zip(_BUCKETS, buckets):
+                cumulative += n
+                lines.append(
+                    f'pas_request_duration_seconds_bucket{{verb="{label}",le="{bound:g}"}} {cumulative}'
+                )
+            cumulative += buckets[-1]
+            lines.append(
+                f'pas_request_duration_seconds_bucket{{verb="{label}",le="+Inf"}} {cumulative}'
+            )
+            lines.append(
+                f'pas_request_duration_seconds_sum{{verb="{label}"}} {sums[label]:.9f}'
+            )
+            lines.append(
+                f'pas_request_duration_seconds_count{{verb="{label}"}} {counts[label]}'
+            )
+        return "\n".join(lines) + ("\n" if lines else "")
