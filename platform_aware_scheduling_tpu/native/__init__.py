@@ -3,9 +3,13 @@
 The reference has no native code (SURVEY: 100% Go, zero C++/CUDA), but this
 framework's runtime keeps its wire tails native: ``_wirec`` removes the
 per-request JSON-object churn at 10k-node scale (see wirec.c).  The module
-is compiled on first use with the toolchain baked into the image (g++/cc);
-everything degrades gracefully to the pure-Python paths when no compiler
-is available (``get_wirec() -> None``).
+is compiled on first use wherever a toolchain exists (dev machines, the
+image BUILD stage); the shipped TAS image carries no compiler and a
+read-only rootfs, so deploy/images/Dockerfile.tas precompiles the
+artifact at build time and this loader just loads it
+(``get_wirec(allow_build=False)`` is its gate).  Everything degrades
+gracefully to the pure-Python paths when neither a prebuilt artifact nor
+a compiler is available (``get_wirec() -> None``).
 
 No binary is ever shipped or loaded blind: the build artifact is named by
 the SHA-256 of the source, so the loader only loads a ``.so`` that was
