@@ -22,6 +22,14 @@ deschedule churn, solver comparison) from benchmarks/configs.py.
 
 Prints ONE JSON line; the primary fields remain
 {"metric", "value", "unit", "vs_baseline"}.
+
+Line layout (round-4 verdict: the driver captures the TAIL of stdout and
+r03/r04 both truncated the headline off the front): the bulky per-config
+http_load device/control dicts go to BENCH_DETAIL_r{N}.json on disk, and
+the line itself ends with the headline — speedup_p99* aliases first, then
+{"metric", "value", "unit", "vs_baseline"} as the very last keys — so any
+tail window that catches the end of the line catches everything that must
+parse.
 """
 
 import json
@@ -126,61 +134,103 @@ def batched_solve():
     return fields, context
 
 
-def main():
-    result, context = batched_solve()
-    print(context, file=sys.stderr)
+def _detail_path() -> str:
+    """BENCH_DETAIL_r{N}.json beside this file, N inferred as one past the
+    highest driver-written BENCH_r*.json (the driver writes its artifact
+    AFTER this process exits, so max+1 is the current round)."""
+    import glob
+    import re
 
-    # --- north star: p99 HTTP serving latency, device vs control ---
-    # (benchmarks/http_load.py; servers run in their own subprocesses)
-    try:
-        from benchmarks import http_load
+    root = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(m.group(1))
+        for f in glob.glob(os.path.join(root, "BENCH_r*.json"))
+        for m in [re.search(r"BENCH_r(\d+)\.json$", f)]
+        if m
+    ]
+    n = max(rounds) + 1 if rounds else 0
+    return os.path.join(root, f"BENCH_DETAIL_r{n:02d}.json")
 
-        load = http_load.run(num_nodes=NUM_NODES)
-        for key in (
-            "p99_prioritize_ms_device",
-            "p99_prioritize_ms_control",
-            "speedup_p99",
-            "speedup_p99_c8",
-            "speedup_p99_miss",
-            "speedup_p99_filter",
-            "speedup_p99_filter_c8",
-            "speedup_p99_filter_miss",
-        ):
-            result[key] = load[key]
-        result["http_load"] = {
+
+def assemble_line(headline, load, configs_out):
+    """(result, detail): the printed JSON line dict — insertion-ordered so
+    the headline aliases and {metric, value, unit, vs_baseline} are the
+    LAST keys (driver tail-capture keeps the end of the line) — and the
+    bulky per-config http_load latency dicts destined for the on-disk
+    detail file (tests/test_bench_line.py pins the layout)."""
+    result = {}
+    detail = {}
+    if load is not None:
+        detail["http_load"] = {
+            "num_nodes": load["num_nodes"],
             "device": load["device"],
             "control": load["control"],
-            "speedup": load["speedup"],
         }
-        # structural note: the filter MISS tier is ratio-capped (~25-30x
-        # at c1) independent of implementation quality — the filter
-        # control skips the sort (~25 ms at 10k nodes) while a span-cache
-        # miss still pays parse + violation partition + encode + HTTP
-        # (~1 ms floor); the named bars are prioritize hit/miss and
-        # filter hit, all reported above
+        result["http_load"] = {"speedup": load["speedup"]}
+    if configs_out is not None:
+        result["configs"] = configs_out
+    if load is not None:
+        # structural note: the filter MISS tier is ratio-capped independent
+        # of implementation quality — the filter control skips the sort
+        # (~25 ms at 10k nodes) while a span-cache miss still pays the
+        # ~1 ms native floor (per-stage breakdown in
+        # configs.filter_floor_breakdown)
         result["notes"] = (
             "filter_miss is ratio-capped: filter control has no sort "
             "(~25ms) vs ~1ms device floor on a true cache miss"
         )
+        # the headline aliases, in http_load.run's own insertion order —
+        # derived from the load dict so a new alias added there can never
+        # be silently dropped here
+        for key, value in load.items():
+            if key.startswith("p99_prioritize_ms_") or key.startswith(
+                "speedup_p99"
+            ):
+                result[key] = value
+    result.update(headline)
+    return result, detail
+
+
+def main():
+    headline, context = batched_solve()
+    print(context, file=sys.stderr)
+
+    # --- north star: p99 HTTP serving latency, device vs control ---
+    # (benchmarks/http_load.py; servers run in their own subprocesses)
+    load = None
+    try:
+        from benchmarks import http_load
+
+        load = http_load.run(num_nodes=NUM_NODES)
         print(
             f"http_load: p99 device {load['p99_prioritize_ms_device']} ms vs "
             f"control {load['p99_prioritize_ms_control']} ms -> "
-            f"{load['speedup_p99']}x (c8 {load['speedup_p99_c8']}x, "
-            f"miss {load['speedup_p99_miss']}x, filter {load['speedup_p99_filter']}x)",
+            f"{load['speedup_p99']}x",
             file=sys.stderr,
         )
     except Exception as exc:  # the HTTP bench must never sink the headline
         print(f"http_load failed: {exc}", file=sys.stderr)
 
-    # --- BASELINE configs #2/#3/#5 + solver surface ---
+    # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
+    configs_out = None
     try:
         from benchmarks import configs as config_benches
 
-        result["configs"] = config_benches.run_all()
+        configs_out = config_benches.run_all()
     except Exception as exc:  # config benches must never sink the headline
         print(f"config benches failed: {exc}", file=sys.stderr)
 
+    result, detail = assemble_line(headline, load, configs_out)
+    # the line FIRST — nothing after this point may sink the headline
     print(json.dumps(result))
+    if detail:
+        try:
+            path = _detail_path()
+            with open(path, "w") as f:
+                json.dump(detail, f, indent=2)
+            print(f"detail -> {path}", file=sys.stderr)
+        except Exception as exc:  # detail is best-effort, line already out
+            print(f"detail write failed: {exc}", file=sys.stderr)
 
 
 if __name__ == "__main__":

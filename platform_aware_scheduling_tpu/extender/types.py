@@ -14,10 +14,16 @@ reference's untagged Go structs decode them only via encoding/json's
 case-insensitive field matching.  Go resolves every JSON key to its field
 case-insensitively in document order, later assignments overwriting
 earlier ones — reproduced here (tests/test_golden_wire.py pins both key
-spellings).
+spellings).  Case-insensitivity is ASCII (an A-Z-only fold here, byte
+tables in the native scanner): Go's ``strings.EqualFold`` additionally
+folds exotic Unicode spellings (``ſ``→``s``, Kelvin ``K``→``k``) that no
+real JSON marshaler emits for these fields — such keys are dropped here,
+identically on both internal paths (``str.lower`` would fold Kelvin
+``K`` and diverge from the scanner, hence the explicit ASCII table).
 
-Envelope note on duplicate keys: field RESOLUTION (case-insensitivity,
-document order, per-type null rules) is Go-exact, but when the same
+Envelope note on duplicate keys: field RESOLUTION (ASCII
+case-insensitivity, document order, per-type null rules) matches Go on
+every producible wire body, but when the same
 object-valued field appears twice, the later OBJECT replaces the earlier
 one wholesale (json.loads semantics, matched by the native scanner),
 whereas Go would merge it per-field into the existing struct.  Go
@@ -64,6 +70,13 @@ def _loads_with_top_pairs(body: bytes):
     return obj, (top if isinstance(obj, dict) else [])
 
 
+# A-Z -> a-z only; unlike str.lower() this cannot fold non-ASCII
+# spellings (Kelvin K, etc.) the native scanner's byte tables never match
+_ASCII_LOWER = str.maketrans(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ", "abcdefghijklmnopqrstuvwxyz"
+)
+
+
 def _fold_keys(
     pairs, fields: Dict[str, str], nullable: frozenset = frozenset()
 ) -> Dict[str, Any]:
@@ -81,7 +94,7 @@ def _fold_keys(
     survives."""
     out: Dict[str, Any] = {}
     for key, value in pairs:
-        canonical = fields.get(key.lower())
+        canonical = fields.get(key.translate(_ASCII_LOWER))
         if canonical is None:
             continue
         if value is None and canonical not in nullable:

@@ -698,8 +698,14 @@ static int scan_pod(Scan *sc, ParsedArgs *pa) {
  * the realloc chain (each step past the mmap threshold is a fresh
  * mapping + copy — p99 churn at 10k nodes).  Atomic because the server
  * is thread-per-connection (a per-thread hint would reset every
- * connection); relaxed ordering — the hint is only an optimization. */
+ * connection); relaxed ordering — the hint is only an optimization.
+ * Capped at NAME_HINT_MAX slots: the hint is driven by untrusted
+ * request content, and without a ceiling one huge NodeNames body would
+ * permanently raise the initial allocation for every later request
+ * (64k slots = 2 MB of StrSlice, comfortably above any real cluster;
+ * larger requests still parse — they just grow from the cap). */
 #include <stdatomic.h>
+#define NAME_HINT_MAX 65536
 static _Atomic Py_ssize_t names_hint = NAME_CHUNK;
 
 static Py_ssize_t grow_cap(Py_ssize_t cap) {
@@ -989,6 +995,7 @@ static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
      * the hint is only an allocation-size optimization) */
     Py_ssize_t seen = pa->num_names > pa->num_nn_names ? pa->num_names
                                                        : pa->num_nn_names;
+    if (seen > NAME_HINT_MAX) seen = NAME_HINT_MAX;
     if (seen > atomic_load_explicit(&names_hint, memory_order_relaxed)) {
         Py_ssize_t h = NAME_CHUNK;
         while (h < seen) h *= 2;

@@ -1,0 +1,88 @@
+"""bench.py's printed JSON line layout.
+
+The driver captures the TAIL of bench.py's stdout; rounds 3 and 4 both
+lost the headline to front-truncation (BENCH_r0{3,4}.json ``parsed:
+null``).  These tests pin the fix: the required fields — the
+``speedup_p99*`` aliases and {metric, value, unit, vs_baseline} — are the
+LAST keys of the line, and the bulky per-config latency dicts never
+appear in the line at all (they go to the on-disk detail file).
+"""
+
+import importlib.util
+import json
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "bench",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py"),
+)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _fake_load():
+    stats = {"count": 8, "p50_ms": 1.0, "p90_ms": 2.0, "p99_ms": 3.0,
+             "mean_ms": 1.5, "requests_per_s": 100.0}
+    return {
+        "num_nodes": 100,
+        "device": {"prioritize_nodenames_c1": dict(stats)},
+        "control": {"prioritize_nodenames_c1": dict(stats)},
+        "speedup": {"prioritize_nodenames_c1": {"p50": 10.0, "p99": 12.0}},
+        "p99_prioritize_ms_device": 3.0,
+        "p99_prioritize_ms_control": 36.0,
+        "speedup_p99": 12.0,
+        "speedup_p99_miss": 8.0,
+        "speedup_p99_filter": 9.0,
+    }
+
+
+HEADLINE = {
+    "metric": "batch_schedule_pods_per_sec_10k_nodes_1k_pods",
+    "value": 123.4,
+    "unit": "pods/s",
+    "vs_baseline": 56.7,
+}
+
+
+class TestBenchLine:
+    def test_headline_fields_are_last(self):
+        result, _ = bench.assemble_line(HEADLINE, _fake_load(), {"c": 1})
+        keys = list(result)
+        assert keys[-4:] == ["metric", "value", "unit", "vs_baseline"]
+        # aliases sit directly before the headline block
+        alias_block = keys[: -4][-5:]
+        assert "speedup_p99" in alias_block
+        assert "p99_prioritize_ms_device" in alias_block
+
+    def test_tail_window_parses_headline(self):
+        """Any tail window that catches the closing brace catches every
+        required field: the headline must live within the last 600 bytes
+        of the serialized line."""
+        result, _ = bench.assemble_line(HEADLINE, _fake_load(), {"c": 1})
+        line = json.dumps(result)
+        tail = line[-600:]
+        for fragment in ('"vs_baseline"', '"metric"', '"speedup_p99"'):
+            assert fragment in tail
+
+    def test_bulk_detail_not_in_line(self):
+        result, detail = bench.assemble_line(HEADLINE, _fake_load(), None)
+        line = json.dumps(result)
+        assert '"p90_ms"' not in line  # per-config stats stay off the line
+        assert "device" in detail["http_load"]
+        assert "control" in detail["http_load"]
+        assert result["http_load"] == {
+            "speedup": {"prioritize_nodenames_c1": {"p50": 10.0, "p99": 12.0}}
+        }
+
+    def test_missing_load_still_emits_headline(self):
+        result, detail = bench.assemble_line(HEADLINE, None, None)
+        assert list(result)[-4:] == ["metric", "value", "unit", "vs_baseline"]
+        assert detail == {}
+        # no http_load data -> no filter_miss caveat about it
+        assert "notes" not in result
+
+    def test_absent_aliases_are_omitted(self):
+        load = _fake_load()  # has no *_c8 aliases (c1-only sweep)
+        result, _ = bench.assemble_line(HEADLINE, load, None)
+        assert "speedup_p99_c8" not in result
+        assert result["speedup_p99"] == 12.0
