@@ -137,7 +137,11 @@ def batched_solve():
 def _detail_path() -> str:
     """BENCH_DETAIL_r{N}.json beside this file, N inferred as one past the
     highest driver-written BENCH_r*.json (the driver writes its artifact
-    AFTER this process exits, so max+1 is the current round)."""
+    AFTER this process exits, so max+1 is the current round).  A manual
+    re-run after the driver has written the current round's artifact
+    lands on the NEXT round's name and will be overwritten by that
+    round's real run — last writer wins; only the driver-run detail is
+    authoritative."""
     import glob
     import re
 

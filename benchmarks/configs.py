@@ -211,39 +211,48 @@ def _binpack_problem(num_nodes=256, num_cards=8, num_res=3, seed=9):
     return state, request, max_gpus, hosts
 
 
+def _host_fit_node(used_n, cap_n, need, need_active, num_gpus):
+    """(ok, booked used) for ONE node — the reference's per-node first-fit
+    card walk (scheduler.go:200-257, 341-383), card_order == identity."""
+    used = used_n.copy()
+    n_cards, n_res = used.shape
+    ok = True
+    for t in range(len(num_gpus)):
+        for _g in range(int(num_gpus[t])):
+            placed = False
+            for c in range(n_cards):
+                fit = True
+                for r in range(n_res):
+                    if not need_active[t, r]:
+                        continue
+                    if used[c, r] + need[t, r] > cap_n[r]:
+                        fit = False
+                        break
+                if fit:
+                    for r in range(n_res):
+                        if need_active[t, r]:
+                            used[c, r] += need[t, r]
+                    placed = True
+                    break
+            if not placed:
+                ok = False
+    return ok, used
+
+
 def _host_first_fit(hosts) -> np.ndarray:
-    """The reference's sequential per-node first-fit
-    (scheduler.go:200-257, 341-383): returns fits bool [N]."""
+    """The reference's sequential first-fit over every node: fits bool [N]."""
     cap = hosts["cap"]
     base_used = hosts["used"]
-    need = hosts["need"]
-    need_active = hosts["need_active"]
-    num_gpus = hosts["num_gpus"]
-    n_nodes, n_cards, n_res = base_used.shape
+    n_nodes = base_used.shape[0]
     fits = np.zeros(n_nodes, dtype=bool)
     for n in range(n_nodes):
-        used = base_used[n].copy()
-        ok = True
-        for t in range(len(num_gpus)):
-            for _g in range(int(num_gpus[t])):
-                placed = False
-                for c in range(n_cards):  # card_order == identity here
-                    fit = True
-                    for r in range(n_res):
-                        if not need_active[t, r]:
-                            continue
-                        if used[c, r] + need[t, r] > cap[n, r]:
-                            fit = False
-                            break
-                    if fit:
-                        for r in range(n_res):
-                            if need_active[t, r]:
-                                used[c, r] += need[t, r]
-                        placed = True
-                        break
-                if not placed:
-                    ok = False
-        fits[n] = ok
+        fits[n], _ = _host_fit_node(
+            base_used[n],
+            cap[n],
+            hosts["need"],
+            hosts["need_active"],
+            hosts["num_gpus"],
+        )
     return fits
 
 
@@ -302,6 +311,196 @@ def config3_gas_binpack_large(num_nodes: int = 4096) -> Dict:
     vectorized form pulls away (per-node host cost is linear; the batched
     evaluation is one program either way)."""
     return config3_gas_binpack(num_nodes=num_nodes)
+
+
+# -- config #4: fused TAS+GAS joint solve, 10k nodes x 1k pods --------------
+
+
+def _fused_problem(
+    num_nodes=10_000,
+    num_pods=1000,
+    num_cards=8,
+    num_res=3,
+    num_classes=3,
+    seed=21,
+):
+    """(tas_state, pods, req_class, gas_state, requests, max_gpus, hosts):
+    a joint problem — TAS metric state + per-pod scheduleonmetric rules
+    AND a per-card GAS usage tensor + T pod request classes."""
+    import jax.numpy as jnp
+
+    from platform_aware_scheduling_tpu.models.batch_scheduler import (
+        example_inputs,
+    )
+    from platform_aware_scheduling_tpu.models.fused import FusedRequests
+    from platform_aware_scheduling_tpu.ops.binpack import BinpackNodeState
+
+    rng = np.random.default_rng(seed)
+    state, pods = example_inputs(
+        num_metrics=4, num_nodes=num_nodes, num_pods=num_pods, seed=seed
+    )
+    cap = rng.integers(600, 1200, size=(num_nodes, num_res)).astype(np.int64)
+    used = rng.integers(0, 400, size=(num_nodes, num_cards, num_res)).astype(
+        np.int64
+    )
+    used = np.minimum(used, cap[:, None, :])
+    need = rng.integers(40, 260, size=(num_classes, 2, num_res)).astype(
+        np.int64
+    )
+    need_active = rng.random((num_classes, 2, num_res)) > 0.2
+    num_gpus = rng.integers(1, 3, size=(num_classes, 2)).astype(np.int32)
+    container_active = np.ones((num_classes, 2), dtype=bool)
+    req_class = rng.integers(0, num_classes, size=num_pods).astype(np.int32)
+    max_gpus = int(num_gpus.max())
+
+    gas = BinpackNodeState(
+        used=_i64_np(used),
+        capacity=_i64_np(cap),
+        cap_present=jnp.ones((num_nodes, num_res), dtype=bool),
+        card_valid=jnp.ones((num_nodes, num_cards), dtype=bool),
+        card_real=jnp.ones((num_nodes, num_cards), dtype=bool),
+        card_order=jnp.broadcast_to(
+            jnp.arange(num_cards, dtype=jnp.int32), (num_nodes, num_cards)
+        ),
+    )
+    requests = FusedRequests(
+        need=_i64_np(need),
+        need_active=jnp.asarray(need_active),
+        num_gpus=jnp.asarray(num_gpus),
+        container_active=jnp.asarray(container_active),
+    )
+    hosts = {
+        "cap": cap,
+        "used": used,
+        "need": need,
+        "need_active": need_active,
+        "num_gpus": num_gpus,
+    }
+    return state, pods, jnp.asarray(req_class), gas, requests, max_gpus, hosts
+
+
+def _host_fused_control(
+    state, pods, req_class, hosts, num_nodes: int, n_pods: int
+):
+    """The sequential TAS-then-GAS composition the reference deploys
+    (tas+gas-extender-configmap.yaml): per pod, TAS violation filter +
+    sort (telemetryscheduler.go:128-149), then walk nodes best-first and
+    take the first with pod capacity AND a first-fit card packing
+    (scheduler.go:200-257); book the cards.  Returns (assignment [P],
+    seconds)."""
+    m_hi = np.asarray(state.metric_values.hi).astype(np.int64)
+    m_lo = np.asarray(state.metric_values.lo).astype(np.int64)
+    matrix = (m_hi << 32) | m_lo
+    present = np.asarray(state.metric_present)
+    rules_row = np.asarray(state.dontschedule.metric_row)
+    rules_op = np.asarray(state.dontschedule.op_id)
+    t_hi = np.asarray(state.dontschedule.target.hi).astype(np.int64)
+    t_lo = np.asarray(state.dontschedule.target.lo).astype(np.int64)
+    rules_target = (t_hi << 32) | t_lo
+    rules_active = np.asarray(state.dontschedule.active)
+    capacity = list(np.asarray(state.capacity))
+    pod_rows = np.asarray(pods.metric_row)
+    pod_ops = np.asarray(pods.op_id)
+    candidates = np.asarray(pods.candidates)
+    classes = np.asarray(req_class)
+    cap = hosts["cap"]
+    used = hosts["used"].copy()
+    need = hosts["need"]
+    need_active = hosts["need_active"]
+    num_gpus = hosts["num_gpus"]
+
+    start = time.perf_counter()
+    violating = set()
+    for r in range(len(rules_row)):
+        if not rules_active[r]:
+            continue
+        row = rules_row[r]
+        for n in range(num_nodes):
+            if not present[row, n]:
+                continue
+            v = int(matrix[row, n])
+            t = int(rules_target[r])
+            op = int(rules_op[r])
+            if (op == 0 and v < t) or (op == 1 and v > t) or (op == 2 and v == t):
+                violating.add(n)
+    assignment = np.full(n_pods, -1, dtype=np.int64)
+    for p in range(n_pods):
+        row = pod_rows[p]
+        op = int(pod_ops[p])
+        cand = [
+            n
+            for n in range(num_nodes)
+            if candidates[p, n] and present[row, n] and n not in violating
+        ]
+        cand.sort(key=lambda n: int(matrix[row, n]), reverse=(op == 1))
+        t = int(classes[p])
+        for n in cand:
+            if capacity[n] <= 0:
+                continue
+            ok, new_used = _host_fit_node(
+                used[n], cap[n], need[t], need_active[t], num_gpus[t]
+            )
+            if ok:
+                used[n] = new_used
+                capacity[n] -= 1
+                assignment[p] = n
+                break
+    return assignment, time.perf_counter() - start
+
+
+def config4_fused(num_nodes: int = 10_000, num_pods: int = 1000) -> Dict:
+    """BASELINE config #4: the joint TAS+GAS fused solve at 10k x 1k,
+    device vs the sequential host composition; the device/host parity bit
+    is REPORTED in the result (exactness itself is pinned at multiple
+    shapes by tests/test_fused.py — a bench run never hides a divergence
+    behind an exception, it surfaces parity: false)."""
+    import jax
+    import jax.numpy as jnp
+
+    from platform_aware_scheduling_tpu.models.batch_scheduler import PendingPods
+    from platform_aware_scheduling_tpu.models.fused import fused_schedule
+
+    state, pods, req_class, gas, requests, max_gpus, hosts = _fused_problem(
+        num_nodes=num_nodes, num_pods=num_pods
+    )
+
+    # parity first: device assignment == sequential host TAS-then-GAS
+    out = fused_schedule(state, pods, req_class, gas, requests, max_gpus)
+    device_assign = np.asarray(out.node_for_pod).astype(np.int64)
+    host_assign, control_s = _host_fused_control(
+        state, pods, req_class, hosts, num_nodes, num_pods
+    )
+    parity = bool((device_assign == host_assign).all())
+
+    def make_jit(reps):
+        def loop_body(i, checksum):
+            rolled = PendingPods(
+                metric_row=pods.metric_row,
+                op_id=pods.op_id,
+                candidates=jnp.roll(pods.candidates, i, axis=1),
+            )
+            out = fused_schedule(
+                state, rolled, req_class, gas, requests, max_gpus
+            )
+            return checksum + jnp.sum(out.node_for_pod)
+
+        @jax.jit
+        def run():
+            return jax.lax.fori_loop(0, reps, loop_body, jnp.int32(0))
+
+        return run
+
+    device_s = _timed_chain(make_jit, reps=20)
+    return {
+        "scale": f"{num_nodes} nodes x {num_pods} pods, "
+        f"{hosts['used'].shape[1]} cards x {hosts['used'].shape[2]} res, "
+        f"{hosts['num_gpus'].shape[0]} request classes",
+        "device_ms_per_solve": round(device_s * 1e3, 3),
+        "control_ms_per_solve": round(control_s * 1e3, 3),
+        "speedup": round(control_s / device_s, 1),
+        "parity": parity,
+        "pods_assigned": int((host_assign >= 0).sum()),
+    }
 
 
 # -- config #5: streaming deschedule + Sinkhorn churn, 10k nodes ------------
@@ -437,21 +636,8 @@ def solver_surface(num_nodes: int = 10_000, num_pods: int = 1000) -> Dict:
 
 
 def _ring_main(nodes_per_shard: int, n_shards: int) -> None:
+    _force_cpu_mesh(n_shards)
     import jax
-
-    # the ambient axon sitecustomize pins jax_platforms to the real
-    # accelerator, which beats the JAX_PLATFORMS env — force the virtual
-    # CPU mesh before the backend initializes (same dance as
-    # __graft_entry__._ensure_devices)
-    try:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", max(n_shards, 1))
-    except RuntimeError:
-        pass
-    if len(jax.devices()) < n_shards:
-        raise RuntimeError(
-            f"need {n_shards} devices, have {len(jax.devices())}"
-        )
     import jax.numpy as jnp
 
     from platform_aware_scheduling_tpu.ops import i64
@@ -494,9 +680,11 @@ def _ring_main(nodes_per_shard: int, n_shards: int) -> None:
     print(json.dumps(results))
 
 
-def ring_cpu_mesh(nodes_per_shard: int = 512, n_shards: int = 8) -> Dict:
-    """Run the ring-vs-gather comparison in a subprocess with a virtual
-    8-device CPU mesh (the live process owns the TPU backend)."""
+def _subprocess_bench(mode: str, *args: int, timeout: int = 600) -> Dict:
+    """Run one of this module's ``--<mode>`` entries in a subprocess with a
+    virtual multi-device CPU mesh (the live process owns the TPU backend);
+    the LAST int arg is the shard count."""
+    n_shards = args[-1]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
@@ -504,24 +692,130 @@ def ring_cpu_mesh(nodes_per_shard: int = 512, n_shards: int = 8) -> Dict:
         + f" --xla_force_host_platform_device_count={n_shards}"
     ).strip()
     proc = subprocess.run(
-        [
-            sys.executable,
-            "-m",
-            "benchmarks.configs",
-            "--ring",
-            str(nodes_per_shard),
-            str(n_shards),
-        ],
+        [sys.executable, "-m", "benchmarks.configs", f"--{mode}"]
+        + [str(a) for a in args],
         capture_output=True,
         text=True,
         env=env,
-        timeout=600,
+        timeout=timeout,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
     if not line:
-        raise RuntimeError(f"ring bench produced no output: {proc.stderr[-500:]}")
+        raise RuntimeError(
+            f"{mode} bench produced no output: {proc.stderr[-500:]}"
+        )
     return json.loads(line)
+
+
+def ring_cpu_mesh(nodes_per_shard: int = 512, n_shards: int = 8) -> Dict:
+    """Ring-vs-gather comparison on a virtual 8-device CPU mesh."""
+    return _subprocess_bench("ring", nodes_per_shard, n_shards)
+
+
+def _force_cpu_mesh(n_shards: int) -> None:
+    """The ambient axon sitecustomize pins jax_platforms to the real
+    accelerator, which beats the JAX_PLATFORMS env — force the virtual
+    CPU mesh before the backend initializes."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", max(n_shards, 1))
+    except RuntimeError:
+        pass
+    if len(jax.devices()) < n_shards:
+        raise RuntimeError(
+            f"need {n_shards} devices, have {len(jax.devices())}"
+        )
+
+
+def _churn_mesh_main(nodes_per_shard: int, n_shards: int) -> None:
+    """config #5 on the mesh (VERDICT r4 #5): per tick, score/filter the
+    churned metric state and re-solve the pending set with the SHARDED
+    Sinkhorn engine (parallel/sharded.sharded_sinkhorn_assign), vs the
+    single-chip kernel on the same problem; objective parity asserted."""
+    _force_cpu_mesh(n_shards)
+    import jax
+    import jax.numpy as jnp
+
+    from platform_aware_scheduling_tpu.models.batch_scheduler import (
+        example_inputs,
+        score_and_filter,
+    )
+    from platform_aware_scheduling_tpu.ops import i64
+    from platform_aware_scheduling_tpu.ops.sinkhorn import (
+        sinkhorn_assign_kernel,
+        total_utility,
+    )
+    from platform_aware_scheduling_tpu.parallel.mesh import make_mesh
+    from platform_aware_scheduling_tpu.parallel.sharded import (
+        sharded_sinkhorn_assign,
+    )
+
+    num_nodes = nodes_per_shard * n_shards
+    num_pods = 256
+    ticks = 4
+    state, pods = example_inputs(
+        num_metrics=4, num_nodes=num_nodes, num_pods=num_pods, seed=13
+    )
+    mesh = make_mesh(n_node_shards=n_shards, n_pod_shards=1)
+
+    def churned(t):
+        return state._replace(
+            metric_values=i64.I64(
+                hi=jnp.roll(state.metric_values.hi, t, axis=1),
+                lo=jnp.roll(state.metric_values.lo, t, axis=1),
+            )
+        )
+
+    def mesh_tick(t):
+        _, score, eligible = score_and_filter(churned(t), pods)
+        assigned, _ = sharded_sinkhorn_assign(
+            mesh, score, eligible, state.capacity, iterations=20
+        )
+        return assigned
+
+    def single_tick(t):
+        _, score, eligible = score_and_filter(churned(t), pods)
+        out = sinkhorn_assign_kernel(
+            score, eligible, state.capacity, iterations=20
+        )
+        return out.assignment.node_for_pod
+
+    results: Dict = {}
+    for name, fn in (("mesh", mesh_tick), ("single", single_tick)):
+        np.asarray(fn(0))  # compile
+        t0 = time.perf_counter()
+        last = None
+        for t in range(ticks):
+            last = fn(t)
+            np.asarray(last)
+        results[f"{name}_ms_per_tick"] = round(
+            (time.perf_counter() - t0) / ticks * 1e3, 3
+        )
+        _, score, eligible = score_and_filter(churned(ticks - 1), pods)
+        results[f"{name}_objective"] = round(
+            float(total_utility(score, last)), 3
+        )
+        results[f"{name}_assigned"] = int((np.asarray(last) >= 0).sum())
+    results["objective_parity"] = (
+        abs(results["mesh_objective"] - results["single_objective"])
+        <= max(0.02 * abs(results["single_objective"]), 0.1)
+    )
+    results["scale"] = (
+        f"{n_shards} shards x {nodes_per_shard} nodes, {num_pods} pods/tick, "
+        f"sinkhorn-20 (cpu mesh)"
+    )
+    print(json.dumps(results))
+
+
+def churn_mesh_cpu8(nodes_per_shard: int = 256, n_shards: int = 8) -> Dict:
+    """config #5's churn engine on a virtual 8-device CPU mesh.  Like
+    ring_prioritize_cpu8 this is a structural check (collective pattern +
+    objective parity), not a TPU performance claim — virtual CPU-mesh
+    collectives are orders slower than ICI."""
+    return _subprocess_bench("churn-mesh", nodes_per_shard, n_shards)
 
 
 # -- entry ------------------------------------------------------------------
@@ -533,9 +827,11 @@ def run_all() -> Dict:
         ("config2_multi_metric_1k_100", config2_multi_metric),
         ("config3_gas_binpack_256x8", config3_gas_binpack),
         ("config3_gas_binpack_4096x8", config3_gas_binpack_large),
+        ("config4_fused_10k_1k", config4_fused),
         ("config5_churn_10k", config5_churn),
         ("solvers_1k_pods_10k_nodes", solver_surface),
         ("ring_prioritize_cpu8", ring_cpu_mesh),
+        ("config5_churn_mesh_cpu8", churn_mesh_cpu8),
     ):
         try:
             out[name] = fn()
@@ -547,5 +843,7 @@ def run_all() -> Dict:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--ring":
         _ring_main(int(sys.argv[2]), int(sys.argv[3]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--churn-mesh":
+        _churn_mesh_main(int(sys.argv[2]), int(sys.argv[3]))
     else:
         print(json.dumps(run_all(), indent=2))

@@ -107,6 +107,14 @@ def _fit_one_node(
     def per_container(carry, request_t):
         used, ok = carry
         need, need_active, num_gpus, active = request_t
+        # only resources PRESENT in the request are booked — the reference
+        # walks the request map (addRM over its keys, resource_map.go:38-55);
+        # an inactive lane must neither gate (handled in _card_fits) nor
+        # consume capacity here
+        booked_need = i64.I64(
+            hi=jnp.where(need_active, need.hi, jnp.int32(0)),
+            lo=jnp.where(need_active, need.lo, jnp.uint32(0)),
+        )
 
         def per_gpu(carry2, step):
             used2, ok2 = carry2
@@ -120,7 +128,8 @@ def _fit_one_node(
             book = wanted & fitted
             sel = (card_iota == chosen) & book  # [C]
             total = i64.add(
-                used2, i64.I64(hi=need.hi[None, :], lo=need.lo[None, :])
+                used2,
+                i64.I64(hi=booked_need.hi[None, :], lo=booked_need.lo[None, :]),
             )
             used2 = i64.select(sel[:, None], total, used2)
             ok2 = ok2 & (fitted | ~wanted)
@@ -132,13 +141,16 @@ def _fit_one_node(
         )
         return (used, ok_inner), picks
 
-    (_, ok), all_picks = jax.lax.scan(
+    (used_out, ok), all_picks = jax.lax.scan(
         per_container,
         (used, jnp.array(True)),
         (request.need, request.need_active, request.num_gpus,
          request.container_active),
     )
-    return ok, all_picks  # [T, K]
+    # used_out carries every booked share; meaningful when ok (the
+    # reference discards the scratch copy on failure, scheduler.go:247) —
+    # the fused solve gates on fits before applying it
+    return ok, all_picks, used_out  # [T, K], [C, R]
 
 
 @partial(jax.jit, static_argnames=("max_gpus",))
@@ -146,7 +158,7 @@ def binpack_kernel(
     state: BinpackNodeState, request: BinpackRequest, max_gpus: int
 ) -> BinpackResult:
     """Fit ``request`` against every node at once (the batched Filter)."""
-    fits, cards = jax.vmap(
+    fits, cards, _ = jax.vmap(
         lambda used, cap, cap_p, ok, order: _fit_one_node(
             used, cap, cap_p, ok, order, request, max_gpus
         )

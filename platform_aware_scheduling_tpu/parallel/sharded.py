@@ -1,6 +1,6 @@
 """Sharded scheduling kernels: shard_map over the ``nodes`` mesh axis.
 
-Three building blocks, each the multi-chip form of an ops/ kernel:
+Four building blocks, each the multi-chip form of an ops/ kernel:
 
   * :func:`sharded_violations` — rule evaluation is elementwise over nodes,
     so the sharded form needs NO collectives at all: each chip filters its
@@ -13,7 +13,16 @@ Three building blocks, each the multi-chip form of an ops/ kernel:
   * :func:`sharded_greedy_assign` — the sequential-in-pods greedy solve:
     each step reduces a per-shard lexicographic argmin, all_gathers the
     per-chip candidates (4 scalars per chip), and every chip deterministically
-    agrees on the winner; only the owning shard books the capacity.
+    agrees on the winner; only the owning shard books the capacity;
+  * :func:`sharded_sinkhorn_assign` — the mesh form of the Sinkhorn churn
+    engine (ops/sinkhorn.py, BASELINE config #5): the [P, N] logit matrix
+    stays node-sharded end to end; row normalizers are global
+    log-sum-exps built from one ``pmax`` (stability shift) + one ``psum``
+    (exp-sum) per iteration, column normalizers are purely local to each
+    shard's nodes, and the soft plan is rounded by the exact
+    :func:`sharded_greedy_assign` — so feasibility and determinism are
+    inherited from the exact solver while only guidance quality rides on
+    f32 collectives.
 """
 
 from __future__ import annotations
@@ -331,3 +340,104 @@ def sharded_greedy_assign(
 
     assigned, cap_left = _impl(score, eligible, capacity)
     return assigned[:num_pods], cap_left
+
+
+def sharded_sinkhorn_assign(
+    mesh: Mesh,
+    score: i64.I64,  # [P, N] node-sharded — larger is better
+    eligible,  # bool [P, N] node-sharded
+    capacity,  # int32 [N] node-sharded
+    iterations: int = 20,
+    tau: float = 0.05,
+    block_size: int = 32,
+):
+    """Mesh Sinkhorn-guided assignment (module doc): returns
+    (assigned [P] replicated, capacity_left [N] sharded).
+
+    Numerics note: the plan is the same entropic iteration as the
+    single-chip ``sinkhorn_assign_kernel`` — per-row utilities from
+    global pmin/pmax, row log-sum-exp via a pmax shift + psum of local
+    exp-sums, column scaling local per shard — but cross-shard f32
+    summation orders differ from the single-chip reduction, so guide
+    log-probabilities can differ in the last ulps.  The exact greedy
+    rounding re-masks eligibility and capacity, so the sharded result is
+    always feasible and deterministic; tests assert objective parity
+    with the single-chip kernel rather than bitwise equality
+    (tests/test_parallel.py)."""
+    from platform_aware_scheduling_tpu.ops.sinkhorn import NEG
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            i64.I64(hi=P(None, NODE_AXIS), lo=P(None, NODE_AXIS)),
+            P(None, NODE_AXIS),
+            P(NODE_AXIS),
+        ),
+        out_specs=(
+            i64.I64(hi=P(None, NODE_AXIS), lo=P(None, NODE_AXIS))
+        ),
+    )
+    def _guide(s, elig, cap):
+        # per-pod [0,1] utilities over the GLOBAL node axis (the sharded
+        # form of ops/sinkhorn._normalize_scores)
+        value = s.hi.astype(jnp.float32) * jnp.float32(2.0**32) + s.lo.astype(
+            jnp.float32
+        )
+        lo_v = jax.lax.pmin(
+            jnp.min(jnp.where(elig, value, jnp.inf), axis=1), NODE_AXIS
+        )[:, None]
+        hi_v = jax.lax.pmax(
+            jnp.max(jnp.where(elig, value, -jnp.inf), axis=1), NODE_AXIS
+        )[:, None]
+        span = jnp.maximum(hi_v - lo_v, jnp.float32(1.0))
+        utility = jnp.where(elig, (value - lo_v) / span, 0.0)
+        logits = jnp.where(elig, utility / jnp.float32(tau), NEG)
+        cap_f = cap.astype(jnp.float32)
+        any_local = jnp.any(elig, axis=1).astype(jnp.int32)
+        has_eligible = jax.lax.psum(any_local, NODE_AXIS) > 0  # [P]
+
+        def step(carry, _):
+            log_u, log_v = carry
+            # rows: global log-sum-exp = pmax shift + psum of exp-sums
+            x = logits + log_v[None, :]
+            m = jax.lax.pmax(jnp.max(x, axis=1), NODE_AXIS)  # [P]
+            expsum = jax.lax.psum(
+                jnp.sum(jnp.exp(x - m[:, None]), axis=1), NODE_AXIS
+            )
+            row_lse = m + jnp.log(expsum)
+            log_u = jnp.where(has_eligible, -row_lse, NEG)
+            # cols: each node's scaling is local to its shard
+            col_lse = jax.nn.logsumexp(logits + log_u[:, None], axis=0)
+            log_v = jnp.minimum(
+                jnp.log(jnp.maximum(cap_f, 1e-9)) - col_lse, 0.0
+            )
+            log_v = jnp.where(cap_f > 0, log_v, NEG)
+            return (log_u, log_v), None
+
+        p = elig.shape[0]
+        n_loc = elig.shape[1]
+        # log_v is per-node (varying over the shard axis); log_u is built
+        # from psums and stays replicated
+        init = (
+            jnp.zeros(p, jnp.float32),
+            jax.lax.pcast(
+                jnp.zeros(n_loc, jnp.float32), (NODE_AXIS,), to="varying"
+            ),
+        )
+        (log_u, log_v), _ = jax.lax.scan(step, init, None, length=iterations)
+        log_plan = logits + log_u[:, None] + log_v[None, :]
+        # identical quantization to the single-chip kernel: micro-nats in
+        # int32, sign-extended into the i64 limbs
+        guide = jnp.where(elig, log_plan, jnp.float32(NEG))
+        g_scaled = jnp.clip(guide * jnp.float32(1e6), -2.0e9, 2.0e9).astype(
+            jnp.int32
+        )
+        g_hi = jnp.where(g_scaled < 0, jnp.int32(-1), jnp.int32(0))
+        g_lo = jax.lax.bitcast_convert_type(g_scaled, jnp.uint32)
+        return i64.I64(hi=g_hi, lo=g_lo)
+
+    guide_scores = _guide(score, eligible, capacity)
+    return sharded_greedy_assign(
+        mesh, guide_scores, eligible, capacity, block_size=block_size
+    )
