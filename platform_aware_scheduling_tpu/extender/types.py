@@ -77,6 +77,28 @@ _ASCII_LOWER = str.maketrans(
 )
 
 
+def _require_obj(value, what: str):
+    """Go decode parity: a field typed as an object accepts an object or
+    null (null -> nil/zero value, returned as None); anything else is an
+    UnmarshalTypeError -> DecodeError here."""
+    if value is not None and not isinstance(value, dict):
+        raise DecodeError(f"error decoding request: {what} is not an object")
+    return value
+
+
+def _normalize_string_field(container: Dict[str, Any], key: str, what: str):
+    """Go decode parity for string-typed fields: strings pass, an explicit
+    null becomes the zero value "" (in place), anything else is a decode
+    error."""
+    value = container.get(key)
+    if value is None:
+        if key in container:
+            container[key] = ""
+        return
+    if not isinstance(value, str):
+        raise DecodeError(f"error decoding request: {what} is not a string")
+
+
 def _fold_keys(
     pairs, fields: Dict[str, str], nullable: frozenset = frozenset()
 ) -> Dict[str, Any]:
@@ -129,13 +151,61 @@ class Args:
             {"pod": "Pod", "nodes": "Nodes", "nodenames": "NodeNames"},
             nullable=frozenset({"Nodes", "NodeNames"}),
         )
-        pod = Pod(folded.get("Pod") or {})
-        nodes_obj = folded.get("Nodes")
+        # type-mismatched fields are Go decode errors (json.Unmarshal into
+        # the typed structs fails -> the empty-200 decode-failure quirk),
+        # not values to limp along with; an explicit null into a string
+        # field is Go's "no effect" -> the zero value "".  The native
+        # scanner rejects the same shapes, so both internal paths agree
+        # (tests/test_wire_fuzz.py).
+        pod_obj = _require_obj(folded.get("Pod"), "Pod") or {}
+        md = _require_obj(pod_obj.get("metadata"), "Pod metadata")
+        if md is not None:
+            _normalize_string_field(md, "name", "Pod name")
+            _normalize_string_field(md, "namespace", "Pod namespace")
+            labels = _require_obj(md.get("labels"), "Pod labels")
+            if labels is not None:
+                for key in labels:
+                    _normalize_string_field(labels, key, f"label {key!r}")
+        pod = Pod(pod_obj)
+        nodes_obj = _require_obj(folded.get("Nodes"), "Nodes")
         nodes = None
         if nodes_obj is not None:
             items = nodes_obj.get("items")
+            if items is not None and not isinstance(items, list):
+                raise DecodeError(
+                    "error decoding request: Nodes.items is not a list"
+                )
+            for item in items or []:
+                # a null list element is Go's zero-value Node (name "");
+                # any other non-object fails the decode
+                if item is None:
+                    continue
+                if not isinstance(item, dict):
+                    raise DecodeError(
+                        "error decoding request: node is not an object"
+                    )
+                imd = _require_obj(item.get("metadata"), "node metadata")
+                if imd is not None:
+                    _normalize_string_field(imd, "name", "node name")
             nodes = [Node(item) for item in (items or [])]
         node_names = folded.get("NodeNames")
+        if node_names is not None:
+            if not isinstance(node_names, list):
+                raise DecodeError(
+                    "error decoding request: NodeNames is not a list"
+                )
+            fixed = []
+            for entry in node_names:
+                if entry is None:
+                    fixed.append("")  # Go: null into string = zero value
+                elif not isinstance(entry, str):
+                    raise DecodeError(
+                        "error decoding request: NodeNames entry is not "
+                        "a string"
+                    )
+                else:
+                    fixed.append(entry)
+            node_names = fixed
         return cls(pod=pod, nodes=nodes, node_names=node_names)
 
     def to_json(self) -> bytes:

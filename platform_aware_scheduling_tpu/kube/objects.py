@@ -24,7 +24,18 @@ class KubeObject:
 
     @property
     def metadata(self) -> Dict[str, Any]:
-        return self.raw.setdefault("metadata", {})
+        # a JSON null under "metadata" is the Go zero value: decoding null
+        # into a struct field "has no effect", so the object behaves as if
+        # metadata were empty — normalize in place to keep setdefault-style
+        # mutation semantics for writers.  Other non-dict types are NOT
+        # masked: the wire decode rejects them up front (Args.from_json,
+        # matching Go's decode error), and internal objects should fail
+        # loudly rather than silently lose their metadata.
+        md = self.raw.get("metadata")
+        if md is None:
+            md = {}
+            self.raw["metadata"] = md
+        return md
 
     @property
     def name(self) -> str:

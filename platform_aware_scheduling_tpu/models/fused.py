@@ -49,7 +49,7 @@ from platform_aware_scheduling_tpu.models.batch_scheduler import (
     score_and_filter,
 )
 from platform_aware_scheduling_tpu.ops import i64
-from platform_aware_scheduling_tpu.ops.assign import UNASSIGNED, lex_argmin
+from platform_aware_scheduling_tpu.ops.assign import lex_argmin
 from platform_aware_scheduling_tpu.ops.binpack import (
     BinpackNodeState,
     BinpackRequest,

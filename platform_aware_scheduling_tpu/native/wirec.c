@@ -594,18 +594,22 @@ static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
         if (key.len == 4 && memcmp(kp, "name", 4) == 0) {
             if (sc->i < sc->n && sc->s[sc->i] == '"') {
                 if (scan_string(sc, &pa->pod_name) < 0) return -1;
-            } else {
-                /* last wins: a repeated key with a non-string value
-                 * replaces (clears) an earlier captured string */
+            } else if (sc->i < sc->n && sc->s[sc->i] == 'n') {
+                /* null into a string is Go's zero value "": a repeated
+                 * key's null clears an earlier captured string */
                 memset(&pa->pod_name, 0, sizeof(StrSlice));
-                if (skip_value(sc) < 0) return -1;
+                if (skip_literal(sc, "null", 4) < 0) return -1;
+            } else {
+                return fail("pod name not string");  /* Go decode error */
             }
         } else if (key.len == 9 && memcmp(kp, "namespace", 9) == 0) {
             if (sc->i < sc->n && sc->s[sc->i] == '"') {
                 if (scan_string(sc, &pa->pod_namespace) < 0) return -1;
-            } else {
+            } else if (sc->i < sc->n && sc->s[sc->i] == 'n') {
                 memset(&pa->pod_namespace, 0, sizeof(StrSlice));
-                if (skip_value(sc) < 0) return -1;
+                if (skip_literal(sc, "null", 4) < 0) return -1;
+            } else {
+                return fail("pod namespace not string");
             }
         } else if (key.len == 6 && memcmp(kp, "labels", 6) == 0) {
             /* scan the labels object for "telemetry-policy"; a repeated
@@ -629,20 +633,30 @@ static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
                     skip_ws(sc);
                     if (lkey.len == 16 &&
                         memcmp(sc->s + lkey.off, "telemetry-policy", 16) == 0) {
-                        /* non-string label values take the exact Python
-                         * path (status-code parity on absurd input) */
                         if (sc->i >= sc->n || sc->s[sc->i] != '"')
                             return fail("label not string");
                         if (scan_string(sc, &pa->policy_label) < 0) return -1;
                         pa->has_label = 1;
-                    } else if (skip_value(sc) < 0) return -1;
+                    } else {
+                        /* map[string]string: EVERY label value must be a
+                         * string or the Go decode fails — matched by the
+                         * exact path's from_json validation */
+                        if (sc->i >= sc->n || sc->s[sc->i] != '"')
+                            return fail("label not string");
+                        if (skip_value(sc) < 0) return -1;
+                    }
                     skip_ws(sc);
                     if (sc->i >= sc->n) return fail("unterminated labels");
                     if (sc->s[sc->i] == ',') { sc->i++; continue; }
                     if (sc->s[sc->i] == '}') { sc->i++; break; }
                     return fail("bad labels");
                 }
-            } else if (skip_value(sc) < 0) return -1;
+            } else if (sc->i < sc->n && sc->s[sc->i] == 'n') {
+                /* null labels: Go zero-value map (clears, no error) */
+                if (skip_literal(sc, "null", 4) < 0) return -1;
+            } else {
+                return fail("labels not object");
+            }
         } else {
             if (skip_value(sc) < 0) return -1;
         }
@@ -747,9 +761,15 @@ static int scan_node_item(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
             skip_ws(sc);
             if (sc->i >= sc->n) return fail("eof in node metadata");
             /* repeated "metadata" key: last wins — the new value replaces
-             * any name captured from an earlier occurrence */
+             * any name captured from an earlier occurrence.  null clears
+             * to the zero value; any other non-object is a decode error
+             * (as in Go), so the exact path owns the response */
             memset(&name, 0, sizeof(StrSlice));
-            if (sc->s[sc->i] == '{') {
+            if (sc->s[sc->i] == 'n') {
+                if (skip_literal(sc, "null", 4) < 0) return -1;
+            } else if (sc->s[sc->i] != '{') {
+                return fail("node metadata not object");
+            } else {
                 sc->i++;
                 skip_ws(sc);
                 if (sc->i < sc->n && sc->s[sc->i] == '}') { sc->i++; }
@@ -767,9 +787,13 @@ static int scan_node_item(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
                         memcmp(sc->s + mkey.off, "name", 4) == 0) {
                         if (sc->i < sc->n && sc->s[sc->i] == '"') {
                             if (scan_string(sc, &name) < 0) return -1;
-                        } else {
+                        } else if (sc->i < sc->n && sc->s[sc->i] == 'n') {
+                            /* null into a string: Go zero value "" */
                             memset(&name, 0, sizeof(StrSlice));
-                            if (skip_value(sc) < 0) return -1;
+                            if (skip_literal(sc, "null", 4) < 0) return -1;
+                        } else {
+                            /* Go: UnmarshalTypeError — decode fails */
+                            return fail("node name not string");
                         }
                     } else if (skip_value(sc) < 0) return -1;
                     skip_ws(sc);
@@ -778,7 +802,7 @@ static int scan_node_item(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
                     if (sc->s[sc->i] == '}') { sc->i++; break; }
                     return fail("bad node metadata");
                 }
-            } else if (skip_value(sc) < 0) return -1;
+            }
         } else {
             if (skip_value(sc) < 0) return -1;
         }
@@ -789,7 +813,15 @@ static int scan_node_item(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
         return fail("bad node");
     }
 done:
-    /* missing metadata.name encodes as empty slice at offset 0 */
+    /* a node item whose metadata carries no name (absent key, null name,
+     * or null metadata) is the Go zero value "" — a PRESENT empty name
+     * that participates in candidate matching exactly as the Python
+     * decode's Node({}).name == "" does (the round-5 differential fuzzer
+     * caught the old drop-it behavior diverging when "" is an interned
+     * node).  Non-string names fail the parse above, as in Go. */
+    if (!name.present) {
+        name.off = 0; name.len = 0; name.escaped = 0; name.present = 1;
+    }
     return push_name(sc, pa, cap, &name);
 }
 

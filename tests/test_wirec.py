@@ -586,9 +586,39 @@ class TestAdvisorFindings:
             b'"metadata": {}}]}}'
         )
         parsed = wirec.parse_prioritize(body)
-        # last-wins: the second metadata object has no name (None, the
-        # same encoding scan_node_item uses for a missing name)
-        assert parsed.node_names() == [None]
+        # last-wins: the second metadata object has no name, which is the
+        # Go zero value "" — exactly what the Python decode yields
+        # (Node({}).name == ""); the round-5 differential fuzzer caught
+        # the earlier drop-the-candidate behavior diverging
+        assert parsed.node_names() == [""]
+
+    def test_missing_name_is_empty_string_candidate(self):
+        """A {} node item (or null metadata / null name) participates as
+        the empty-named candidate on both paths — the Go zero value
+        (fuzzer-found divergence, fixed in scan_node_item)."""
+        parsed = wirec.parse_prioritize(
+            b'{"Nodes": {"items": [{}, {"metadata": null}, '
+            b'{"metadata": {"name": null}}]}}'
+        )
+        assert parsed.node_names() == ["", "", ""]
+
+    def test_type_mismatches_fail_parse_like_go(self):
+        """Go's json.Unmarshal fails the whole decode on type-mismatched
+        fields; the scanner rejects identically so the exact path (whose
+        from_json raises DecodeError -> the empty-200 quirk) owns the
+        response on both runs."""
+        import pytest
+
+        for body in (
+            b'{"Nodes": {"items": [{"metadata": {"name": 3}}]}}',
+            b'{"Nodes": {"items": [{"metadata": 3}]}}',
+            b'{"Pod": {"metadata": {"name": 3}}, "NodeNames": ["a"]}',
+            b'{"Pod": {"metadata": {"namespace": []}}, "NodeNames": ["a"]}',
+            b'{"Pod": {"metadata": {"labels": 3}}, "NodeNames": ["a"]}',
+            b'{"Pod": {"metadata": {"labels": {"x": 3}}}, "NodeNames": ["a"]}',
+        ):
+            with pytest.raises(ValueError):
+                wirec.parse_prioritize(body)
 
     @pytest.mark.parametrize(
         "bad",
