@@ -821,6 +821,13 @@ def churn_mesh_cpu8(nodes_per_shard: int = 256, n_shards: int = 8) -> Dict:
 # -- entry ------------------------------------------------------------------
 
 
+def filter_floor() -> Dict:
+    """Per-stage filter-floor decomposition (benchmarks/http_load.py)."""
+    from benchmarks import http_load
+
+    return http_load.filter_floor_breakdown()
+
+
 def run_all() -> Dict:
     out: Dict = {}
     for name, fn in (
@@ -832,6 +839,7 @@ def run_all() -> Dict:
         ("solvers_1k_pods_10k_nodes", solver_surface),
         ("ring_prioritize_cpu8", ring_cpu_mesh),
         ("config5_churn_mesh_cpu8", churn_mesh_cpu8),
+        ("filter_floor_breakdown", filter_floor),
     ):
         try:
             out[name] = fn()

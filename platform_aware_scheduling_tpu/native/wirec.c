@@ -633,17 +633,31 @@ static int scan_pod_metadata(Scan *sc, ParsedArgs *pa) {
                     skip_ws(sc);
                     if (lkey.len == 16 &&
                         memcmp(sc->s + lkey.off, "telemetry-policy", 16) == 0) {
-                        if (sc->i >= sc->n || sc->s[sc->i] != '"')
+                        if (sc->i < sc->n && sc->s[sc->i] == '"') {
+                            if (scan_string(sc, &pa->policy_label) < 0)
+                                return -1;
+                            pa->has_label = 1;
+                        } else if (sc->i < sc->n && sc->s[sc->i] == 'n') {
+                            /* null label value: Go zero value "" (the
+                             * exact path normalizes identically) */
+                            if (skip_literal(sc, "null", 4) < 0) return -1;
+                            memset(&pa->policy_label, 0, sizeof(StrSlice));
+                            pa->policy_label.present = 1;  /* "" */
+                            pa->has_label = 1;
+                        } else {
                             return fail("label not string");
-                        if (scan_string(sc, &pa->policy_label) < 0) return -1;
-                        pa->has_label = 1;
+                        }
                     } else {
-                        /* map[string]string: EVERY label value must be a
-                         * string or the Go decode fails — matched by the
-                         * exact path's from_json validation */
-                        if (sc->i >= sc->n || sc->s[sc->i] != '"')
+                        /* map[string]string: label values must be strings
+                         * (or null -> zero value ""); anything else fails
+                         * the Go decode — matched by from_json */
+                        if (sc->i < sc->n && sc->s[sc->i] == '"') {
+                            if (skip_value(sc) < 0) return -1;
+                        } else if (sc->i < sc->n && sc->s[sc->i] == 'n') {
+                            if (skip_literal(sc, "null", 4) < 0) return -1;
+                        } else {
                             return fail("label not string");
-                        if (skip_value(sc) < 0) return -1;
+                        }
                     }
                     skip_ws(sc);
                     if (sc->i >= sc->n) return fail("unterminated labels");

@@ -44,6 +44,29 @@ class TestHttpLoadHarness:
         # the reported p99 is the best (lowest) of the repeats
         assert entry["p99_ms"] == min(entry["repeat_p99_ms"])
 
+    def test_filter_floor_breakdown_small(self):
+        """The per-stage floor decomposition must produce every stage and
+        internally-consistent magnitudes (stages <= the whole verb +
+        slack) at tiny scale."""
+        import pytest
+
+        from platform_aware_scheduling_tpu.native import get_wirec
+
+        if get_wirec() is None:
+            pytest.skip("native scanner unavailable")
+        out = http_load.filter_floor_breakdown(num_nodes=64, reps=5)
+        for key in (
+            "parse_us",
+            "partition_encode_us",
+            "verb_total_us",
+            "nodes_hit_verb_us",
+            "control_filter_ms",
+            "http_floor_us",
+        ):
+            assert out[key] > 0, key
+        # the verb includes parse + partition/encode (plus probe overhead)
+        assert out["verb_total_us"] >= out["partition_encode_us"] * 0.5
+
     def test_control_default_sample_size(self):
         """The control default must stay >=100 and divisible by the c=8
         sweep (so per-worker splits do not shrink the sample)."""
