@@ -156,7 +156,7 @@ def _detail_path() -> str:
     return os.path.join(root, f"BENCH_DETAIL_r{n:02d}.json")
 
 
-def assemble_line(headline, load, configs_out):
+def assemble_line(headline, load, configs_out, gas=None):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
     LAST keys (driver tail-capture keeps the end of the line) — and the
@@ -173,6 +173,16 @@ def assemble_line(headline, load, configs_out):
         result["http_load"] = {"speedup": load["speedup"]}
     if configs_out is not None:
         result["configs"] = configs_out
+    if gas is not None:
+        detail["gas_filter"] = {
+            "device": gas.get("device"),
+            "control": gas.get("control"),
+        }
+        result["gas_filter"] = {
+            "num_nodes": gas.get("num_nodes"),
+            "speedup": gas.get("speedup"),
+            "speedup_p99_gas_filter": gas.get("speedup_p99_gas_filter"),
+        }
     if load is not None:
         # structural note: the filter MISS tier is ratio-capped independent
         # of implementation quality — the filter control skips the sort
@@ -215,6 +225,20 @@ def main():
     except Exception as exc:  # the HTTP bench must never sink the headline
         print(f"http_load failed: {exc}", file=sys.stderr)
 
+    # --- GAS device path through the wire (benchmarks/gas_load.py) ---
+    gas = None
+    try:
+        from benchmarks import gas_load
+
+        gas = gas_load.run(num_nodes=2000)
+        print(
+            f"gas_filter: p99 speedup {gas['speedup_p99_gas_filter']}x "
+            f"at {gas['num_nodes']} nodes",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"gas_load failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -224,7 +248,7 @@ def main():
     except Exception as exc:  # config benches must never sink the headline
         print(f"config benches failed: {exc}", file=sys.stderr)
 
-    result, detail = assemble_line(headline, load, configs_out)
+    result, detail = assemble_line(headline, load, configs_out, gas)
     # the line FIRST — nothing after this point may sink the headline
     print(json.dumps(result))
     if detail:

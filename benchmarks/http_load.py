@@ -288,24 +288,28 @@ def _configs(concurrency_sweep) -> List[tuple]:
     return rows
 
 
-def _serve_forever(num_nodes: int, device: bool) -> None:
+def _serve_forever(num_nodes: int, device: bool, builder=None) -> None:
     """Subprocess entry: start the service, print ``READY <port>``, block.
     The server gets its own process (and GIL) — in-process serving would
     let the measuring threads contend with the handler threads and charge
-    the contention to the server under test.
+    the contention to the server under test.  ``builder`` defaults to the
+    TAS service; benchmarks/gas_load.py reuses this with its own.
 
     GC posture (applies to BOTH sides of the A/B): the same serving
     tuning the production mains apply (utils/gctuning.py)."""
     from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 
-    server, _ = build_service(num_nodes, device=device)
+    server, _ = (builder or build_service)(num_nodes, device=device)
     tune_for_serving()
     print(f"READY {server.port}", flush=True)
     threading.Event().wait()
 
 
-def _spawn_service(num_nodes: int, device: bool) -> tuple:
-    """(process, port) for an isolated service subprocess."""
+def _spawn_service(
+    num_nodes: int, device: bool, module: str = "benchmarks.http_load"
+) -> tuple:
+    """(process, port) for an isolated service subprocess running
+    ``python -m <module> --serve`` (shared by the GAS A/B)."""
     import subprocess
     import sys
 
@@ -313,15 +317,15 @@ def _spawn_service(num_nodes: int, device: bool) -> tuple:
         [
             sys.executable,
             "-m",
-            "benchmarks.http_load",
+            module,
             "--serve",
             str(num_nodes),
             "1" if device else "0",
         ],
         stdout=subprocess.PIPE,
         text=True,
-        # resolve `-m benchmarks.http_load` from the repo root regardless
-        # of the caller's cwd (bench.py supports being launched anywhere)
+        # resolve `-m benchmarks.*` from the repo root regardless of the
+        # caller's cwd (bench.py supports being launched anywhere)
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     line = proc.stdout.readline().strip()

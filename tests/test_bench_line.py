@@ -81,6 +81,20 @@ class TestBenchLine:
         # no http_load data -> no filter_miss caveat about it
         assert "notes" not in result
 
+    def test_gas_section_compact_in_line(self):
+        gas = {
+            "num_nodes": 2000,
+            "device": {"gas_filter_c1": {"p50_ms": 1.0, "p99_ms": 2.0}},
+            "control": {"gas_filter_c1": {"p50_ms": 30.0, "p99_ms": 40.0}},
+            "speedup": {"gas_filter_c1": {"p50": 30.0, "p99": 20.0}},
+            "speedup_p99_gas_filter": 20.0,
+        }
+        result, detail = bench.assemble_line(HEADLINE, None, None, gas)
+        assert result["gas_filter"]["speedup_p99_gas_filter"] == 20.0
+        assert "device" not in result["gas_filter"]
+        assert detail["gas_filter"]["device"]
+        assert list(result)[-4:] == ["metric", "value", "unit", "vs_baseline"]
+
     def test_absent_aliases_are_omitted(self):
         load = _fake_load()  # has no *_c8 aliases (c1-only sweep)
         result, _ = bench.assemble_line(HEADLINE, load, None)

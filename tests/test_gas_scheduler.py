@@ -401,6 +401,84 @@ class TestUsageMirrorSync:
         finally:
             cache.stop()
 
+    def test_fits_cache_invalidated_by_booking(self):
+        """The per-(state version, template) fits cache must never serve
+        stale fits: a booking bumps the mirror version, so a repeated
+        identical request re-evaluates and sees the node full."""
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1", cards=1, i915=1, millicores=1000))
+        cache = Cache(kube, start=False)
+        ext = GASExtender(kube, cache=cache, use_device=True, use_mirror=True)
+        cache.start()
+        try:
+            # two identical requests: second is a cache hit, same verdict
+            assert wait_until(
+                lambda: self._filter_names(ext, ["n1"], millicores="800")[
+                    "NodeNames"
+                ] == ["n1"]
+            )
+            assert self._filter_names(ext, ["n1"], millicores="800")[
+                "NodeNames"
+            ] == ["n1"]
+            packer = ext._device
+            assert len(packer._fits_cache) == 1
+            # book 800 of 1000 millicores -> the same template no longer fits
+            booked = gpu_pod("booked", millicores="800", node_name="n1")
+            kube.add_pod(booked)
+            cache.adjust_pod_resources_locked(booked, True, "card0", "n1")
+            out = self._filter_names(ext, ["n1"], millicores="800")
+            assert "n1" in out["FailedNodes"]
+        finally:
+            cache.stop()
+
+    def test_unknown_request_resource_after_snapshot(self):
+        """Interning a never-seen request resource must invalidate the
+        memoized snapshot: before the fix the old state (too-small r_pad)
+        made stage_request index out of bounds until the next cluster
+        event, forcing host fallback on every such request."""
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1"))
+        cache = Cache(kube, start=False)
+        ext = GASExtender(kube, cache=cache, use_device=True, use_mirror=True)
+        cache.start()
+        try:
+            # memoize the snapshot at the current version
+            assert wait_until(
+                lambda: self._filter_names(ext, ["n1"])["NodeNames"] == ["n1"]
+            )
+            pod = gpu_pod("probe2").raw
+            pod["spec"]["containers"][0]["resources"]["requests"][
+                "gpu.intel.com/never-seen"
+            ] = "1"
+            from platform_aware_scheduling_tpu.kube.objects import Pod
+
+            fits = ext._device.batch_fit(Pod(pod), ["n1"])
+            # no node carries the resource -> no fit; the point is the
+            # device path answered (no IndexError -> host fallback)
+            assert fits == [False]
+        finally:
+            cache.stop()
+
+    def test_fits_cache_distinguishes_templates(self):
+        """Different pod templates under one state version get separate
+        cache entries with different verdicts."""
+        kube = FakeKubeClient()
+        kube.add_node(gpu_node("n1", cards=1, i915=1, millicores=1000))
+        cache = Cache(kube, start=False)
+        ext = GASExtender(kube, cache=cache, use_device=True, use_mirror=True)
+        cache.start()
+        try:
+            assert wait_until(
+                lambda: self._filter_names(ext, ["n1"], millicores="500")[
+                    "NodeNames"
+                ] == ["n1"]
+            )
+            out = self._filter_names(ext, ["n1"], millicores="5000")
+            assert "n1" in out["FailedNodes"]
+            assert len(ext._device._fits_cache) == 2
+        finally:
+            cache.stop()
+
     def test_node_delete_prefails(self):
         kube = FakeKubeClient()
         kube.add_node(gpu_node("n1"))

@@ -67,6 +67,23 @@ class TestHttpLoadHarness:
         # the verb includes parse + partition/encode (plus probe overhead)
         assert out["verb_total_us"] >= out["partition_encode_us"] * 0.5
 
+    def test_gas_load_small(self):
+        """The GAS wire A/B harness end to end at tiny scale: both sides
+        serve, speedups and the alias are produced."""
+        from benchmarks import gas_load
+
+        out = gas_load.run(
+            num_nodes=24,
+            device_requests=6,
+            control_requests=6,
+            concurrency_sweep=(1,),
+            warmup=1,
+            repeats=1,
+        )
+        assert out["speedup_p99_gas_filter"] > 0
+        assert "gas_filter_c1" in out["device"]
+        assert "gas_filter_c8" not in out["device"]
+
     def test_control_default_sample_size(self):
         """The control default must stay >=100 and divisible by the c=8
         sweep (so per-worker splits do not shrink the sample)."""
