@@ -210,23 +210,67 @@ def run_policy_churn():
     print("PASS policy add/delete churn (5 rounds)")
 
 
+def collect_tas_logs():
+    """[(pod name, log text)] for every TAS pod — shared by the failure
+    dump and the golden-capture refresh."""
+    pods = kubectl(
+        "get", "pods", "-n", NAMESPACE, "-l", "app=tas",
+        "-o", "jsonpath={.items[*].metadata.name}",
+    ).split()
+    return [
+        (name, kubectl("logs", "-n", NAMESPACE, name, check=False))
+        for name in pods
+    ]
+
+
 def dump_tas_log():
     try:
-        pods = kubectl(
-            "get", "pods", "-n", NAMESPACE, "-l", "app=tas",
-            "-o", "jsonpath={.items[*].metadata.name}",
-        ).split()
-        for name in pods:
+        for name, log in collect_tas_logs():
             print(f"--- log: {name} ---", file=sys.stderr)
-            print(
-                kubectl("logs", "-n", NAMESPACE, name, check=False),
-                file=sys.stderr,
-            )
+            print(log, file=sys.stderr)
     except Exception as exc:  # log dump must never mask the real failure
         print(f"log dump failed: {exc}", file=sys.stderr)
 
 
+def refresh_goldens(capture_dir):
+    """Pull the TAS --v=5 wire log and turn it into golden fixture files
+    (tests/golden/from_capture.py): a passing e2e run auto-produces the
+    REAL kube-scheduler request/response pairs the golden suite and the
+    differential wire fuzzer (tests/test_wire_fuzz.py) are pinned
+    against.  Review the extracted pairs and commit the representative
+    ones into tests/golden/."""
+    import os
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    os.makedirs(capture_dir, exist_ok=True)
+    log_path = os.path.join(capture_dir, "tas.log")
+    logs = collect_tas_logs()
+    with open(log_path, "w") as f:
+        for _name, log in logs:
+            f.write(log)
+    out_dir = os.path.join(capture_dir, "golden")
+    sh(
+        sys.executable,
+        os.path.join(repo_root, "tests", "golden", "from_capture.py"),
+        log_path,
+        out_dir,
+    )
+    extracted = sorted(os.listdir(out_dir)) if os.path.isdir(out_dir) else []
+    print(
+        f"golden refresh: {len(extracted)} files extracted to {out_dir} "
+        f"from {len(logs)} pod log(s)"
+    )
+
+
 def main():
+    capture_dir = None
+    if "--capture-dir" in sys.argv:
+        at = sys.argv.index("--capture-dir")
+        if at + 1 >= len(sys.argv):
+            raise SystemExit("usage: run_e2e.py [--capture-dir DIR]")
+        capture_dir = sys.argv[at + 1]
     wait_for_metrics()
     try:
         run_filter_scenario()
@@ -236,6 +280,11 @@ def main():
     except Exception:
         dump_tas_log()
         raise
+    if capture_dir:
+        try:
+            refresh_goldens(capture_dir)
+        except Exception as exc:  # refresh is additive, never fails the run
+            print(f"golden refresh failed: {exc}", file=sys.stderr)
     print("e2e: all scenarios passed")
 
 

@@ -13,6 +13,10 @@
 set -euo pipefail
 
 CLUSTER=${CLUSTER:-pas-tpu-e2e}
+# pinnable from workflow_dispatch: a specific kindest/node image (i.e. a
+# specific kubernetes version) and the TAS image tag under test
+KIND_NODE_IMAGE=${KIND_NODE_IMAGE:-}
+TAS_IMAGE=${TAS_IMAGE:-pas-tpu-tas}
 SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 REPO_ROOT=$(cd "$SCRIPT_DIR/../.." && pwd)
 # unpredictable mktemp dir (a fixed /tmp path could be pre-created or
@@ -51,7 +55,14 @@ EOF
 }
 
 create_cluster() {
-  cat <<EOF | kind create cluster --name "$CLUSTER" --config=-
+  local image_flag=()
+  if [ -n "$KIND_NODE_IMAGE" ]; then
+    image_flag=(--image "$KIND_NODE_IMAGE")
+  fi
+  # ${arr[@]+...} form: expanding an empty array under set -u aborts on
+  # bash < 4.4 (macOS system bash)
+  cat <<EOF | kind create cluster --name "$CLUSTER" \
+    ${image_flag[@]+"${image_flag[@]}"} --config=-
 kind: Cluster
 apiVersion: kind.x-k8s.io/v1alpha4
 kubeadmConfigPatches:
@@ -101,8 +112,8 @@ install_metrics_pipeline() {
 
 deploy_tas() {
   docker build -f "$REPO_ROOT/deploy/images/Dockerfile.tas" \
-    -t pas-tpu-tas "$REPO_ROOT"
-  kind load docker-image pas-tpu-tas --name "$CLUSTER"
+    -t "$TAS_IMAGE" "$REPO_ROOT"
+  kind load docker-image "$TAS_IMAGE" --name "$CLUSTER"
   kubectl apply -f "$REPO_ROOT/deploy/tas/tas-policy-crd.yaml"
   kubectl apply -f "$REPO_ROOT/deploy/tas/tas-rbac.yaml"
   # fixed ClusterIP so the host-network kube-scheduler reaches the
@@ -121,7 +132,8 @@ EOF
   # level (--v=5) so the CI wire-capture artifact holds real
   # request/response pairs for tests/golden/ refresh
   kubectl apply -f - <<EOF
-$(sed 's/--cert=.*/--unsafe/; /--key=\|--cacert=/d; s/--v=2/--v=5/' \
+$(sed "s/--cert=.*/--unsafe/; /--key=\|--cacert=/d; s/--v=2/--v=5/; \
+s|image: pas-tpu-tas|image: $TAS_IMAGE|" \
     "$REPO_ROOT/deploy/tas/tas-deployment.yaml")
 EOF
 }

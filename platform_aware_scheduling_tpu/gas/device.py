@@ -274,25 +274,33 @@ class DeviceBinpacker:
 
     # -- persistent-mirror path ------------------------------------------------
 
-    def _all_rows_fits(self, state, request, k_pad, signature) -> np.ndarray:
+    def _all_rows_fits(self, state, signature, compute) -> np.ndarray:
         """fits over ALL interned rows for this (state, request template),
-        served from the MRU cache when the burst repeats the template."""
+        served from the MRU cache when the burst repeats the template;
+        ``compute`` runs only on a miss (a hit skips request staging and
+        the kernel entirely)."""
         with self._fits_lock:
             for idx, entry in enumerate(self._fits_cache):
                 if entry[0] is state and entry[1] == signature:
                     if idx:
                         self._fits_cache.insert(0, self._fits_cache.pop(idx))
                     return entry[2]
-        fits = np.asarray(binpack_kernel(state, request, k_pad).fits)
+        fits = compute()
+        # purge relative to the mirror's CURRENT memoized state, not this
+        # call's: a straggler that snapshotted a superseded state must not
+        # evict fresh entries or insert one that can never hit again
+        # (superseded-state entries would only pin full-cluster device
+        # arrays; snapshot returns ONE state object per mirror version)
+        with self.mirror._lock:
+            dev = self.mirror._device
+            current = dev[1] if dev is not None else state
         with self._fits_lock:
-            # entries keyed on a superseded state can never hit again
-            # (snapshot returns ONE state object per mirror version) —
-            # drop them so they stop pinning full-cluster device arrays
             self._fits_cache = [
-                entry for entry in self._fits_cache if entry[0] is state
+                entry for entry in self._fits_cache if entry[0] is current
             ]
-            self._fits_cache.insert(0, [state, signature, fits])
-            del self._fits_cache[self.FITS_CACHE_SIZE:]
+            if state is current:
+                self._fits_cache.insert(0, [state, signature, fits])
+                del self._fits_cache[self.FITS_CACHE_SIZE:]
         return fits
 
     def _fit_mirror(self, requests, shares, resources, node_names):
@@ -301,15 +309,25 @@ class DeviceBinpacker:
             for name in resources:  # unknown request resources: intern (all-absent)
                 mirror._intern_resource(name)
             state, node_index, known, has_gpus, res_index = mirror.snapshot()
-        r_pad = state.capacity.hi.shape[-1]
-        request, k_pad = stage_request(requests, shares, res_index, r_pad)
+        max_gpus = max((k for _, k in shares), default=0)
+        k_pad = _bucket(max(max_gpus, 1), MIN_GPUS)
         signature = (
             tuple(
                 (tuple(sorted(per_gpu.items())), k) for per_gpu, k in shares
             ),
             k_pad,
         )
-        fits_all = self._all_rows_fits(state, request, k_pad, signature)
+
+        def compute() -> np.ndarray:
+            r_pad = state.capacity.hi.shape[-1]
+            request, staged_k_pad = stage_request(
+                requests, shares, res_index, r_pad
+            )
+            return np.asarray(
+                binpack_kernel(state, request, staged_k_pad).fits
+            )
+
+        fits_all = self._all_rows_fits(state, signature, compute)
         out = [False] * len(node_names)
         for pos, name in enumerate(node_names):
             row = node_index.get(name)

@@ -145,6 +145,15 @@ class TestE2E:
         kube, cache, server, _ = cluster
         kube.create_taspolicy(demo_policy())
         assert policy_ready(kube, server, "e2e-policy")
+        # policy_ready proves the FILTER metric is pulled; the
+        # scheduleonmetric rule uses a different metric that can land a
+        # refresh tick later — wait for a non-empty answer like the
+        # reference's waitForMetrics does before asserting contents
+        assert wait_until(
+            lambda: json.loads(
+                call(server, "prioritize", sched_args("e2e-policy"))[1]
+            )
+        )
         status, body = call(server, "prioritize", sched_args("e2e-policy"))
         assert status == 200
         out = json.loads(body)
