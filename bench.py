@@ -183,6 +183,10 @@ def assemble_line(headline, load, configs_out, gas=None):
             "speedup": gas.get("speedup"),
             "speedup_p99_gas_filter": gas.get("speedup_p99_gas_filter"),
         }
+        if "baseline_shape_256" in gas:
+            result["gas_filter"]["baseline_shape_256"] = gas[
+                "baseline_shape_256"
+            ]
     if load is not None:
         # structural note: the filter MISS tier is ratio-capped independent
         # of implementation quality — the filter control skips the sort
@@ -225,7 +229,9 @@ def main():
     except Exception as exc:  # the HTTP bench must never sink the headline
         print(f"http_load failed: {exc}", file=sys.stderr)
 
-    # --- GAS device path through the wire (benchmarks/gas_load.py) ---
+    # --- GAS device path through the wire (benchmarks/gas_load.py):
+    # primary at 2k nodes + the BASELINE config-#3 shape (256 x 8) so the
+    # wire-path number exists at the scale BASELINE names (r4 weak #3)
     gas = None
     try:
         from benchmarks import gas_load
@@ -238,6 +244,23 @@ def main():
         )
     except Exception as exc:  # must never sink the headline
         print(f"gas_load failed: {exc}", file=sys.stderr)
+    if gas is not None:
+        try:  # secondary shape: its failure must not discard the primary
+            small = gas_load.run(
+                num_nodes=256, concurrency_sweep=(1,), repeats=1
+            )
+            gas["baseline_shape_256"] = {
+                "speedup": small["speedup"],
+                "device_p99_ms": small["device"]["gas_filter_c1"]["p99_ms"],
+                "control_p99_ms": small["control"]["gas_filter_c1"]["p99_ms"],
+            }
+            print(
+                f"gas_filter 256-node shape: "
+                f"{small['speedup_p99_gas_filter']}x",
+                file=sys.stderr,
+            )
+        except Exception as exc:
+            print(f"gas_load 256-node shape failed: {exc}", file=sys.stderr)
 
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None

@@ -342,6 +342,113 @@ def sharded_greedy_assign(
     return assigned[:num_pods], cap_left
 
 
+def sharded_auction_assign(
+    mesh: Mesh,
+    score: i64.I64,  # [P, N] node-sharded — larger is better
+    eligible,  # bool [P, N] node-sharded
+    capacity,  # int32 [N] node-sharded
+):
+    """Mesh form of ``auction_assign_kernel`` — EXACTLY the single-chip
+    (and therefore the sequential greedy) result.
+
+    Per fixpoint round every shard computes each pod's best local lane
+    (three masked reductions), the per-shard candidates — key limbs,
+    global index, found — cross the mesh in one small all_gather, and
+    every chip deterministically reduces the same global winner per pod.
+    Capacity pressure ("room") is evaluated shard-locally: the exclusive
+    per-pod count of holds on each node only needs the replicated choice
+    vector mapped into the shard's own lane range.  Collectives per
+    round: ONE all_gather of 4x[P] scalars, vs gathering the full [P, N]
+    score matrix."""
+    num_pods = score.hi.shape[0]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            i64.I64(hi=P(None, NODE_AXIS), lo=P(None, NODE_AXIS)),
+            P(None, NODE_AXIS),
+            P(NODE_AXIS),
+        ),
+        out_specs=(P(), P(NODE_AXIS)),
+        # choice is replicated by construction (every chip reduces the
+        # same gathered candidates); the static check can't see that
+        check_vma=False,
+    )
+    def _impl(s, elig, cap):
+        n_loc = cap.shape[-1]
+        shard = jax.lax.axis_index(NODE_AXIS)
+        offset = (shard * n_loc).astype(jnp.int32)
+        iota_loc = jnp.arange(n_loc, dtype=jnp.int32)
+        neg_hi = jnp.int32(-(2**31))
+        big_idx = jnp.int32(2**30)
+
+        def count_below_local(choice):
+            """Exclusive count of holds by lower-index pods on THIS
+            shard's lanes (auction_assign_kernel.count_below, local);
+            one_hot maps out-of-shard/unassigned choices to all-zero
+            rows, same as the single-chip kernel."""
+            onehot = jax.nn.one_hot(choice - offset, n_loc, dtype=jnp.int32)
+            csum = jnp.cumsum(onehot, axis=0)
+            return csum - onehot  # [P, n_loc]
+
+        def body(state):
+            choice, _changed = state
+            room = count_below_local(choice) < cap[None, :]
+            ok = elig & room
+            hi = jnp.where(ok, s.hi, neg_hi)
+            m_hi = jnp.max(hi, axis=-1)
+            on_hi = ok & (s.hi == m_hi[:, None])
+            lo = jnp.where(on_hi, s.lo, jnp.uint32(0))
+            m_lo = jnp.max(lo, axis=-1)
+            on_lo = on_hi & (s.lo == m_lo[:, None])
+            idx = jnp.min(
+                jnp.where(on_lo, iota_loc[None, :] + offset, big_idx),
+                axis=-1,
+            )
+            found = jnp.any(ok, axis=-1)
+            # ONE gather of the stacked per-shard candidates ([P, 4])
+            payload = jnp.stack(
+                [
+                    jnp.where(found, m_hi, neg_hi),
+                    jax.lax.bitcast_convert_type(
+                        jnp.where(found, m_lo, jnp.uint32(0)), jnp.int32
+                    ),
+                    jnp.where(found, idx, big_idx),
+                    found.astype(jnp.int32),
+                ],
+                axis=-1,
+            )
+            gathered = jax.lax.all_gather(payload, NODE_AXIS)  # [D, P, 4]
+            g_hi = gathered[..., 0]
+            g_lo = jax.lax.bitcast_convert_type(
+                gathered[..., 1], jnp.uint32
+            )
+            g_idx = gathered[..., 2]
+            g_found = gathered[..., 3] > 0
+            w_hi = jnp.max(g_hi, axis=0)  # [P]
+            on_whi = g_found & (g_hi == w_hi[None, :])
+            w_lo = jnp.max(jnp.where(on_whi, g_lo, jnp.uint32(0)), axis=0)
+            on_wlo = on_whi & (g_lo == w_lo[None, :])
+            winner = jnp.min(jnp.where(on_wlo, g_idx, big_idx), axis=0)
+            any_found = jnp.any(g_found, axis=0)
+            new_choice = jnp.where(any_found, winner, UNASSIGNED)
+            return new_choice, jnp.any(new_choice != choice)
+
+        init = (jnp.full(num_pods, UNASSIGNED, dtype=jnp.int32),
+                jnp.array(True))
+        # the first body evaluation IS the single-chip init (all-UNASSIGNED
+        # choices put zero pressure on capacity, so room == cap > 0); the
+        # fixpoint sequence is then identical round for round
+        choice, _ = jax.lax.while_loop(lambda st: st[1], body, init)
+        taken = jnp.sum(
+            jax.nn.one_hot(choice - offset, n_loc, dtype=cap.dtype), axis=0
+        )  # out-of-shard/unassigned rows are all-zero
+        return choice, cap - taken
+
+    return _impl(score, eligible, capacity)
+
+
 def sharded_sinkhorn_assign(
     mesh: Mesh,
     score: i64.I64,  # [P, N] node-sharded — larger is better
