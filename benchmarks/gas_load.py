@@ -196,6 +196,19 @@ def run(
     out["speedup"] = speedups
     c0 = concurrency_sweep[0]
     out["speedup_p99_gas_filter"] = speedups[f"gas_filter_c{c0}"]["p99"]
+    # measurement transparency (same spirit as the TAS miss tier): the
+    # device side amortizes ONE binpack dispatch per (usage-state
+    # version, pod template) across the burst (gas/device.py fits
+    # cache); requests here rotate pod names within one template, the
+    # kube-scheduler burst pattern.  A template/state miss re-pays the
+    # kernel — sub-ms on-chip (configs config3's chained measurement) —
+    # plus, in THIS environment only, a ~100 ms tunnel RTT that
+    # production TPU hosts don't have.
+    out["notes"] = (
+        "device amortizes one kernel dispatch per (state version, pod "
+        "template) across the burst; cold template cost = config3 kernel "
+        "time + dispatch"
+    )
     return out
 
 
