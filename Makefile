@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-configs lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs lint image clean dryrun
 
 all: test
 
@@ -24,7 +24,11 @@ bench:
 bench-http:
 	python -m benchmarks.http_load
 
-# BASELINE configs #2/#3/#5 + solver surface alone
+# GAS wire A/B alone
+bench-gas:
+	python -m benchmarks.gas_load
+
+# BASELINE configs #2/#3/#4/#5 + solver surface + mesh checks alone
 bench-configs:
 	python -m benchmarks.configs
 
