@@ -807,6 +807,11 @@ def _churn_mesh_main(nodes_per_shard: int, n_shards: int) -> None:
         f"{n_shards} shards x {nodes_per_shard} nodes, {num_pods} pods/tick, "
         f"sinkhorn-20 (cpu mesh)"
     )
+    results["notes"] = (
+        "structural check (collective pattern + objective parity) on the "
+        "virtual CPU mesh; not a TPU performance claim — CPU-mesh "
+        "collectives are orders slower than ICI"
+    )
     print(json.dumps(results))
 
 
