@@ -307,6 +307,11 @@ class BindingArgs:
                 "node": "Node",
             },
         )
+        # Go decode parity, as in Args.from_json: every field is a string
+        # (null has no effect and was already dropped by _fold_keys for
+        # these value-typed fields); anything else fails the decode
+        for key in folded:
+            _normalize_string_field(folded, key, key)
         return cls(
             pod_name=folded.get("PodName", ""),
             pod_namespace=folded.get("PodNamespace", ""),

@@ -18,6 +18,7 @@ from platform_aware_scheduling_tpu.extender.types import (
     Args,
     BindingArgs,
     BindingResult,
+    DecodeError,
     FilterResult,
     HostPriority,
     decode_host_priority_list,
@@ -158,6 +159,20 @@ class TestWireTypes:
         ).encode())
         assert (args.pod_name, args.pod_namespace, args.pod_uid, args.node) == (
             "p", "ns", "u1", "n1")
+
+    def test_binding_args_type_mismatch_is_decode_error(self):
+        """Go decode parity: non-string Bind fields fail the whole decode
+        (null into a value-typed string field has no effect and keeps the
+        zero value)."""
+        for body in (
+            b'{"PodName": 3, "Node": "n"}',
+            b'{"podUID": ["u"], "Node": "n"}',
+            b'{"Node": {"name": "n"}}',
+        ):
+            with pytest.raises(DecodeError):
+                BindingArgs.from_json(body)
+        args = BindingArgs.from_json(b'{"PodName": null, "Node": "n"}')
+        assert (args.pod_name, args.node) == ("", "n")
 
     def test_binding_result(self):
         assert json.loads(BindingResult().to_json()) == {"Error": ""}
