@@ -125,6 +125,46 @@ def _all_fits(gas: BinpackNodeState, requests: FusedRequests, max_gpus: int):
     return jax.vmap(per_class)(_stacked(requests))
 
 
+def shard_fused_inputs(mesh, state, pods, req_class, gas, requests):
+    """Place a fused problem on a node-sharded mesh: every node-axis leaf
+    (metric matrix dim 1, candidates dim 1, capacity dim 0, the whole GAS
+    usage tensor dim 0) gets a NamedSharding over ``NODE_AXIS``; rule
+    tensors, request classes, and per-pod vectors replicate.  The single
+    sharding recipe used by both the multi-chip dryrun and the GSPMD
+    parity test — ``fused_schedule`` then runs unchanged and GSPMD
+    inserts the collectives."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from platform_aware_scheduling_tpu.parallel.mesh import NODE_AXIS
+
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def node_shard(x, axis):
+        spec = [None] * x.ndim
+        spec[axis] = NODE_AXIS
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+    state_s = state._replace(
+        metric_values=jax.tree.map(
+            lambda x: node_shard(x, 1), state.metric_values
+        ),
+        metric_present=node_shard(state.metric_present, 1),
+        dontschedule=jax.tree.map(
+            lambda x: jax.device_put(x, rep), state.dontschedule
+        ),
+        capacity=node_shard(state.capacity, 0),
+    )
+    pods_s = pods._replace(
+        candidates=node_shard(pods.candidates, 1),
+        metric_row=jax.device_put(pods.metric_row, rep),
+        op_id=jax.device_put(pods.op_id, rep),
+    )
+    gas_s = jax.tree.map(lambda x: node_shard(x, 0), gas)
+    requests_s = jax.tree.map(lambda x: jax.device_put(x, rep), requests)
+    req_class_s = jax.device_put(req_class, rep)
+    return state_s, pods_s, req_class_s, gas_s, requests_s
+
+
 @partial(jax.jit, static_argnames=("max_gpus",))
 def fused_schedule(
     state: ClusterState,

@@ -4,6 +4,7 @@ sequential host TAS-then-GAS composition decision-for-decision
 telemetryscheduler.go:128-149 + gpuscheduler/scheduler.go:200-257)."""
 
 import numpy as np
+import pytest
 
 from benchmarks.configs import (
     _fused_problem,
@@ -118,6 +119,50 @@ class TestFusedParity:
         # 999 must not be booked or the second share would not fit
         assert bool(np.asarray(result.fits)[0])
         assert np.asarray(result.cards)[0].tolist() == [[0, 0]]
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_parity_seed_sweep(self, seed):
+        """Host-control parity across random problem draws (shapes small,
+        semantics full: random need_active, classes, capacities)."""
+        out, host_assign, _ = _solve(
+            num_nodes=48,
+            num_pods=20,
+            num_cards=3,
+            num_res=2,
+            num_classes=2,
+            seed=seed,
+        )
+        assert (np.asarray(out.node_for_pod) == host_assign).all()
+
+    def test_gspmd_node_sharded_matches_unsharded(self):
+        """The fused solve under GSPMD node sharding (the multi-chip
+        config-4 path, also asserted in dryrun_multichip) must equal the
+        unsharded program exactly."""
+        import jax
+
+        from platform_aware_scheduling_tpu.models.fused import (
+            shard_fused_inputs,
+        )
+        from platform_aware_scheduling_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        state, pods, req_class, gas, requests, max_gpus, _ = _fused_problem(
+            num_nodes=128, num_pods=16, seed=4
+        )
+        want = np.asarray(
+            fused_schedule(
+                state, pods, req_class, gas, requests, max_gpus
+            ).node_for_pod
+        )
+        mesh = make_mesh(n_node_shards=8, n_pod_shards=1)
+        sharded = shard_fused_inputs(
+            mesh, state, pods, req_class, gas, requests
+        )
+        got = np.asarray(
+            fused_schedule(*sharded, max_gpus).node_for_pod
+        )
+        assert (got == want).all()
 
     def test_capacity_left_consistent(self):
         out, host_assign, (state, *_rest) = _solve(num_nodes=32, num_pods=12)
