@@ -1,8 +1,11 @@
-"""BASELINE.md config benches #2, #3, #5 plus the solver surface.
+"""BASELINE.md config benches #1-#5 plus the solver surface.
 
 Each config reports a measured device number with a measured host control
 beside it (no extrapolation):
 
+  * **config #1** — the reference's own e2e scale (3 nodes, single
+    metric) through the live socket: the honest lower anchor where the
+    batched design has nothing to win;
   * **config #2** — TAS multi-metric Prioritize, 1k synthetic nodes x
     100 pods: the batched scheduling solve (per-pod scheduleonmetric rows
     over a 4-metric matrix) vs the reference's per-pod loop
@@ -12,6 +15,9 @@ beside it (no extrapolation):
     node at once vs the reference's sequential per-node first-fit
     (gpuscheduler/scheduler.go:200-257, 341-383), with a device/host
     parity assertion on the fits.
+  * **config #4** — the fused TAS+GAS joint solve at 10k nodes x 1k
+    pods (models/fused.py) vs the sequential host TAS-then-GAS
+    composition, decision parity reported.
   * **config #5** — streaming deschedule + Sinkhorn reassignment, 10k
     nodes under continuous churn: per tick, re-evaluate the dontschedule
     violation set on churned metrics and re-solve the pending set with
@@ -58,6 +64,36 @@ def _i64_np(values: "np.ndarray"):
 
     hi, lo = i64.split_int64_np(values.astype(np.int64))
     return i64.I64(hi=jnp.asarray(hi), lo=jnp.asarray(lo))
+
+
+# -- config #1: single-metric policy at the reference e2e scale -------------
+
+
+def config1_single_metric(num_nodes: int = 3) -> Dict:
+    """BASELINE config #1: the reference's own e2e scale — 3 worker nodes,
+    a single-metric scheduleonmetric policy — through the live HTTP
+    socket, device fastpath vs host control.  At 3 nodes the control's
+    sort is trivial, so this config is the honest LOWER anchor of the
+    scaling story: the batched design neither wins nor loses at the scale
+    the reference was actually exercised at (functional parity is pinned
+    by tests/test_e2e.py's kind-shaped scenarios); the win grows with
+    cluster size (configs #2-#5, the north-star A/B)."""
+    from benchmarks import http_load
+
+    out = http_load.run(
+        num_nodes=num_nodes,
+        device_requests=104,
+        control_requests=104,
+        concurrency_sweep=(1,),
+        warmup=5,
+        repeats=1,
+    )
+    return {
+        "scale": f"{num_nodes} nodes (reference e2e scale), single metric",
+        "device_p99_ms": out["p99_prioritize_ms_device"],
+        "control_p99_ms": out["p99_prioritize_ms_control"],
+        "speedup_p99": out["speedup_p99"],
+    }
 
 
 # -- config #2: multi-metric Prioritize, 1k nodes x 100 pods ----------------
@@ -836,6 +872,7 @@ def filter_floor() -> Dict:
 def run_all() -> Dict:
     out: Dict = {}
     for name, fn in (
+        ("config1_single_metric_3node", config1_single_metric),
         ("config2_multi_metric_1k_100", config2_multi_metric),
         ("config3_gas_binpack_256x8", config3_gas_binpack),
         ("config3_gas_binpack_4096x8", config3_gas_binpack_large),

@@ -135,9 +135,12 @@ def shard_fused_inputs(mesh, state, pods, req_class, gas, requests):
     inserts the collectives."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from platform_aware_scheduling_tpu.parallel.mesh import NODE_AXIS
+    from platform_aware_scheduling_tpu.parallel.mesh import (
+        NODE_AXIS,
+        replicated,
+    )
 
-    rep = NamedSharding(mesh, PartitionSpec())
+    rep = replicated(mesh)
 
     def node_shard(x, axis):
         spec = [None] * x.ndim

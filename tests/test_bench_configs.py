@@ -6,6 +6,12 @@ from benchmarks import configs
 
 
 class TestConfigBenches:
+    def test_config1_runs_and_reports(self):
+        out = configs.config1_single_metric(num_nodes=3)
+        assert out["device_p99_ms"] > 0
+        assert out["control_p99_ms"] > 0
+        assert "speedup_p99" in out
+
     def test_config2_runs_and_reports(self):
         out = configs.config2_multi_metric(num_nodes=64, num_pods=8)
         assert out["device_ms_per_solve"] > 0
