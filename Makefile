@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving test-serving lint image clean dryrun
 
 all: test
 
@@ -27,6 +27,16 @@ bench-http:
 # GAS wire A/B alone
 bench-gas:
 	python -m benchmarks.gas_load
+
+# serving front-end head-to-head: threaded vs async c=1 -> c=8 scaling
+# curve (docs/serving.md)
+bench-serving:
+	python -m benchmarks.http_load --scaling
+
+# hermetic serving-subsystem suite (wire parity, coalescing,
+# backpressure, the c=8 <= 3x c=1 bar) — CI runs this as its own step
+test-serving:
+	python -m pytest tests/test_serving.py -q
 
 # BASELINE configs #2/#3/#4/#5 + solver surface + mesh checks alone
 bench-configs:

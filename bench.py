@@ -29,7 +29,10 @@ http_load device/control dicts go to BENCH_DETAIL_r{N}.json on disk, and
 the line itself ends with the headline — speedup_p99* aliases first, then
 {"metric", "value", "unit", "vs_baseline"} as the very last keys — so any
 tail window that catches the end of the line catches everything that must
-parse.
+parse.  The headline JSON is also the LAST stdout line of the process
+(the detail-file write and its stderr pointer happen before it, ADVICE
+r5 #3), and the detail round can be pinned explicitly with
+``--round N`` / ``PAS_TPU_BENCH_ROUND`` instead of glob inference.
 """
 
 import json
@@ -134,18 +137,26 @@ def batched_solve():
     return fields, context
 
 
-def _detail_path() -> str:
-    """BENCH_DETAIL_r{N}.json beside this file, N inferred as one past the
+def _detail_path(round_override=None) -> str:
+    """BENCH_DETAIL_r{N}.json beside this file.  N comes from (highest
+    precedence first) the ``round_override`` argument, the
+    ``PAS_TPU_BENCH_ROUND`` env var, or glob inference: one past the
     highest driver-written BENCH_r*.json (the driver writes its artifact
-    AFTER this process exits, so max+1 is the current round).  A manual
-    re-run after the driver has written the current round's artifact
-    lands on the NEXT round's name and will be overwritten by that
-    round's real run — last writer wins; only the driver-run detail is
-    authoritative."""
+    AFTER this process exits, so max+1 is the current round).  The
+    explicit override exists because the inference mislabels a manual
+    re-run made after the driver has written the current round's
+    artifact — that run lands on the NEXT round's name (last writer
+    wins); pass the intended round to pin it."""
     import glob
     import re
 
     root = os.path.dirname(os.path.abspath(__file__))
+    if round_override is None:
+        round_override = os.environ.get("PAS_TPU_BENCH_ROUND") or None
+    if round_override is not None:
+        return os.path.join(
+            root, f"BENCH_DETAIL_r{int(round_override):02d}.json"
+        )
     rounds = [
         int(m.group(1))
         for f in glob.glob(os.path.join(root, "BENCH_r*.json"))
@@ -156,7 +167,7 @@ def _detail_path() -> str:
     return os.path.join(root, f"BENCH_DETAIL_r{n:02d}.json")
 
 
-def assemble_line(headline, load, configs_out, gas=None):
+def assemble_line(headline, load, configs_out, gas=None, serving=None):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
     LAST keys (driver tail-capture keeps the end of the line) — and the
@@ -187,6 +198,20 @@ def assemble_line(headline, load, configs_out, gas=None):
             result["gas_filter"]["baseline_shape_256"] = gas[
                 "baseline_shape_256"
             ]
+    if serving is not None:
+        # per-concurrency latency dicts to disk; the line keeps only the
+        # scaling ratios (threaded vs async c=1 -> c=8 curve)
+        detail["serving_scaling"] = serving
+        compact = {"num_nodes": serving.get("num_nodes")}
+        for mode in ("threaded", "async"):
+            side = serving.get(mode)
+            if side:
+                compact[mode] = {
+                    k: v
+                    for k, v in side.items()
+                    if k.startswith(("p99_scaling", "rps_scaling"))
+                }
+        result["serving_scaling"] = compact
     if load is not None:
         # structural note: the filter MISS tier is ratio-capped independent
         # of implementation quality — the filter control skips the sort
@@ -210,6 +235,26 @@ def assemble_line(headline, load, configs_out, gas=None):
 
 
 def main():
+    # explicit round pin for the detail artifact (ADVICE r5 #3):
+    # `python bench.py --round 6` or PAS_TPU_BENCH_ROUND=6.  Validated up
+    # front — a malformed pin must fail fast here, not be swallowed by
+    # the best-effort detail write after the whole bench has run
+    round_override = None
+    argv = sys.argv[1:]
+    raw_round = None
+    if "--round" in argv and argv.index("--round") + 1 < len(argv):
+        raw_round = argv[argv.index("--round") + 1]
+    else:
+        raw_round = os.environ.get("PAS_TPU_BENCH_ROUND") or None
+    if raw_round is not None:
+        try:
+            round_override = int(raw_round)
+        except ValueError:
+            raise SystemExit(
+                f"bench.py: --round/PAS_TPU_BENCH_ROUND must be an "
+                f"integer, got {raw_round!r}"
+            )
+
     headline, context = batched_solve()
     print(context, file=sys.stderr)
 
@@ -262,6 +307,23 @@ def main():
         except Exception as exc:
             print(f"gas_load 256-node shape failed: {exc}", file=sys.stderr)
 
+    # --- serving front-end head-to-head: threaded vs async c=1 -> c=8
+    # scaling curve (benchmarks/http_load.serving_scaling; the tentpole
+    # claim behind docs/serving.md, measured not asserted) ---
+    serving = None
+    try:
+        serving = http_load.serving_scaling(num_nodes=2000)
+        a = serving.get("async", {})
+        t = serving.get("threaded", {})
+        print(
+            f"serving_scaling: c8/c1 p99 threaded "
+            f"{t.get('p99_scaling_c8')}x vs async "
+            f"{a.get('p99_scaling_c8')}x (rps x{a.get('rps_scaling_c8')})",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"serving_scaling failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -271,17 +333,19 @@ def main():
     except Exception as exc:  # config benches must never sink the headline
         print(f"config benches failed: {exc}", file=sys.stderr)
 
-    result, detail = assemble_line(headline, load, configs_out, gas)
-    # the line FIRST — nothing after this point may sink the headline
-    print(json.dumps(result))
+    result, detail = assemble_line(headline, load, configs_out, gas, serving)
+    # detail (and its stderr pointer) go FIRST; the headline JSON must be
+    # the LAST stdout line so a tail-capturing driver always parses it
+    # (ADVICE r5 #3 — r03/r04 lost the headline to output after it)
     if detail:
         try:
-            path = _detail_path()
+            path = _detail_path(round_override)
             with open(path, "w") as f:
                 json.dump(detail, f, indent=2)
             print(f"detail -> {path}", file=sys.stderr)
-        except Exception as exc:  # detail is best-effort, line already out
+        except Exception as exc:  # detail is best-effort
             print(f"detail write failed: {exc}", file=sys.stderr)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

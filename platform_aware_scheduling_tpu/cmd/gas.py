@@ -12,7 +12,6 @@ import signal
 import threading
 from typing import List, Optional
 
-from platform_aware_scheduling_tpu.extender.server import Server
 from platform_aware_scheduling_tpu.gas.scheduler import GASExtender
 from platform_aware_scheduling_tpu.kube.client import get_kube_client
 from platform_aware_scheduling_tpu.utils import klog
@@ -33,6 +32,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--cacert", default="/etc/kubernetes/pki/ca.crt")
     parser.add_argument("--unsafe", action="store_true")
     parser.add_argument("--v", type=int, default=4, help="klog verbosity")
+    parser.add_argument("--serving", default="threaded",
+                        choices=["threaded", "async"],
+                        help="HTTP front-end: threaded (reference-parity "
+                        "default) or async (event loop + micro-batched "
+                        "dispatch, docs/serving.md)")
+    parser.add_argument("--batchWindow", default="1ms",
+                        help="async serving: micro-batch coalescing window")
+    parser.add_argument("--batchMax", type=int, default=64,
+                        help="async serving: max requests fused per batch")
+    parser.add_argument("--queueDepth", type=int, default=256,
+                        help="async serving: admission queue bound; past it "
+                        "requests get 503 + Retry-After")
     return parser
 
 
@@ -43,10 +54,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     kube_client = get_kube_client(args.kubeConfig)
     extender = GASExtender(kube_client)
 
+    from platform_aware_scheduling_tpu.cmd.tas import build_server
+    from platform_aware_scheduling_tpu.utils.duration import parse_duration
     from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 
     tune_for_serving()
-    server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
+    server = build_server(
+        extender,
+        serving=args.serving,
+        window_s=parse_duration(args.batchWindow),
+        max_batch=args.batchMax,
+        max_queue_depth=args.queueDepth,
+    )
     done = threading.Event()
     failed = []
 

@@ -66,6 +66,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="serve Prioritize/Filter from Args.NodeNames "
                         "(register the extender nodeCacheCapable: true); "
                         "large clusters avoid shipping full node objects")
+    parser.add_argument("--serving", default="threaded",
+                        choices=["threaded", "async"],
+                        help="HTTP front-end: threaded (reference-parity "
+                        "default) or async (event loop + micro-batched "
+                        "device dispatch, docs/serving.md)")
+    parser.add_argument("--batchWindow", default="1ms",
+                        help="async serving: micro-batch coalescing window "
+                        "(Go duration, e.g. 500us, 1ms)")
+    parser.add_argument("--batchMax", type=int, default=64,
+                        help="async serving: max requests fused per batch")
+    parser.add_argument("--queueDepth", type=int, default=256,
+                        help="async serving: admission queue bound; past it "
+                        "requests get 503 + Retry-After")
     parser.add_argument("--profilePort", type=int, default=0,
                         help="start the JAX profiler server on this port "
                         "(0 = off): connect TensorBoard/xprof on demand to "
@@ -124,6 +137,30 @@ def assemble(
     return cache, mirror, extender, controller, enforcer, stop
 
 
+def build_server(
+    extender,
+    serving: str = "threaded",
+    window_s: float = 0.001,
+    max_batch: int = 64,
+    max_queue_depth: int = 256,
+):
+    """The selected HTTP front-end over an extender: the reference-parity
+    threaded server (default) or the event-loop micro-batching one
+    (serving/, opt-in via --serving=async).  Shared by the TAS and GAS
+    mains — both serve the same verbs through the same wire stack."""
+    if serving == "async":
+        from platform_aware_scheduling_tpu.serving import AsyncServer
+
+        return AsyncServer(
+            extender,
+            metrics_provider=extender.recorder.prometheus_text,
+            window_s=window_s,
+            max_batch=max_batch,
+            max_queue_depth=max_queue_depth,
+        )
+    return Server(extender, metrics_provider=extender.recorder.prometheus_text)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_arg_parser().parse_args(argv)
     klog.set_verbosity(args.v)
@@ -155,7 +192,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 
     tune_for_serving()
-    server = Server(extender, metrics_provider=extender.recorder.prometheus_text)
+    server = build_server(
+        extender,
+        serving=args.serving,
+        window_s=parse_duration(args.batchWindow),
+        max_batch=args.batchMax,
+        max_queue_depth=args.queueDepth,
+    )
     done = threading.Event()
     failed = []
 

@@ -93,7 +93,97 @@ _STATUS_REASON = {
     405: "Method Not Allowed",
     431: "Request Header Fields Too Large",
     500: "Internal Server Error",
+    503: "Service Unavailable",
 }
+
+
+class HeadParseError(Exception):
+    """A request head that must be answered with a simple error response
+    and a closed connection; ``status`` is the response status."""
+
+    def __init__(self, status: int):
+        super().__init__(f"bad request head ({status})")
+        self.status = status
+
+
+def parse_request_head(head: bytes):
+    """Sans-IO parse of one request head (the bytes before ``CRLFCRLF``):
+    returns ``(method, path, version, headers, lowered, body_length)`` or
+    raises :class:`HeadParseError`.  This is the single source of the
+    framing rules — strict Content-Length validation, duplicate-CL and
+    Transfer-Encoding rejection, the 1 GB body refusal — shared by the
+    threaded handler below and the asyncio front-end (serving/http.py),
+    so both front-ends keep byte-identical wire behavior."""
+    lines = head.split(b"\r\n")
+    parts = lines[0].split(b" ")
+    if len(parts) != 3:
+        raise HeadParseError(400)
+    try:
+        method = parts[0].decode("ascii")
+        path = parts[1].decode("ascii")
+        version = parts[2].decode("ascii")
+    except UnicodeDecodeError:
+        raise HeadParseError(400) from None
+    headers: Dict[str, str] = {}
+    content_lengths = []
+    for line in lines[1:]:
+        name, sep, value = line.partition(b":")
+        if not sep:
+            continue
+        if name != name.rstrip(b" \t"):
+            # whitespace before the colon lets 'Transfer-Encoding :'
+            # dodge the checks below (RFC 7230 §3.2.4 says reject)
+            raise HeadParseError(400)
+        key = name.decode("latin-1")
+        headers[key] = value.strip().decode("latin-1")
+        if key.lower() == "content-length":
+            content_lengths.append(headers[key])
+    lowered = {k.lower(): v for k, v in headers.items()}
+    if "transfer-encoding" in lowered:
+        # chunked bodies aren't deframed here; leaving one in the
+        # keep-alive buffer would desync pipelining (request
+        # smuggling surface behind a proxy) — reject outright
+        raise HeadParseError(400)
+    if len(set(content_lengths)) > 1:
+        # differing duplicates MUST 400 (RFC 7230 §3.3.2): a
+        # first-wins proxy in front would frame differently
+        raise HeadParseError(400)
+    raw_length = content_lengths[0] if content_lengths else "0"
+    # strict framing: ASCII digits only (int() would accept '+5',
+    # '5_0', whitespace — all desync vectors)
+    if not (raw_length.isascii() and raw_length.isdigit()):
+        raise HeadParseError(400)
+    length = int(raw_length)
+    if length > MAX_CONTENT_LENGTH:
+        # parity with the ContentLength middleware check: refuse to
+        # slurp oversized bodies
+        raise HeadParseError(500)
+    return method, path, version, headers, lowered, length
+
+
+def render_response(response: HTTPResponse, close: bool) -> bytes:
+    """Status line + headers + body as one buffer (one sendall/write)."""
+    reason = _STATUS_REASON.get(response.status, "Unknown")
+    out = [f"HTTP/1.1 {response.status} {reason}\r\n".encode("ascii")]
+    for k, v in response.headers.items():
+        out.append(f"{k}: {v}\r\n".encode("latin-1"))
+    out.append(f"Content-Length: {len(response.body)}\r\n".encode())
+    if close:
+        out.append(b"Connection: close\r\n")
+    out.append(b"\r\n")
+    out.append(response.body)
+    return b"".join(out)
+
+
+def render_simple(status: int, close: bool = False) -> bytes:
+    """An empty-body status response (the head-framing error answers)."""
+    reason = _STATUS_REASON.get(status, "Unknown")
+    extra = b"Connection: close\r\n" if close else b""
+    return (
+        f"HTTP/1.1 {status} {reason}\r\nContent-Length: 0\r\n".encode()
+        + extra
+        + b"\r\n"
+    )
 
 
 class _FastHTTPHandler(socketserver.BaseRequestHandler):
@@ -137,57 +227,12 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
                 return
             head = bytes(buf[:head_end])
             del buf[: head_end + 4]
-            lines = head.split(b"\r\n")
-            parts = lines[0].split(b" ")
-            if len(parts) != 3:
-                self._send_simple(sock, 400, close=True)
-                return
             try:
-                method = parts[0].decode("ascii")
-                path = parts[1].decode("ascii")
-                version = parts[2].decode("ascii")
-            except UnicodeDecodeError:
-                self._send_simple(sock, 400, close=True)
-                return
-            headers: Dict[str, str] = {}
-            content_lengths = []
-            bad_head = False
-            for line in lines[1:]:
-                name, sep, value = line.partition(b":")
-                if not sep:
-                    continue
-                if name != name.rstrip(b" \t"):
-                    # whitespace before the colon lets 'Transfer-Encoding :'
-                    # dodge the checks below (RFC 7230 §3.2.4 says reject)
-                    bad_head = True
-                    break
-                key = name.decode("latin-1")
-                headers[key] = value.strip().decode("latin-1")
-                if key.lower() == "content-length":
-                    content_lengths.append(headers[key])
-            lowered = {k.lower(): v for k, v in headers.items()}
-            if bad_head or "transfer-encoding" in lowered:
-                # chunked bodies aren't deframed here; leaving one in the
-                # keep-alive buffer would desync pipelining (request
-                # smuggling surface behind a proxy) — reject outright
-                self._send_simple(sock, 400, close=True)
-                return
-            if len(set(content_lengths)) > 1:
-                # differing duplicates MUST 400 (RFC 7230 §3.3.2): a
-                # first-wins proxy in front would frame differently
-                self._send_simple(sock, 400, close=True)
-                return
-            raw_length = content_lengths[0] if content_lengths else "0"
-            # strict framing: ASCII digits only (int() would accept '+5',
-            # '5_0', whitespace — all desync vectors)
-            if not (raw_length.isascii() and raw_length.isdigit()):
-                self._send_simple(sock, 400, close=True)
-                return
-            length = int(raw_length)
-            if length > MAX_CONTENT_LENGTH:
-                # parity with the ContentLength middleware check: refuse to
-                # slurp oversized bodies
-                self._send_simple(sock, 500, close=True)
+                method, path, version, headers, lowered, length = (
+                    parse_request_head(head)
+                )
+            except HeadParseError as exc:
+                self._send_simple(sock, exc.status, close=True)
                 return
             if lowered.get("expect", "").lower() == "100-continue":
                 try:
@@ -218,18 +263,9 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
                 version == "HTTP/1.0"
                 or lowered.get("connection", "").lower() == "close"
             )
-            reason = _STATUS_REASON.get(response.status, "Unknown")
-            out = [f"HTTP/1.1 {response.status} {reason}\r\n".encode("ascii")]
-            for k, v in response.headers.items():
-                out.append(f"{k}: {v}\r\n".encode("latin-1"))
-            out.append(f"Content-Length: {len(response.body)}\r\n".encode())
-            if close:
-                out.append(b"Connection: close\r\n")
-            out.append(b"\r\n")
-            out.append(response.body)
             sock.settimeout(WRITE_TIMEOUT_S)
             try:
-                sock.sendall(b"".join(out))
+                sock.sendall(render_response(response, close))
             except OSError:
                 return
             if close:
@@ -237,14 +273,8 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
 
     @staticmethod
     def _send_simple(sock, status: int, close: bool = False) -> None:
-        reason = _STATUS_REASON.get(status, "Unknown")
-        extra = b"Connection: close\r\n" if close else b""
         try:
-            sock.sendall(
-                f"HTTP/1.1 {status} {reason}\r\nContent-Length: 0\r\n".encode()
-                + extra
-                + b"\r\n"
-            )
+            sock.sendall(render_simple(status, close))
         except OSError:
             pass
 

@@ -34,6 +34,32 @@ such bodies.
 
 Node objects are passed through as raw dicts so responses round-trip the
 scheduler's own node JSON exactly.
+
+Enforced decode scope (Go type-mismatch parity): a type-mismatched value
+raises :class:`DecodeError` — which the verb handlers surface as the
+reference's decode-failure empty-200 quirk — for EXACTLY these typed
+fields, checked identically by this decoder and the native scanner
+(tests/test_decode_scope.py pins the boundary):
+
+  * ``Pod`` must be an object (or null); ``Pod.metadata`` an object;
+    ``Pod.metadata.name`` / ``.namespace`` strings; ``Pod.metadata.labels``
+    an object whose values are all strings;
+  * ``Nodes`` must be an object; ``Nodes.items`` a list whose non-null
+    entries are objects; ``items[].metadata`` an object;
+    ``items[].metadata.name`` a string;
+  * ``NodeNames`` must be a list of strings (null entries become "");
+  * every ``BindingArgs`` field (``PodName`` / ``PodNamespace`` /
+    ``PodUID`` / ``Node``) must be a string.
+
+Everything OUTSIDE that list is accepted leniently as raw pass-through —
+``Pod.spec``, ``Pod.status``, node ``labels`` / ``annotations`` /
+``status``, and any unknown key may hold any JSON type without failing
+the decode, even where Go's fully-typed structs would reject it (e.g. a
+non-string node label).  This is a deliberate fidelity boundary: the
+enforced set covers every field this framework actually reads, both
+internal paths agree on every body (the fuzzer pins that), and the gap
+is observable only on hand-crafted bodies no real kube-scheduler emits
+(ADVICE r5 #1).
 """
 
 from __future__ import annotations

@@ -34,6 +34,13 @@ from platform_aware_scheduling_tpu.ops.assign import (
 
 NEG = -1e30
 
+# shared anneal-step default for BOTH the single-chip kernel below and the
+# mesh form (parallel/sharded.sharded_sinkhorn_assign): callers comparing
+# or swapping the two at their defaults must get the same guidance
+# quality (ADVICE r5 #2 — the sharded default of 20 was too few anneal
+# steps for contended cases the single-chip default resolves)
+DEFAULT_ITERATIONS = 50
+
 
 class SinkhornResult(NamedTuple):
     assignment: AssignResult
@@ -60,7 +67,7 @@ def sinkhorn_assign_kernel(
     score: i64.I64,  # [P, N] — larger is better
     eligible: jax.Array,  # bool [P, N]
     capacity: jax.Array,  # int32 [N]
-    iterations: int = 50,
+    iterations: int = DEFAULT_ITERATIONS,
     tau: float = 0.05,
 ) -> SinkhornResult:
     """Globally-coordinated assignment: Sinkhorn plan + exact greedy

@@ -27,12 +27,17 @@ Four building blocks, each the multi-chip form of an ops/ kernel:
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 ships it under experimental only
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from platform_aware_scheduling_tpu.ops import i64
@@ -44,6 +49,14 @@ from platform_aware_scheduling_tpu.ops.rules import (
     violated_nodes,
 )
 from platform_aware_scheduling_tpu.parallel.mesh import NODE_AXIS, POD_AXIS
+
+# "skip the static replication/varying-axes check" spells check_vma in
+# current jax and check_rep before the rename — resolve once at import
+_SHARD_MAP_NOCHECK = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(shard_map).parameters
+    else {"check_rep": False}
+)
 
 
 def sharded_violations(mesh: Mesh, metric_values: i64.I64, metric_present, rules: RuleSet):
@@ -99,7 +112,11 @@ def sharded_prioritize(mesh: Mesh, value: i64.I64, valid, op_id):
         local_idx = jnp.arange(n_loc, dtype=jnp.int32) + offset
         key_loc = _rank_key(value_loc, valid_loc, op, local_idx)
         # invalid lanes sort after valid ones on key collision: index + N
-        n_total = n_loc * jax.lax.axis_size(NODE_AXIS)
+        # axis_size is a newer jax API; psum(1) is its portable spelling
+        if hasattr(jax.lax, "axis_size"):
+            n_total = n_loc * jax.lax.axis_size(NODE_AXIS)
+        else:
+            n_total = n_loc * jax.lax.psum(1, NODE_AXIS)
         tie_loc = jnp.where(valid_loc, local_idx, local_idx + n_total)
 
         g_hi = jax.lax.all_gather(key_loc.hi, NODE_AXIS, tiled=True)
@@ -163,9 +180,10 @@ def sharded_prioritize_ring(mesh: Mesh, value: i64.I64, valid, op_id):
             blk_tie = jax.lax.ppermute(blk_tie, NODE_AXIS, perm)
             return (blk_hi, blk_lo, blk_tie, counts), None
 
-        zero_counts = jax.lax.pcast(
-            jnp.zeros(n_loc, jnp.int32), (NODE_AXIS,), to="varying"
-        )
+        # node-varying zeros derived from a sharded value (tie_loc) so the
+        # scan carry rep matches on every jax version; current jax would
+        # spell this lax.pcast(..., to="varying"), older jax has no pcast
+        zero_counts = tie_loc * jnp.int32(0)
         init = (key_loc.hi, key_loc.lo, tie_loc, zero_counts)
         (_, _, _, ranks), _ = jax.lax.scan(hop, init, None, length=n_shards)
         return jnp.int32(10) - ranks, valid_loc
@@ -224,7 +242,7 @@ def sharded_greedy_assign(
         # `assigned` is replicated by construction (every chip replays the
         # same decision from the same gathered candidates); the static
         # varying-axes check can't see that
-        check_vma=False,
+        **_SHARD_MAP_NOCHECK,
     )
     def _impl(s, elig, cap):
         n_loc = cap.shape[-1]
@@ -373,7 +391,7 @@ def sharded_auction_assign(
         out_specs=(P(), P(NODE_AXIS)),
         # choice is replicated by construction (every chip reduces the
         # same gathered candidates); the static check can't see that
-        check_vma=False,
+        **_SHARD_MAP_NOCHECK,
     )
     def _impl(s, elig, cap):
         n_loc = cap.shape[-1]
@@ -454,7 +472,7 @@ def sharded_sinkhorn_assign(
     score: i64.I64,  # [P, N] node-sharded — larger is better
     eligible,  # bool [P, N] node-sharded
     capacity,  # int32 [N] node-sharded
-    iterations: int = 20,
+    iterations: int = None,  # defaults to ops.sinkhorn.DEFAULT_ITERATIONS
     tau: float = 0.05,
     block_size: int = 32,
 ):
@@ -471,7 +489,15 @@ def sharded_sinkhorn_assign(
     always feasible and deterministic; tests assert objective parity
     with the single-chip kernel rather than bitwise equality
     (tests/test_parallel.py)."""
-    from platform_aware_scheduling_tpu.ops.sinkhorn import NEG
+    from platform_aware_scheduling_tpu.ops.sinkhorn import (
+        DEFAULT_ITERATIONS,
+        NEG,
+    )
+
+    if iterations is None:
+        # single source of truth with the single-chip kernel (ADVICE r5
+        # #2): both forms anneal the same number of steps by default
+        iterations = DEFAULT_ITERATIONS
 
     @partial(
         shard_map,
@@ -522,15 +548,19 @@ def sharded_sinkhorn_assign(
             log_v = jnp.where(cap_f > 0, log_v, NEG)
             return (log_u, log_v), None
 
-        p = elig.shape[0]
-        n_loc = elig.shape[1]
         # log_v is per-node (varying over the shard axis); log_u is built
         # from psums and stays replicated
+        # derive both zero carries from already-collective values so every
+        # jax version's replication tracker assigns them the same rep the
+        # scan body produces: log_u from the psum-built has_eligible
+        # (replicated over both axes, like -row_lse), log_v from the
+        # node-sharded capacity (node-varying, like col_lse).  Bare
+        # jnp.zeros carries would trip the scan carry rep check on either
+        # side; newer jax spells the cast lax.pcast, older jax has no
+        # such API, multiplying by zero works on both.
         init = (
-            jnp.zeros(p, jnp.float32),
-            jax.lax.pcast(
-                jnp.zeros(n_loc, jnp.float32), (NODE_AXIS,), to="varying"
-            ),
+            has_eligible.astype(jnp.float32) * jnp.float32(0.0),
+            cap_f * jnp.float32(0.0),
         )
         (log_u, log_v), _ = jax.lax.scan(step, init, None, length=iterations)
         log_plan = logits + log_u[:, None] + log_v[None, :]

@@ -1,0 +1,19 @@
+"""Concurrent serving subsystem: asyncio event-loop HTTP front-end with
+micro-batched device dispatch (docs/serving.md).
+
+Opt-in alternative to the threaded reference-parity server
+(``--serving=async`` on the service mains): one event loop owns all
+connections, concurrent Prioritize/Filter requests coalesce inside a
+short window into ONE fused device solve, and responses — byte-identical
+to the per-request path — are demultiplexed per request.  Bounded
+admission with 503 + Retry-After backpressure; per-stage latency and
+queue-depth metrics on /metrics.
+"""
+
+from platform_aware_scheduling_tpu.serving.batch import BatchExecutor
+from platform_aware_scheduling_tpu.serving.dispatcher import (
+    MicroBatchDispatcher,
+)
+from platform_aware_scheduling_tpu.serving.http import AsyncServer
+
+__all__ = ["AsyncServer", "BatchExecutor", "MicroBatchDispatcher"]

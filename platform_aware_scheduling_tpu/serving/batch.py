@@ -1,0 +1,89 @@
+"""Batched route execution: fuse a coalesced batch's device work, then
+demux per-request responses through the unchanged routing stack.
+
+The dispatcher (serving/dispatcher.py) hands a batch of raw HTTP requests
+to :class:`BatchExecutor`.  Requests that cannot batch — non-verb paths,
+middleware rejections (wrong content type, oversize body, non-POST),
+/metrics — are answered inline through ``Server.route`` exactly as the
+threaded front-end would.  The Prioritize/Filter members are grouped per
+path and each group is offered to the scheduler's optional ``warm_batch``
+hook (MetricsExtender.warm_batch) which performs ONE fused device solve
+covering every ranking/violation set the group needs; the members are
+then served one by one through the same ``Server.route`` — now pure
+cache hits — so responses are byte-identical to the per-request path by
+construction (the encode path never changes, only cache warmth).
+
+Schedulers without the hook (GAS) just get the serialized demux, which
+already beats thread-per-connection at concurrency: one worker thread
+instead of N racing the interpreter lock.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    HTTPResponse,
+    MAX_CONTENT_LENGTH,
+    Server,
+)
+from platform_aware_scheduling_tpu.utils import klog
+
+_BATCH_PATHS = ("/scheduler/prioritize", "/scheduler/filter")
+
+
+class BatchExecutor:
+    """``batch_route`` callable for MicroBatchDispatcher over a routing
+    ``Server`` (used for its route table + middleware, never started)."""
+
+    def __init__(self, router: Server):
+        self.router = router
+        # instrumentation (pinned by tests/test_serving.py): batches
+        # executed and fused device solves performed across them
+        self.batches = 0
+        self.fused_solves = 0
+
+    def _batchable(self, request: HTTPRequest) -> bool:
+        """Only requests that will pass the middleware chain reach a verb
+        handler; everything else is answered inline (its response never
+        depends on cache warmth)."""
+        return (
+            request.path in _BATCH_PATHS
+            and request.method == "POST"
+            and request.header("Content-Type") == "application/json"
+            and len(request.body) <= MAX_CONTENT_LENGTH
+        )
+
+    def __call__(
+        self, requests: List[HTTPRequest]
+    ) -> List[HTTPResponse]:
+        self.batches += 1
+        responses: List[HTTPResponse] = [None] * len(requests)  # type: ignore
+        groups: dict = {}
+        for i, request in enumerate(requests):
+            if self._batchable(request):
+                groups.setdefault(request.path, []).append(i)
+            else:
+                responses[i] = self._route_one(requests[i])
+        warm = getattr(self.router.scheduler, "warm_batch", None)
+        for path, idxs in groups.items():
+            if warm is not None:
+                try:
+                    self.fused_solves += int(
+                        warm(path, [requests[i] for i in idxs])
+                    )
+                except Exception as exc:  # warmth is an optimization only
+                    klog.error(
+                        "batch warm failed, per-request path serves: %s", exc
+                    )
+            for i in idxs:
+                responses[i] = self._route_one(requests[i])
+        return responses
+
+    def _route_one(self, request: HTTPRequest) -> HTTPResponse:
+        try:
+            return self.router.route(request)
+        except Exception as exc:
+            klog.error("handler raised: %r", exc)
+            return HTTPResponse(status=500)

@@ -36,6 +36,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from platform_aware_scheduling_tpu.ops.scoring import (
+    batch_prioritize_kernel,
     filter_kernel,
     prioritize_kernel,
 )
@@ -191,6 +192,38 @@ class PrioritizeFastPath:
                 self._rank[key] = ranked
         return ranked
 
+    def warm_rankings_batched(self, view: DeviceView, pairs) -> int:
+        """Seed the ranking cache for every not-yet-warm (metric row, op)
+        pair in ONE device dispatch (``batch_prioritize_kernel`` vmapped
+        over the pair axis) — the serving micro-batcher's fused solve:
+        a coalesced batch of requests needing K distinct rankings costs
+        one XLA program, not K (and zero when all are warm).  Cache
+        entries are identical to what per-pair :meth:`_ranking` would
+        store, so responses stay byte-identical to the per-request path.
+        Returns the number of pairs actually computed."""
+        missing = [
+            (int(row), int(op))
+            for row, op in pairs
+            if (view.row_version(int(row)), int(row), int(op))
+            not in self._rank
+        ]
+        if not missing:
+            return 0
+        res = batch_prioritize_kernel(
+            view.values,
+            view.present,
+            jnp.asarray([row for row, _ in missing], dtype=jnp.int32),
+            jnp.asarray([op for _, op in missing], dtype=jnp.int32),
+            jnp.ones((len(missing), view.node_capacity), dtype=bool),
+        )
+        perms = np.asarray(res.perm)
+        counts = np.asarray(res.valid_count)
+        with self._lock:
+            for i, (row, op) in enumerate(missing):
+                key = (view.row_version(row), row, op)
+                self._rank[key] = perms[i][: int(counts[i])].astype(np.int64)
+        return len(missing)
+
     def precompute(self, view: DeviceView, pairs, wirec=None) -> None:
         """Warm the request-time state for (metric_row, op) pairs: the
         ranking cache (one device pass per pair whose row actually
@@ -336,6 +369,23 @@ class PrioritizeFastPath:
         """Identity-stable violating-row frozenset for this policy at this
         state — the Filter response cache keys on the OBJECT identity, so
         a state change (new frozenset) can never serve stale bytes."""
+        result = self._violation_set_counted(compiled, view)
+        return result if result is None else result[0]
+
+    def warm_violations(
+        self, compiled: CompiledPolicy, view: DeviceView
+    ) -> int:
+        """Warm the violation set for one policy, reporting whether a
+        device computation actually ran (1) or the set was already cached
+        (0) — the serving micro-batcher's fused-solve accounting
+        (MetricsExtender.warm_batch)."""
+        result = self._violation_set_counted(compiled, view)
+        return 0 if result is None else int(result[1])
+
+    def _violation_set_counted(
+        self, compiled: CompiledPolicy, view: DeviceView
+    ):
+        """(violation frozenset, computed-now?) or None (no device rules)."""
         rules = compiled.dontschedule
         if rules is None:
             return None
@@ -350,21 +400,27 @@ class PrioritizeFastPath:
             rules.active.tobytes(),
         )
         cached = self._violations.get(sig)
-        if cached is None:
-            device_rules = compiled.device_rules("dontschedule")
-            if device_rules is None:
-                return None
-            passing = filter_kernel(
-                view.values,
-                view.present,
-                device_rules,
-                jnp.ones(view.node_capacity, dtype=bool),
-            )
-            bad = ~np.asarray(passing)
-            cached = frozenset(int(i) for i in np.nonzero(bad)[0])
-            with self._lock:
-                self._violations[sig] = cached
-        return cached
+        if cached is not None:
+            return cached, False
+        device_rules = compiled.device_rules("dontschedule")
+        if device_rules is None:
+            return None
+        passing = filter_kernel(
+            view.values,
+            view.present,
+            device_rules,
+            jnp.ones(view.node_capacity, dtype=bool),
+        )
+        bad = ~np.asarray(passing)
+        cached = frozenset(int(i) for i in np.nonzero(bad)[0])
+        with self._lock:
+            # a concurrent computer may have won: keep ITS set so the
+            # identity-keyed response caches see one object per state
+            existing = self._violations.get(sig)
+            if existing is not None:
+                return existing, False
+            self._violations[sig] = cached
+        return cached, True
 
     def _violation_mask(self, violations: frozenset, n_rows: int) -> bytes:
         """uint8-per-row bitmask form of a violation frozenset (the shape

@@ -24,6 +24,41 @@ def quantile(sorted_values: List[float], q: float) -> float:
     return sorted_values[idx]
 
 
+class CounterSet:
+    """Thread-safe named counters and gauges with Prometheus text
+    exposition — the non-latency half of the serving subsystem's metrics
+    (queue depth, admission rejections, batch sizes; docs/serving.md).
+    Names are emitted verbatim, so callers pass fully-qualified metric
+    names (``pas_serving_queue_depth`` etc.)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, 0)
+
+    def prometheus_text(self) -> str:
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+        lines = [f"{name} {value}" for name, value in counters]
+        lines += [f"{name} {value:g}" for name, value in gauges]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
 class LatencyRecorder:
     """Thread-safe per-label latency stats: histogram buckets plus a bounded
     window of raw samples for exact quantiles."""

@@ -100,3 +100,52 @@ class TestBenchLine:
         result, _ = bench.assemble_line(HEADLINE, load, None)
         assert "speedup_p99_c8" not in result
         assert result["speedup_p99"] == 12.0
+
+    def test_serving_section_compact_in_line(self):
+        stats = {"count": 8, "p50_ms": 1.0, "p99_ms": 2.0,
+                 "requests_per_s": 100.0}
+        serving = {
+            "num_nodes": 100,
+            "threaded": {"c1": dict(stats), "c8": dict(stats),
+                         "p99_scaling_c8": 9.5, "rps_scaling_c8": 1.0},
+            "async": {"c1": dict(stats), "c8": dict(stats),
+                      "p99_scaling_c8": 2.1, "rps_scaling_c8": 3.0},
+        }
+        result, detail = bench.assemble_line(
+            HEADLINE, None, None, serving=serving
+        )
+        # per-concurrency dicts go to the detail file, ratios to the line
+        assert detail["serving_scaling"] == serving
+        assert result["serving_scaling"]["async"] == {
+            "p99_scaling_c8": 2.1, "rps_scaling_c8": 3.0
+        }
+        assert "c1" not in result["serving_scaling"]["threaded"]
+        # headline keys still last
+        assert list(result)[-4:] == ["metric", "value", "unit", "vs_baseline"]
+
+
+class TestDetailPath:
+    """_detail_path round resolution (ADVICE r5 #3): explicit override
+    beats the env var beats glob inference."""
+
+    def test_explicit_override(self):
+        assert bench._detail_path(7).endswith("BENCH_DETAIL_r07.json")
+        assert bench._detail_path("3").endswith("BENCH_DETAIL_r03.json")
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("PAS_TPU_BENCH_ROUND", "9")
+        assert bench._detail_path().endswith("BENCH_DETAIL_r09.json")
+        # the argument still wins over the env var
+        assert bench._detail_path(4).endswith("BENCH_DETAIL_r04.json")
+
+    def test_glob_inference_fallback(self, monkeypatch, tmp_path):
+        """Inference over a seeded directory: one past the highest
+        driver-written round, 0 when none exist."""
+        monkeypatch.delenv("PAS_TPU_BENCH_ROUND", raising=False)
+        # _detail_path roots its glob at bench.py's own directory
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        assert bench._detail_path().endswith("BENCH_DETAIL_r00.json")
+        for n in (0, 3, 11):
+            (tmp_path / f"BENCH_r{n:02d}.json").write_text("{}")
+        (tmp_path / "BENCH_rxx.json").write_text("{}")  # ignored: no digits
+        assert bench._detail_path() == str(tmp_path / "BENCH_DETAIL_r12.json")

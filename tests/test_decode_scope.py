@@ -1,0 +1,145 @@
+"""Hand-crafted-body tests pinning the enforced-field scope of Go
+type-mismatch decode parity, exactly as stated in the extender/types.py
+module docstring (ADVICE r5 #1): fields INSIDE the enforced set raise
+DecodeError on a type mismatch (the verbs then produce the reference's
+decode-failure empty-200 quirk); fields OUTSIDE it are lenient raw
+pass-through even where Go's fully-typed structs would reject them.
+"""
+
+import json
+
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.extender.types import (
+    Args,
+    BindingArgs,
+    DecodeError,
+)
+
+
+def _body(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+NODES = {"items": [{"metadata": {"name": "n1"}}]}
+
+
+class TestEnforcedFields:
+    """Type mismatches inside the enforced scope are decode failures."""
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            {"Pod": 5, "Nodes": NODES},  # Pod not an object
+            {"Pod": {"metadata": []}, "Nodes": NODES},  # metadata not object
+            {"Pod": {"metadata": {"name": 5}}, "Nodes": NODES},
+            {"Pod": {"metadata": {"namespace": []}}, "Nodes": NODES},
+            {"Pod": {"metadata": {"labels": "x"}}, "Nodes": NODES},
+            {"Pod": {"metadata": {"labels": {"a": 1}}}, "Nodes": NODES},
+            {"Pod": {}, "Nodes": 7},  # Nodes not an object
+            {"Pod": {}, "Nodes": {"items": 7}},  # items not a list
+            {"Pod": {}, "Nodes": {"items": ["x"]}},  # entry not an object
+            {"Pod": {}, "Nodes": {"items": [{"metadata": 5}]}},
+            {"Pod": {}, "Nodes": {"items": [{"metadata": {"name": 5}}]}},
+            {"Pod": {}, "NodeNames": "n1"},  # NodeNames not a list
+            {"Pod": {}, "NodeNames": [5]},  # entry not a string
+        ],
+    )
+    def test_args_type_mismatch_fails(self, body):
+        with pytest.raises(DecodeError):
+            Args.from_json(_body(body))
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            {"PodName": 5},
+            {"PodNamespace": []},
+            {"PodUID": {}},
+            {"Node": 1.5},
+        ],
+    )
+    def test_binding_type_mismatch_fails(self, body):
+        with pytest.raises(DecodeError):
+            BindingArgs.from_json(_body(body))
+
+
+class TestLenientFields:
+    """Everything outside the enforced scope passes through untyped, even
+    where Go's typed structs would reject it (the documented boundary)."""
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            # Pod.spec / Pod.status may hold any JSON type
+            {"Pod": {"spec": 5, "metadata": {"name": "p"}}, "Nodes": NODES},
+            {"Pod": {"status": []}, "Nodes": NODES},
+            # node labels/annotations/status are raw pass-through; a
+            # non-string node label is a Go UnmarshalTypeError but is
+            # accepted here (observable on hand-crafted bodies only)
+            {
+                "Pod": {},
+                "Nodes": {
+                    "items": [
+                        {
+                            "metadata": {
+                                "name": "n1",
+                                "labels": {"a": 1},
+                                "annotations": 7,
+                            },
+                            "status": "up",
+                        }
+                    ]
+                },
+            },
+            # unknown top-level and nested keys of any type are dropped
+            # or carried, never decode failures
+            {"Pod": {"metadata": {"name": "p", "extra": {}}}, "Junk": [1]},
+        ],
+    )
+    def test_args_lenient_accept(self, body):
+        args = Args.from_json(_body(body))
+        assert args.pod is not None
+
+    def test_null_entries_keep_go_zero_values(self):
+        args = Args.from_json(
+            _body({"Pod": {}, "NodeNames": ["n1", None, "n2"]})
+        )
+        assert args.node_names == ["n1", "", "n2"]
+
+
+class TestQuirkThroughVerb:
+    """An enforced-scope mismatch produces the decode-failure empty-200
+    quirk through the Prioritize verb (telemetryscheduler.go:41-48)."""
+
+    def _extender(self):
+        from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+        from platform_aware_scheduling_tpu.tas.telemetryscheduler import (
+            MetricsExtender,
+        )
+
+        return MetricsExtender(AutoUpdatingCache())
+
+    def _request(self, obj) -> HTTPRequest:
+        return HTTPRequest(
+            method="POST",
+            path="/scheduler/prioritize",
+            headers={"Content-Type": "application/json"},
+            body=_body(obj),
+        )
+
+    def test_enforced_mismatch_is_empty_200(self):
+        response = self._extender().prioritize(
+            self._request({"Pod": {"metadata": {"name": 5}}, "Nodes": NODES})
+        )
+        assert response.status == 200
+        assert response.body == b""
+
+    def test_lenient_body_reaches_the_handler(self):
+        # same shape but with the mismatch on a LENIENT field: decode
+        # succeeds and the no-policy-label path answers 400 + "[]"
+        response = self._extender().prioritize(
+            self._request({"Pod": {"spec": 5}, "Nodes": NODES})
+        )
+        assert response.status == 400
+        assert response.body == b"[]\n"

@@ -67,6 +67,23 @@ class TestHttpLoadHarness:
         # the verb includes parse + partition/encode (plus probe overhead)
         assert out["verb_total_us"] >= out["partition_encode_us"] * 0.5
 
+    def test_serving_scaling_small(self):
+        """The threaded-vs-async head-to-head harness end to end at tiny
+        scale: both front-ends serve from their own subprocess and the
+        scaling ratios are derived from the actual sweep."""
+        out = http_load.serving_scaling(
+            num_nodes=32,
+            requests=8,
+            warmup=2,
+            repeats=1,
+            concurrency_sweep=(1, 2),
+        )
+        for mode in ("threaded", "async"):
+            assert out[mode]["c1"]["p99_ms"] > 0
+            assert out[mode]["c2"]["p99_ms"] > 0
+            assert out[mode]["p99_scaling_c2"] > 0
+            assert out[mode]["rps_scaling_c2"] > 0
+
     def test_gas_load_small(self):
         """The GAS wire A/B harness end to end at tiny scale: both sides
         serve, speedups and the alias are produced."""

@@ -590,13 +590,14 @@ class TestShardedSinkhorn:
         eligible_np[0, :2] = True
         eligible_np[1, 0] = True
         mesh = make_mesh(n_node_shards=8)
+        # at the defaults: sharded and single-chip share
+        # ops.sinkhorn.DEFAULT_ITERATIONS (50 — enough anneal steps for
+        # this contention; the old sharded-only default of 20 was not)
         assigned, _ = sharded_sinkhorn_assign(
             mesh,
             i64.from_int64(score_np),
             jnp.asarray(eligible_np),
             jnp.asarray(np.ones(n, dtype=np.int32)),
-            iterations=50,  # the single-chip kernel's default — 20 is too
-            # few anneal steps for this contention to resolve there either
         )
         np.testing.assert_array_equal(np.asarray(assigned), [1, 0])
 
