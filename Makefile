@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving test-serving lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving test-serving trace-lint lint image clean dryrun
 
 all: test
 
@@ -37,6 +37,12 @@ bench-serving:
 # backpressure, the c=8 <= 3x c=1 bar) — CI runs this as its own step
 test-serving:
 	python -m pytest tests/test_serving.py -q
+
+# metric-name convention gate (docs/observability.md): every emitted
+# metric is declared in trace.METRICS, pas_-prefixed snake_case, no
+# duplicates, and live /metrics output parses as valid exposition
+trace-lint:
+	python -m pytest tests/test_trace_lint.py -q
 
 # BASELINE configs #2/#3/#4/#5 + solver surface + mesh checks alone
 bench-configs:

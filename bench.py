@@ -271,6 +271,19 @@ def main():
             f"{load['speedup_p99']}x",
             file=sys.stderr,
         )
+        # per-stage attribution (scraped from /debug/traces): the detail
+        # artifact carries the full breakdown; the stderr line answers
+        # "where does a device-path request spend its time" at a glance
+        stages = (load.get("device") or {}).get("stages") or {}
+        if stages.get("stages"):
+            top = ", ".join(
+                f"{name} {agg['mean_ms']}ms"
+                for name, agg in sorted(
+                    stages["stages"].items(),
+                    key=lambda kv: -kv[1]["mean_ms"],
+                )[:6]
+            )
+            print(f"http_load stages (mean): {top}", file=sys.stderr)
     except Exception as exc:  # the HTTP bench must never sink the headline
         print(f"http_load failed: {exc}", file=sys.stderr)
 

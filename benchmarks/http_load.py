@@ -52,6 +52,7 @@ from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
 from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
 from platform_aware_scheduling_tpu.utils.quantity import Quantity
+from platform_aware_scheduling_tpu.utils.tracing import quantile
 
 POD_ROTATION = 20  # distinct pending pods cycled through the request stream
 
@@ -260,8 +261,9 @@ def drive(
     latencies.sort()
 
     def pct(p: float) -> float:
-        idx = min(len(latencies) - 1, int(p * len(latencies)))
-        return latencies[idx] * 1e3
+        # nearest-rank, shared with /metrics quantiles — the old
+        # int(p * n) indexing overshot p99 to the clamped max
+        return quantile(latencies, p) * 1e3
 
     return {
         "count": len(latencies),
@@ -277,6 +279,47 @@ _PATHS = {
     "prioritize": "/scheduler/prioritize",
     "filter": "/scheduler/filter",
 }
+
+
+def scrape_stage_breakdown(port: int) -> Dict:
+    """Per-stage latency attribution from the live service's
+    ``/debug/traces`` ring (utils/trace.py): mean/total milliseconds per
+    stage name over the recent completed traces, plus the trace count.
+    This is what gives the BENCH_DETAIL artifact per-stage attribution —
+    'where did the p99 go' (read/queue_wait/coalesce/decode/kernel/
+    encode/write) instead of one opaque number."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", "/debug/traces")
+        payload = conn.getresponse().read()
+    finally:
+        conn.close()
+    data = json.loads(payload)
+    stages: Dict[str, Dict[str, float]] = {}
+    count = 0
+    for entry in data.get("recent", ()):
+        if entry.get("name") == "serving_batch":
+            continue  # batch spans aggregate members; don't double-count
+        count += 1
+        for stage in entry.get("stages", ()):
+            agg = stages.setdefault(
+                stage["name"], {"total_ms": 0.0, "count": 0}
+            )
+            agg["total_ms"] += stage["duration_ms"]
+            agg["count"] += 1
+    return {
+        "traces": count,
+        "stages": {
+            name: {
+                "mean_ms": round(agg["total_ms"] / agg["count"], 4),
+                "count": agg["count"],
+            }
+            for name, agg in sorted(stages.items())
+            if agg["count"]
+        },
+    }
 
 
 def _configs(concurrency_sweep) -> List[tuple]:
@@ -444,12 +487,18 @@ def run(
                 if len(repeat_p99) > 1:
                     best["repeat_p99_ms"] = repeat_p99
                 side[key] = best
+            try:  # per-stage attribution rides the detail artifact
+                side["stages"] = scrape_stage_breakdown(port)
+            except Exception as exc:  # stages are best-effort diagnostics
+                side["stages"] = {"error": str(exc)}
             out[label] = side
         finally:
             proc.terminate()
             proc.wait(timeout=10)
     speedups: Dict[str, Dict[str, float]] = {}
     for key, dev in out["device"].items():
+        if key == "stages":  # attribution, not a latency config
+            continue
         ctl = out["control"].get(key)
         if ctl:
             speedups[key] = {
@@ -511,6 +560,10 @@ def serving_scaling(
                         measured if best is None else _best_of(best, measured)
                     )
                 side[f"c{conc}"] = best
+            try:  # per-stage attribution for the scaling story
+                side["stages"] = scrape_stage_breakdown(port)
+            except Exception as exc:
+                side["stages"] = {"error": str(exc)}
             c0 = f"c{concurrency_sweep[0]}"
             for conc in concurrency_sweep[1:]:
                 key = f"c{conc}"

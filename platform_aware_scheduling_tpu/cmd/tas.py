@@ -147,18 +147,25 @@ def build_server(
     """The selected HTTP front-end over an extender: the reference-parity
     threaded server (default) or the event-loop micro-batching one
     (serving/, opt-in via --serving=async).  Shared by the TAS and GAS
-    mains — both serve the same verbs through the same wire stack."""
+    mains — both serve the same verbs through the same wire stack.
+
+    /metrics serves the full exposition (verb histograms + serving
+    counters + path-attribution and JAX compile counters — utils/trace.py);
+    the async server composes the same page itself from the extender's
+    shared recorder."""
     if serving == "async":
         from platform_aware_scheduling_tpu.serving import AsyncServer
 
         return AsyncServer(
             extender,
-            metrics_provider=extender.recorder.prometheus_text,
             window_s=window_s,
             max_batch=max_batch,
             max_queue_depth=max_queue_depth,
         )
-    return Server(extender, metrics_provider=extender.recorder.prometheus_text)
+    provider = getattr(
+        extender, "metrics_text", extender.recorder.prometheus_text
+    )
+    return Server(extender, metrics_provider=provider)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -18,10 +18,11 @@ import socket
 import socketserver
 import ssl
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, TYPE_CHECKING
 
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
 
 if TYPE_CHECKING:  # pragma: no cover
     from platform_aware_scheduling_tpu.extender.types import Scheduler
@@ -41,6 +42,10 @@ class HTTPRequest:
     path: str
     headers: Dict[str, str]
     body: bytes
+    # the request's trace span (utils/trace.py), attached by whichever
+    # front-end accepted the connection; excluded from equality/repr so
+    # request objects still compare by wire content alone
+    span: Optional[object] = field(default=None, compare=False, repr=False)
 
     def header(self, name: str) -> str:
         # HTTP header names are case-insensitive
@@ -175,10 +180,17 @@ def render_response(response: HTTPResponse, close: bool) -> bytes:
     return b"".join(out)
 
 
-def render_simple(status: int, close: bool = False) -> bytes:
-    """An empty-body status response (the head-framing error answers)."""
+def render_simple(
+    status: int, close: bool = False, request_id: str = ""
+) -> bytes:
+    """An empty-body status response (the head-framing error answers).
+    ``request_id`` rides as ``X-Request-ID`` so even framing rejections
+    are correlatable — for an unparseable head it is freshly generated
+    (nothing client-sent survived the parse to echo)."""
     reason = _STATUS_REASON.get(status, "Unknown")
     extra = b"Connection: close\r\n" if close else b""
+    if request_id:
+        extra += f"X-Request-ID: {request_id}\r\n".encode("latin-1")
     return (
         f"HTTP/1.1 {status} {reason}\r\nContent-Length: 0\r\n".encode()
         + extra
@@ -208,6 +220,11 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
         buf = bytearray()
         while True:
             # -- read the request head --------------------------------------
+            # span timing starts at the request's FIRST byte (leftover
+            # pipelined bytes count as already-arrived): stamping at loop
+            # entry would charge keep-alive idle time between requests to
+            # the next request's read stage (utils/trace.py)
+            t_accept = time.perf_counter() if buf else None
             sock.settimeout(READ_HEADER_TIMEOUT_S)
             head_end = buf.find(b"\r\n\r\n")
             while head_end < 0:
@@ -220,6 +237,8 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
                     return
                 if not chunk:
                     return
+                if t_accept is None:
+                    t_accept = time.perf_counter()
                 buf += chunk
                 head_end = buf.find(b"\r\n\r\n")
             if head_end > MAX_HEAD_LENGTH:
@@ -251,30 +270,43 @@ class _FastHTTPHandler(socketserver.BaseRequestHandler):
             body = bytes(buf[:length])
             del buf[:length]
             # -- dispatch + respond ------------------------------------------
+            request_id = lowered.get("x-request-id") or trace.new_request_id()
+            span = trace.Span(f"{method} {path}", request_id, t0=t_accept)
+            span.add_stage("read", time.perf_counter() - t_accept)
             request = HTTPRequest(
-                method=method, path=path, headers=headers, body=body
+                method=method, path=path, headers=headers, body=body,
+                span=span,
             )
             try:
                 response = type(self).route(request)
             except Exception as exc:
                 klog.error("handler raised: %r", exc)
+                span.set("error", repr(exc))
                 response = HTTPResponse(status=500)
+            response.headers.setdefault("X-Request-ID", request_id)
             close = (
                 version == "HTTP/1.0"
                 or lowered.get("connection", "").lower() == "close"
             )
             sock.settimeout(WRITE_TIMEOUT_S)
+            t_write = time.perf_counter()
             try:
                 sock.sendall(render_response(response, close))
             except OSError:
+                span.set("error", "write failed")
                 return
+            finally:
+                span.add_stage("write", time.perf_counter() - t_write)
+                trace.TRACES.add(span.finish(response.status))
             if close:
                 return
 
     @staticmethod
     def _send_simple(sock, status: int, close: bool = False) -> None:
         try:
-            sock.sendall(render_simple(status, close))
+            sock.sendall(
+                render_simple(status, close, request_id=trace.new_request_id())
+            )
         except OSError:
             pass
 
@@ -297,6 +329,17 @@ class Server:
     # -- routing -------------------------------------------------------------
 
     def route(self, request: HTTPRequest) -> HTTPResponse:
+        if request.path == "/debug/traces":
+            # observability extension (utils/trace.py): a bounded ring of
+            # recent + slowest completed request traces as JSON.  Always
+            # on — tracing has no off switch, matching its near-zero cost
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=trace.TRACES.to_json(),
+            )
         if request.path == "/metrics" and self.metrics_provider is not None:
             # observability extension: outside the POST/JSON middleware
             if request.method != "GET":

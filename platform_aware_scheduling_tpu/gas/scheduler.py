@@ -54,7 +54,7 @@ from platform_aware_scheduling_tpu.gas.utils import (
 )
 from platform_aware_scheduling_tpu.kube.client import ConflictError
 from platform_aware_scheduling_tpu.kube.objects import Node, Pod
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
 from platform_aware_scheduling_tpu.utils.quantity import Quantity
 from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
 
@@ -89,47 +89,71 @@ class GASExtender:
 
     # -- verbs -----------------------------------------------------------------
 
+    def metrics_text(self) -> str:
+        """The /metrics provider for this extender (utils/trace.py)."""
+        return trace.exposition(recorders=[self.recorder])
+
     def prioritize(self, request: HTTPRequest) -> HTTPResponse:
         # not implemented by GAS (scheduler.go:515-519)
         return HTTPResponse(status=404)
 
     def filter(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()
+        span = trace.of(request)
+        span.set("verb", "gas_filter")
         try:
             klog.v(4).info_s("filter request received", component="extender")
             try:
-                args = Args.from_json(request.body) if request.body else None
+                with span.stage("decode"):
+                    args = (
+                        Args.from_json(request.body) if request.body else None
+                    )
             except Exception as exc:
                 args = None
                 klog.error("cannot decode request %s", exc)
             if args is None:
                 return HTTPResponse(status=404)
-            result = self._filter_nodes(args)
+            with span.stage("kernel"):
+                result = self._filter_nodes(args, span=span)
             status = 404 if result.error else 200
-            return HTTPResponse.json(result.to_json(), status=status)
+            with span.stage("encode"):
+                body = result.to_json()
+            return HTTPResponse.json(body, status=status)
         finally:
             self.recorder.observe("gas_filter", time.perf_counter() - start)
 
     def bind(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()
+        span = trace.of(request)
+        span.set("verb", "gas_bind")
         try:
             klog.v(4).info_s("bind request received", component="extender")
             try:
-                args = BindingArgs.from_json(request.body) if request.body else None
+                with span.stage("decode"):
+                    args = (
+                        BindingArgs.from_json(request.body)
+                        if request.body
+                        else None
+                    )
             except Exception as exc:
                 args = None
                 klog.error("cannot decode request %s", exc)
             if args is None:
                 return HTTPResponse(status=404)
-            result = self._bind_node(args)
+            with span.stage("kernel"):
+                result = self._bind_node(args)
             status = 404 if result.error else 200
-            return HTTPResponse.json(result.to_json(), status=status)
+            with span.stage("encode"):
+                body = result.to_json()
+            return HTTPResponse.json(body, status=status)
         finally:
             self.recorder.observe("gas_bind", time.perf_counter() - start)
 
     # -- filter (scheduler.go:447-482) -----------------------------------------
 
-    def _filter_nodes(self, args: Args) -> FilterResult:
+    def _filter_nodes(
+        self, args: Args, span=trace.NULL_SPAN
+    ) -> FilterResult:
         if not args.node_names:
             error = (
                 "No nodes to compare. This should not happen, perhaps the "
@@ -145,6 +169,8 @@ class GASExtender:
                     klog.error("device binpack failed, host fallback: %s", exc)
                     fits = None
                 if fits is not None:
+                    span.set("path", "device")
+                    trace.COUNTERS.inc("pas_gas_filter_device_total")
                     node_names = [n for n, ok in zip(args.node_names, fits) if ok]
                     failed = {
                         n: "Not enough GPU-resources for deployment"
@@ -154,6 +180,8 @@ class GASExtender:
                     return FilterResult(
                         node_names=node_names, failed_nodes=failed, error=""
                     )
+            span.set("path", "host")
+            trace.COUNTERS.inc("pas_gas_filter_host_total")
             node_names: List[str] = []
             failed: Dict[str, str] = {}
             for node_name in args.node_names:

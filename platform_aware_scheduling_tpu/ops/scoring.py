@@ -28,6 +28,7 @@ from platform_aware_scheduling_tpu.ops.rules import (
     RuleSet,
     violated_nodes,
 )
+from platform_aware_scheduling_tpu.utils import trace
 
 
 class PrioritizeResult(NamedTuple):
@@ -84,7 +85,7 @@ def ordinal_scores(
 
 
 @partial(jax.jit, static_argnames=())
-def prioritize_kernel(
+def _prioritize_kernel(
     metric_values: i64.I64,  # [M, N]
     metric_present: jax.Array,  # bool [M, N]
     metric_row: jax.Array,  # scalar int32 — scheduleonmetric rule[0] metric
@@ -101,7 +102,7 @@ def prioritize_kernel(
 
 
 @jax.jit
-def filter_kernel(
+def _filter_kernel(
     metric_values: i64.I64,  # [M, N]
     metric_present: jax.Array,  # bool [M, N]
     rules: RuleSet,
@@ -116,7 +117,7 @@ def filter_kernel(
 
 
 @jax.jit
-def batch_prioritize_kernel(
+def _batch_prioritize_kernel(
     metric_values: i64.I64,  # [M, N]
     metric_present: jax.Array,  # bool [M, N]
     metric_row: jax.Array,  # int32 [P] — per-pod rule metric
@@ -126,7 +127,20 @@ def batch_prioritize_kernel(
     """All pending pods at once — the batched form the Go loop cannot do.
     vmap over the pod axis; one XLA program scores P pods x N nodes."""
     return jax.vmap(
-        lambda row, op, cand: prioritize_kernel(
+        lambda row, op, cand: _prioritize_kernel(
             metric_values, metric_present, row, op, cand
         )
     )(metric_row, op_id, candidate_mask)
+
+
+# lowering-count shims (utils/trace.py): cache growth past each kernel's
+# first compile increments pas_jax_retrace_total — the state-shape bucket
+# system (ops/state.py) exists so steady-state serving NEVER recompiles;
+# a nonzero retrace counter in production says a shape leaked through.
+# The vmap above closes over the unwrapped _prioritize_kernel so tracing
+# the batch kernel can't be miscounted as callers' retraces.
+prioritize_kernel = trace.watch_jit("prioritize_kernel", _prioritize_kernel)
+filter_kernel = trace.watch_jit("filter_kernel", _filter_kernel)
+batch_prioritize_kernel = trace.watch_jit(
+    "batch_prioritize_kernel", _batch_prioritize_kernel
+)

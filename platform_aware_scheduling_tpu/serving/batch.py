@@ -28,7 +28,7 @@ from platform_aware_scheduling_tpu.extender.server import (
     MAX_CONTENT_LENGTH,
     Server,
 )
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
 
 _BATCH_PATHS = ("/scheduler/prioritize", "/scheduler/filter")
 
@@ -70,9 +70,12 @@ class BatchExecutor:
         for path, idxs in groups.items():
             if warm is not None:
                 try:
-                    self.fused_solves += int(
-                        warm(path, [requests[i] for i in idxs])
-                    )
+                    solves = int(warm(path, [requests[i] for i in idxs]))
+                    self.fused_solves += solves
+                    if solves:
+                        trace.COUNTERS.inc(
+                            "pas_serving_fused_solves_total", solves
+                        )
                 except Exception as exc:  # warmth is an optimization only
                     klog.error(
                         "batch warm failed, per-request path serves: %s", exc

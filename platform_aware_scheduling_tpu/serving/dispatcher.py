@@ -39,7 +39,7 @@ from platform_aware_scheduling_tpu.extender.server import (
     HTTPRequest,
     HTTPResponse,
 )
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
 from platform_aware_scheduling_tpu.utils.tracing import (
     CounterSet,
     LatencyRecorder,
@@ -108,6 +108,7 @@ class MicroBatchDispatcher:
         self.counters.inc("pas_serving_requests_total")
         if len(self._queue) >= self.max_queue_depth:
             self.counters.inc("pas_serving_rejected_total")
+            trace.of(request).set("rejected", True)
             future.set_result(
                 HTTPResponse(
                     status=503,
@@ -132,6 +133,7 @@ class MicroBatchDispatcher:
             while not self._queue:
                 self._wakeup.clear()
                 await self._wakeup.wait()
+            t_wake = time.perf_counter()
             # coalescing window, deadline-based: the batch dispatches at
             # head-arrival + window_s, so stragglers landing within the
             # window of the FIRST request fuse with it (skipped when a
@@ -152,7 +154,21 @@ class MicroBatchDispatcher:
             self.counters.inc("pas_serving_batches_total")
             self.counters.inc("pas_serving_batched_requests_total", len(batch))
             t_solve = time.perf_counter()
-            for _, _, t_enq in batch:
+            # the BATCH span: links every member request span, records the
+            # coalesce window + fused solve (the N:1 edge of the trace
+            # graph — member spans carry their own queue_wait/coalesce)
+            batch_span = trace.Span("serving_batch", t0=t_wake)
+            batch_span.set("size", len(batch))
+            batch_span.add_stage("coalesce", t_solve - t_wake)
+            for request, _, t_enq in batch:
+                span = trace.of(request)
+                span.add_stage("queue_wait", max(0.0, t_wake - t_enq))
+                span.add_stage(
+                    "coalesce", max(0.0, t_solve - max(t_enq, t_wake))
+                )
+                if span is not trace.NULL_SPAN:
+                    batch_span.link(span.trace_id)
+                    span.set("batch_id", batch_span.trace_id)
                 self.recorder.observe("serving_queue_wait", t_solve - t_enq)
             requests = [request for request, _, _ in batch]
             try:
@@ -164,6 +180,8 @@ class MicroBatchDispatcher:
                 responses = [HTTPResponse(status=500) for _ in batch]
             done = time.perf_counter()
             self.recorder.observe("serving_batch_solve", done - t_solve)
+            batch_span.add_stage("batch_solve", done - t_solve)
+            trace.TRACES.add(batch_span.finish())
             for (_, future, t_enq), response in zip(batch, responses):
                 if not future.done():
                     future.set_result(response)

@@ -29,10 +29,12 @@ from __future__ import annotations
 import asyncio
 import socket
 import threading
+import time
 from typing import Optional
 
 from platform_aware_scheduling_tpu.extender.server import (
     HTTPRequest,
+    HTTPResponse,
     HeadParseError,
     MAX_HEAD_LENGTH,
     READ_HEADER_TIMEOUT_S,
@@ -47,7 +49,7 @@ from platform_aware_scheduling_tpu.serving.batch import BatchExecutor
 from platform_aware_scheduling_tpu.serving.dispatcher import (
     MicroBatchDispatcher,
 )
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
 from platform_aware_scheduling_tpu.utils.tracing import (
     CounterSet,
     LatencyRecorder,
@@ -70,17 +72,35 @@ class AsyncServer:
     ):
         self.scheduler = scheduler
         # serving-stage observability, merged into the same /metrics
-        # endpoint the extender's verb histograms use (utils/tracing.py)
-        self.recorder = LatencyRecorder()
+        # endpoint the extender's verb histograms use.  The scheduler's
+        # own LatencyRecorder is shared when it has one so the whole
+        # process emits ONE pas_request_duration_seconds family (a second
+        # recorder would need a second # TYPE header — invalid exposition)
+        scheduler_recorder = getattr(scheduler, "recorder", None)
+        self.recorder = scheduler_recorder or LatencyRecorder()
         self.counters = CounterSet()
+        trace.install_jax_hooks()
 
-        def provider() -> str:
-            parts = []
-            if metrics_provider is not None:
-                parts.append(metrics_provider())
-            parts.append(self.recorder.prometheus_text())
-            parts.append(self.counters.prometheus_text())
-            return "".join(parts)
+        if metrics_provider is not None:
+            # legacy explicit provider: its text is prepended verbatim
+            # (the caller owns exposition validity for that fragment).
+            # When the recorder is privately owned (scheduler has none),
+            # the serving-stage histograms must still be exposed here —
+            # the provider's text cannot contain them
+            _extra = metrics_provider
+            own_recorders = [] if scheduler_recorder is not None else [
+                self.recorder
+            ]
+
+            def provider() -> str:
+                return _extra() + trace.exposition(
+                    recorders=own_recorders, counter_sets=[self.counters]
+                )
+
+        else:
+            provider = trace.metrics_provider(
+                recorders=[self.recorder], counter_sets=[self.counters]
+            )
 
         # unstarted Server: routing + middleware + /metrics only
         self._router = Server(scheduler, metrics_provider=provider)
@@ -201,7 +221,10 @@ class AsyncServer:
         try:
             while True:
                 # -- read the request head (same framing as the threaded
-                #    handler; shared parse_request_head) ---------------------
+                #    handler; shared parse_request_head).  Span timing
+                #    starts at the request's FIRST byte, not loop entry —
+                #    keep-alive idle time belongs to no request ----------
+                t_accept = time.perf_counter() if buf else None
                 head_end = buf.find(b"\r\n\r\n")
                 while head_end < 0:
                     if len(buf) > MAX_HEAD_LENGTH:
@@ -210,6 +233,8 @@ class AsyncServer:
                     chunk = await self._read(reader)
                     if not chunk:
                         return
+                    if t_accept is None:
+                        t_accept = time.perf_counter()
                     buf += chunk
                     head_end = buf.find(b"\r\n\r\n")
                 if head_end > MAX_HEAD_LENGTH:
@@ -239,19 +264,44 @@ class AsyncServer:
                 body = bytes(buf[:length])
                 del buf[:length]
                 # -- dispatch through the micro-batcher + respond ---------
-                request = HTTPRequest(
-                    method=method, path=path, headers=headers, body=body
+                request_id = (
+                    lowered.get("x-request-id") or trace.new_request_id()
                 )
-                response = await self.dispatcher.submit(request)
+                span = trace.Span(f"{method} {path}", request_id, t0=t_accept)
+                span.add_stage("read", time.perf_counter() - t_accept)
+                request = HTTPRequest(
+                    method=method, path=path, headers=headers, body=body,
+                    span=span,
+                )
+                if path in ("/metrics", "/debug/traces"):
+                    # observability endpoints bypass the admission queue:
+                    # they must stay readable precisely when the queue is
+                    # saturated (the condition they exist to diagnose),
+                    # and they never touch the device
+                    try:
+                        response = self._router.route(request)
+                    except Exception as exc:
+                        klog.error("handler raised: %r", exc)
+                        response = HTTPResponse(status=500)
+                else:
+                    response = await self.dispatcher.submit(request)
+                # every response carries the id — INCLUDING the 503
+                # backpressure rejection the dispatcher answers directly
+                response.headers.setdefault("X-Request-ID", request_id)
                 close = (
                     version == "HTTP/1.0"
                     or lowered.get("connection", "").lower() == "close"
                 )
+                t_write = time.perf_counter()
                 writer.write(render_response(response, close))
                 try:
                     await asyncio.wait_for(writer.drain(), WRITE_TIMEOUT_S)
                 except (asyncio.TimeoutError, ConnectionError, OSError):
+                    span.set("error", "write failed")
                     return
+                finally:
+                    span.add_stage("write", time.perf_counter() - t_write)
+                    trace.TRACES.add(span.finish(response.status))
                 if close:
                     return
         finally:
@@ -274,7 +324,11 @@ class AsyncServer:
     @staticmethod
     async def _send_simple(writer, status: int) -> None:
         try:
-            writer.write(render_simple(status, close=True))
+            writer.write(
+                render_simple(
+                    status, close=True, request_id=trace.new_request_id()
+                )
+            )
             await writer.drain()
         except (ConnectionError, OSError):
             pass

@@ -41,6 +41,7 @@ from platform_aware_scheduling_tpu.ops.scoring import (
     prioritize_kernel,
 )
 from platform_aware_scheduling_tpu.ops.state import CompiledPolicy, DeviceView
+from platform_aware_scheduling_tpu.utils import trace
 
 # rank -> b'<score>}' suffix bytes; grown on demand (scores are ordinal
 # 10 - rank and go negative past rank 10, telemetryscheduler.go:145)
@@ -263,6 +264,7 @@ class PrioritizeFastPath:
         parsed,
         planned: Optional[str] = None,
         use_node_names: bool = False,
+        span=trace.NULL_SPAN,
     ) -> bytes:
         """Native variant: candidate lookup + selection + byte assembly all
         happen in ``_wirec.select_encode`` over the parsed body's zero-copy
@@ -271,9 +273,12 @@ class PrioritizeFastPath:
         ranking/table/plan, the stored response is returned without any
         selection or encoding at all (see _responses)."""
         table = self._table_for(view)
-        ranked = self._ranking(
-            view, compiled.scheduleonmetric_row, compiled.scheduleonmetric_op
-        )
+        with span.stage("kernel"):
+            ranked = self._ranking(
+                view,
+                compiled.scheduleonmetric_row,
+                compiled.scheduleonmetric_op,
+            )
         planned_row = -1
         if planned is not None:
             planned_row = table.node_index.get(planned, -1)
@@ -288,15 +293,22 @@ class PrioritizeFastPath:
                 ):
                     if idx:  # move to front (MRU)
                         responses.insert(0, responses.pop(idx))
+                    span.set("fastpath", "hit")
+                    trace.COUNTERS.inc("pas_fastpath_response_hit_total")
                     return entry[4]
-        response = wirec.select_encode(
-            parsed, table.native(wirec), ranked, planned_row, use_node_names
-        )
-        span = (
+        span.set("fastpath", "miss")
+        trace.COUNTERS.inc("pas_fastpath_response_miss_total")
+        with span.stage("encode"):
+            response = wirec.select_encode(
+                parsed, table.native(wirec), ranked, planned_row, use_node_names
+            )
+        # cand_span: the request's raw candidate byte-span (the cache key)
+        # — distinct from the trace `span` parameter above
+        cand_span = (
             parsed.node_names_span() if use_node_names else parsed.nodes_span()
         )
-        if span is not None:
-            entry = [ranked, table, planned_row, span, response]
+        if cand_span is not None:
+            entry = [ranked, table, planned_row, cand_span, response]
             with self._lock:
                 self._responses.insert(0, entry)
                 del self._responses[self.RESPONSE_CACHE_SIZE :]
@@ -308,32 +320,37 @@ class PrioritizeFastPath:
         view: DeviceView,
         names: List[str],
         planned: Optional[str] = None,
+        span=trace.NULL_SPAN,
     ) -> bytes:
         """The full Prioritize response body for one request: global order
         restricted to ``names`` (candidate ∩ metric-present), ordinal
         scores, optional batch-plan promotion to rank 1."""
         table = self._table_for(view)
-        ranked = self._ranking(
-            view, compiled.scheduleonmetric_row, compiled.scheduleonmetric_op
-        )
-        index = table.node_index
-        sentinel = table.node_capacity
-        mask = np.zeros(sentinel + 1, dtype=bool)
-        rows = np.fromiter(
-            (index.get(n, sentinel) for n in names),
-            dtype=np.int64,
-            count=len(names),
-        )
-        mask[rows] = True
-        mask[sentinel] = False
-        sel = ranked[mask[ranked]]
-        if planned is not None:
-            prow = index.get(planned)
-            if prow is not None:
-                at = np.nonzero(sel == prow)[0]
-                if at.size:
-                    sel = np.concatenate(([prow], np.delete(sel, at[0])))
-        return self._encode(table, sel)
+        with span.stage("kernel"):
+            ranked = self._ranking(
+                view,
+                compiled.scheduleonmetric_row,
+                compiled.scheduleonmetric_op,
+            )
+        with span.stage("encode"):
+            index = table.node_index
+            sentinel = table.node_capacity
+            mask = np.zeros(sentinel + 1, dtype=bool)
+            rows = np.fromiter(
+                (index.get(n, sentinel) for n in names),
+                dtype=np.int64,
+                count=len(names),
+            )
+            mask[rows] = True
+            mask[sentinel] = False
+            sel = ranked[mask[ranked]]
+            if planned is not None:
+                prow = index.get(planned)
+                if prow is not None:
+                    at = np.nonzero(sel == prow)[0]
+                    if at.size:
+                        sel = np.concatenate(([prow], np.delete(sel, at[0])))
+            return self._encode(table, sel)
 
     @staticmethod
     def _encode(table: _ViewTable, sel: np.ndarray) -> bytes:

@@ -58,7 +58,7 @@ from platform_aware_scheduling_tpu.native import get_wirec
 from platform_aware_scheduling_tpu.tas.fastpath import PrioritizeFastPath
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
 from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
 from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
 
 import jax.numpy as jnp
@@ -89,6 +89,7 @@ class MetricsExtender:
         self.mirror = mirror
         self.node_cache_capable = node_cache_capable
         self.recorder = recorder or LatencyRecorder()
+        trace.install_jax_hooks()  # compile visibility from process start
         # opt-in tas.planner.BatchPlanner: prioritize answers steer planned
         # pods onto their batch-assigned node (see planner module doc)
         self.planner = planner
@@ -207,14 +208,27 @@ class MetricsExtender:
 
     # -- verbs ----------------------------------------------------------------
 
+    def metrics_text(self) -> str:
+        """The /metrics provider for this extender: verb latency
+        histograms + serving counters + the process-wide path-attribution
+        and JAX compile counters (utils/trace.py exposition)."""
+        return trace.exposition(recorders=[self.recorder])
+
     def prioritize(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()
+        span = trace.of(request)
+        span.set("verb", "prioritize")
         try:
+            # the native path attributes itself (native vs native_host —
+            # partition counters, see trace.py declarations)
             response = self._prioritize_native(request)
             if response is not None:
                 return response
+            trace.COUNTERS.inc("pas_prioritize_exact_total")
+            span.set("path", "exact")
             klog.v(2).info_s("Received prioritize request", component="extender")
-            args = self._decode(request)
+            with span.stage("decode"):
+                args = self._decode(request)
             if args is None:
                 return HTTPResponse()
             names = self._candidate_names(args)
@@ -228,26 +242,40 @@ class MetricsExtender:
                 klog.v(2).info_s("no policy associated with pod", component="extender")
                 status = 400  # and still prioritize (telemetryscheduler.go:50-54)
             return HTTPResponse.json(
-                self._prioritize_body(args, names), status=status
+                self._prioritize_body(args, names, span=span), status=status
             )
         finally:
             self.recorder.observe("prioritize", time.perf_counter() - start)
 
     def filter(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()
+        span = trace.of(request)
+        span.set("verb", "filter")
         try:
             klog.v(2).info_s("Filter request received", component="extender")
-            probe = self._filter_cache_probe(request)
+            with span.stage("cache_probe"):
+                probe = self._filter_cache_probe(request)
+            # hit/miss attribution happens inside the probe, at its
+            # non-None return sites only (it alone can tell a true
+            # span-cache hit from the native encode that merely SEEDS the
+            # cache); every None return — uncacheable OR device trouble —
+            # is a bypass, so hit+miss+bypass counts each request once
             if isinstance(probe, HTTPResponse):
                 return probe
-            args = self._decode(request)
+            if probe is None:
+                span.set("filter_cache", "bypass")
+                trace.COUNTERS.inc("pas_filter_cache_bypass_total")
+            with span.stage("decode"):
+                args = self._decode(request)
             if args is None:
                 return HTTPResponse()
-            result = self._filter_nodes(args)
+            with span.stage("kernel"):
+                result = self._filter_nodes(args)
             if result is None:
                 klog.v(2).info_s("No filtered nodes returned", component="extender")
                 return HTTPResponse.json(b"null\n", status=404)
-            body = result.to_json()
+            with span.stage("encode"):
+                body = result.to_json()
             if probe is not None:
                 parsed, violations, use_node_names = probe
                 self.fastpath.filter_store(
@@ -274,6 +302,7 @@ class MetricsExtender:
         wirec = get_wirec()
         if wirec is None:
             return None
+        span = trace.of(request)
         try:
             parsed = wirec.parse_prioritize(request.body)
             use_node_names = False
@@ -305,19 +334,30 @@ class MetricsExtender:
                 violations, use_node_names, parsed
             )
             if body is not None:
+                span.set("filter_cache", "hit")
+                trace.COUNTERS.inc("pas_filter_cache_hit_total")
                 return HTTPResponse.json(body)
             if use_node_names and hasattr(wirec, "filter_encode"):
                 # span-cache miss, NodeNames mode: build the response
                 # natively (row lookup + violation partition + byte
                 # assembly in C) instead of paying the exact path's
-                # full Python decode; the result seeds the span cache
+                # full Python decode; the result seeds the span cache.
+                # The miss counts ONLY once the encode succeeded — a
+                # raise here lands in the outer except -> None -> the
+                # caller counts it a bypass, never miss+bypass
                 body = self.fastpath.filter_parsed(
                     wirec, view, parsed, violations
                 )
                 self.fastpath.filter_store(
                     violations, use_node_names, parsed, body
                 )
+                span.set("filter_cache", "miss")
+                trace.COUNTERS.inc("pas_filter_cache_miss_total")
                 return HTTPResponse.json(body)
+            # cacheable but missed: the exact path builds (and stores) the
+            # response via the returned token — still a miss
+            span.set("filter_cache", "miss")
+            trace.COUNTERS.inc("pas_filter_cache_miss_total")
             return parsed, violations, use_node_names
         except (ValueError, TypeError):
             return None
@@ -362,8 +402,10 @@ class MetricsExtender:
     def _prioritize_native_inner(
         self, wirec, request: HTTPRequest
     ) -> Optional[HTTPResponse]:
+        span = trace.of(request)
         # parse errors (ValueError/TypeError) propagate to the outer guard
-        parsed = wirec.parse_prioritize(request.body)
+        with span.stage("decode"):
+            parsed = wirec.parse_prioritize(request.body)
         use_node_names = False
         if not parsed.nodes_present or parsed.num_nodes == 0:
             if (
@@ -378,14 +420,17 @@ class MetricsExtender:
         policy_name = parsed.policy_label
         if policy_name is None:
             status = 400  # no label: 400 but still prioritize (-> empty)
+            trace.COUNTERS.inc("pas_prioritize_native_total")
             return HTTPResponse.json(encode_host_priority_list([]), status)
         namespace = parsed.pod_namespace or ""
         try:
             policy = self.cache.read_policy(namespace, policy_name)
         except Exception:
+            trace.COUNTERS.inc("pas_prioritize_native_total")
             return HTTPResponse.json(encode_host_priority_list([]), status)
         rule = self._scheduling_rule(policy)
         if rule is None:
+            trace.COUNTERS.inc("pas_prioritize_native_total")
             return HTTPResponse.json(encode_host_priority_list([]), status)
         pod = Pod(
             {"metadata": {"name": parsed.pod_name or "", "namespace": namespace}}
@@ -397,17 +442,28 @@ class MetricsExtender:
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
                 body = self.fastpath.prioritize_parsed(
-                    wirec, compiled, view, parsed, planned, use_node_names
+                    wirec, compiled, view, parsed, planned, use_node_names,
+                    span=span,
                 )
+                span.set("path", "native")
+                trace.COUNTERS.inc("pas_prioritize_native_total")
                 return HTTPResponse.json(body, status)
             except Exception as exc:
+                trace.COUNTERS.inc("pas_prioritize_host_fallback_total")
                 klog.error("native prioritize failed, host fallback: %s", exc)
         # host-only policy/metric: exact host semantics over the parsed names
+        span.set("path", "native_host")
         names = (
             parsed.node_names_list() if use_node_names else parsed.node_names()
         )
-        result = self._apply_plan(pod, self._prioritize_host(rule, names))
-        return HTTPResponse.json(encode_host_priority_list(result), status)
+        with span.stage("kernel"):
+            result = self._apply_plan(pod, self._prioritize_host(rule, names))
+        with span.stage("encode"):
+            body = encode_host_priority_list(result)
+        # partition counter only once the answer actually exists — an
+        # exception above falls to the exact path, which counts itself
+        trace.COUNTERS.inc("pas_prioritize_native_host_total")
+        return HTTPResponse.json(body, status)
 
     # -- decode ---------------------------------------------------------------
 
@@ -441,7 +497,9 @@ class MetricsExtender:
 
     # -- prioritize logic ------------------------------------------------------
 
-    def _prioritize_body(self, args: Args, names: List[str]) -> bytes:
+    def _prioritize_body(
+        self, args: Args, names: List[str], span=trace.NULL_SPAN
+    ) -> bytes:
         """prioritizeNodes (telemetryscheduler.go:81-100) down to response
         bytes: any failure degrades to an empty priority list."""
         try:
@@ -464,13 +522,21 @@ class MetricsExtender:
                 planned = (
                     self.planner.planned_node(args.pod) if self.planner else None
                 )
-                return self.fastpath.prioritize_bytes(
-                    compiled, view, names, planned
+                body = self.fastpath.prioritize_bytes(
+                    compiled, view, names, planned, span=span
                 )
+                span.set("path", "device")
+                return body
             except Exception as exc:  # device trouble must never fail the verb
+                trace.COUNTERS.inc("pas_prioritize_host_fallback_total")
                 klog.error("device prioritize failed, host fallback: %s", exc)
-        result = self._apply_plan(args.pod, self._prioritize_host(rule, names))
-        return encode_host_priority_list(result)
+        span.set("path", "host")
+        with span.stage("kernel"):
+            result = self._apply_plan(
+                args.pod, self._prioritize_host(rule, names)
+            )
+        with span.stage("encode"):
+            return encode_host_priority_list(result)
 
     def _apply_plan(
         self, pod: Pod, result: List[HostPriority]

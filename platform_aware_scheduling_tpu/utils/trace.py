@@ -1,0 +1,616 @@
+"""End-to-end request tracing: spans, path attribution, JAX compile
+visibility, and the metric-name inventory behind /metrics.
+
+The reference PAS suite has no tracing or profiling at all (SURVEY §5.1 —
+klog verbosity only).  This framework's north star is p99 Prioritize
+latency under concurrent load, so "where did this request's 4 ms go" must
+be answerable in production, not reconstructed from benchmarks:
+
+  * :class:`Span` — one per HTTP request, opened at connection accept in
+    BOTH front-ends (extender/server.py and serving/http.py), carrying a
+    generated-or-propagated ``X-Request-ID`` (echoed on every response,
+    including 503 backpressure rejections) and named child stage timings
+    (read, queue_wait, coalesce, decode, kernel, encode, write) recorded
+    by each layer as the request flows through;
+  * :class:`TraceBuffer` — a bounded, lock-light ring of recent completed
+    spans plus a bounded top-K of the slowest, served as JSON on
+    ``GET /debug/traces``;
+  * ``COUNTERS`` — process-wide path-attribution counters (fastpath
+    hit/miss, native vs host fallback, filter cache tiers) and JAX
+    compile/retrace counters, merged into ``/metrics``;
+  * :func:`watch_jit` / :func:`install_jax_hooks` — lowering-count shim
+    around the scoring kernels plus ``jax.monitoring`` listeners, so an
+    unexpected recompile in the hot path is a visible metric
+    (``pas_jax_retrace_total``), not a latency mystery;
+  * :data:`METRICS` — the single declared inventory of every metric name
+    this process may emit (``make trace-lint`` enforces the ``pas_``
+    prefix / snake_case convention and no duplicates against it);
+  * :func:`parse_prometheus_text` — an in-tree text-format parser used by
+    tests to prove ``/metrics`` is real Prometheus exposition.
+
+Tracing is always-on: a span costs two ``perf_counter`` reads per stage
+and one short lock acquisition at completion.  This module must stay
+importable without jax (the host layer's rule); everything jax touches is
+imported lazily.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from platform_aware_scheduling_tpu.utils.tracing import (
+    CounterSet,
+    LatencyRecorder,
+    histograms_text,
+)
+
+# ---------------------------------------------------------------------------
+# metric-name inventory
+# ---------------------------------------------------------------------------
+
+#: name -> (kind, help).  The ONE authority for every metric name this
+#: process may emit; tests/test_trace_lint.py asserts live /metrics
+#: output against it (pas_ prefix, snake_case, declared, no duplicates).
+METRICS: Dict[str, Tuple[str, str]] = {}
+
+
+def declare(name: str, kind: str, help_text: str) -> None:
+    if name in METRICS:
+        raise ValueError(f"metric {name!r} declared twice")
+    METRICS[name] = (kind, help_text)
+
+
+declare(
+    "pas_request_duration_seconds",
+    "histogram",
+    "Verb/stage wall latency (labels: verb).",
+)
+# serving micro-batcher (serving/dispatcher.py, serving/batch.py)
+declare("pas_serving_requests_total", "counter", "Requests submitted to the async dispatcher.")
+declare("pas_serving_batches_total", "counter", "Coalesced batches dispatched.")
+declare("pas_serving_batched_requests_total", "counter", "Requests served through coalesced batches.")
+declare("pas_serving_rejected_total", "counter", "Requests shed with 503 at a saturated admission queue.")
+declare("pas_serving_batch_fallback_total", "counter", "Batches that fell back to per-request routing.")
+declare("pas_serving_fused_solves_total", "counter", "Device computations performed by fused batch warms.")
+declare("pas_serving_queue_depth", "gauge", "Current admission-queue depth.")
+# path attribution (tas/telemetryscheduler.py, tas/fastpath.py).  The
+# three pas_prioritize_{native,native_host,exact}_total counters
+# PARTITION prioritize requests by the path that produced the answer;
+# host_fallback counts degradation EVENTS and overlaps them.
+declare("pas_prioritize_native_total", "counter", "Prioritize requests answered by the native wire path's device fastpath (incl. its trivial empty answers).")
+declare("pas_prioritize_native_host_total", "counter", "Prioritize requests on the native wire path answered with exact host semantics (host-only policy/metric, or after a device failure).")
+declare("pas_prioritize_exact_total", "counter", "Prioritize requests served by the exact Python path.")
+declare("pas_prioritize_host_fallback_total", "counter", "Device-path failures degraded to host semantics (events; overlaps the partition counters).")
+declare("pas_fastpath_response_hit_total", "counter", "Prioritize response-reuse cache hits (span memcmp).")
+declare("pas_fastpath_response_miss_total", "counter", "Prioritize response-reuse cache misses.")
+declare("pas_filter_cache_hit_total", "counter", "Filter response cache hits.")
+declare("pas_filter_cache_miss_total", "counter", "Filter cacheable requests that missed the response cache.")
+declare("pas_filter_cache_bypass_total", "counter", "Filter requests not cacheable (host-only policy, odd shapes, no native scanner).")
+declare("pas_gas_filter_device_total", "counter", "GAS Filter requests served by the vmapped device binpack.")
+declare("pas_gas_filter_host_total", "counter", "GAS Filter requests served by the host loop.")
+# JAX compile visibility (watch_jit shim + jax.monitoring listeners)
+declare("pas_jax_kernel_compile_total", "counter", "Lowerings of watched scoring kernels (watch_jit shim).")
+declare("pas_jax_retrace_total", "counter", "Watched-kernel lowerings past each kernel's first compile: unexpected hot-path retraces.")
+declare("pas_jax_backend_compile_total", "counter", "Process-wide XLA backend compilations (jax.monitoring).")
+declare("pas_jax_compile_seconds_total", "counter", "Process-wide seconds spent in XLA backend compilation.")
+# trace buffer health
+declare("pas_traces_recorded_total", "counter", "Completed spans recorded into the trace ring buffer.")
+
+#: process-wide counters: path attribution + JAX compile visibility.
+#: Layer-local CounterSets (the dispatcher's serving counters) stay where
+#: they are; everything request-path-shaped that crosses layers lands here.
+COUNTERS = CounterSet()
+
+
+# ---------------------------------------------------------------------------
+# request ids and spans
+# ---------------------------------------------------------------------------
+
+
+def new_request_id() -> str:
+    """A fresh X-Request-ID (uuid4 hex — 32 chars, no dashes)."""
+    return uuid.uuid4().hex
+
+
+class _StageTimer:
+    """``with span.stage("decode"):`` — one perf_counter pair."""
+
+    __slots__ = ("_span", "_name", "_t0")
+
+    def __init__(self, span: "Span", name: str):
+        self._span = span
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._span.add_stage(
+            self._name, time.perf_counter() - self._t0
+        )
+        return False
+
+
+class Span:
+    """One request's timeline: id, named child stages, attributes, links.
+
+    Not thread-safe by design: a span is owned by whichever thread is
+    currently serving its request (ownership hands off at well-defined
+    points — event loop -> batch worker -> event loop), never written
+    concurrently.  The ring buffer it lands in takes the lock."""
+
+    __slots__ = (
+        "trace_id",
+        "name",
+        "start_wall",
+        "_t0",
+        "duration_s",
+        "status",
+        "stages",
+        "attrs",
+        "links",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: Optional[str] = None,
+        t0: Optional[float] = None,
+    ):
+        self.trace_id = trace_id or new_request_id()
+        self.name = name
+        now = time.perf_counter()
+        self._t0 = t0 if t0 is not None else now
+        # wall-clock start, back-dated when t0 predates construction
+        self.start_wall = time.time() - (now - self._t0)
+        self.duration_s: Optional[float] = None
+        self.status: Optional[int] = None
+        self.stages: List[Tuple[str, float, float]] = []  # (name, start, dur)
+        self.attrs: Dict[str, object] = {}
+        self.links: List[str] = []
+
+    def stage(self, name: str) -> _StageTimer:
+        return _StageTimer(self, name)
+
+    def add_stage(self, name: str, seconds: float) -> None:
+        """Record a stage that just ended (start inferred from now)."""
+        offset = max(0.0, time.perf_counter() - self._t0 - seconds)
+        self.stages.append((name, offset, seconds))
+
+    def set(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def link(self, trace_id: str) -> None:
+        self.links.append(trace_id)
+
+    def finish(self, status: Optional[int] = None) -> "Span":
+        self.duration_s = time.perf_counter() - self._t0
+        if status is not None:
+            self.status = status
+        return self
+
+    def stage_seconds(self) -> Dict[str, float]:
+        """Total recorded seconds per stage name."""
+        out: Dict[str, float] = {}
+        for name, _start, dur in self.stages:
+            out[name] = out.get(name, 0.0) + dur
+        return out
+
+    def to_dict(self) -> Dict:
+        return {
+            "id": self.trace_id,
+            "name": self.name,
+            "status": self.status,
+            "start": round(self.start_wall, 6),
+            "duration_ms": round((self.duration_s or 0.0) * 1e3, 4),
+            "stages": [
+                {
+                    "name": name,
+                    "start_ms": round(start * 1e3, 4),
+                    "duration_ms": round(dur * 1e3, 4),
+                }
+                for name, start, dur in self.stages
+            ],
+            "attrs": dict(self.attrs),
+            "links": list(self.links),
+        }
+
+
+class _NullSpan:
+    """No-op span: instrumented code never branches on 'is tracing on'."""
+
+    __slots__ = ()
+    trace_id = ""
+    name = ""
+    duration_s = None
+    status = None
+    stages: List[Tuple[str, float, float]] = []
+    attrs: Dict[str, object] = {}
+    links: List[str] = []
+
+    def stage(self, name: str) -> "_NullStageTimer":
+        return _NULL_STAGE
+
+    def add_stage(self, name: str, seconds: float) -> None:
+        pass
+
+    def set(self, key: str, value) -> None:
+        pass
+
+    def link(self, trace_id: str) -> None:
+        pass
+
+    def finish(self, status: Optional[int] = None) -> "_NullSpan":
+        return self
+
+    def stage_seconds(self) -> Dict[str, float]:
+        return {}
+
+    def to_dict(self) -> Dict:
+        return {}
+
+
+class _NullStageTimer:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+_NULL_STAGE = _NullStageTimer()
+
+
+def of(request) -> Span:
+    """The span riding on an HTTPRequest, or the no-op span."""
+    span = getattr(request, "span", None)
+    return span if span is not None else NULL_SPAN
+
+
+# ---------------------------------------------------------------------------
+# trace ring buffer
+# ---------------------------------------------------------------------------
+
+
+class TraceBuffer:
+    """Bounded ring of recent completed spans + bounded top-K slowest.
+
+    Lock-light: one short lock per completed request (append + an
+    occasional sorted insert).  ``/debug/traces`` serves a snapshot; both
+    lists are hard-bounded so the endpoint can never grow without limit."""
+
+    def __init__(self, capacity: int = 256, slow_capacity: int = 32):
+        self.capacity = max(1, capacity)
+        self.slow_capacity = max(1, slow_capacity)
+        self._lock = threading.Lock()
+        self._recent: deque = deque(maxlen=self.capacity)
+        self._slow: List[Span] = []  # sorted by duration, slowest first
+
+    def add(self, span: Span) -> None:
+        if span.duration_s is None:
+            span.finish()
+        with self._lock:
+            self._recent.append(span)
+            slow = self._slow
+            if (
+                len(slow) < self.slow_capacity
+                or span.duration_s > slow[-1].duration_s
+            ):
+                # insertion point by duration desc (K is small: linear scan)
+                i = 0
+                while i < len(slow) and slow[i].duration_s >= span.duration_s:
+                    i += 1
+                slow.insert(i, span)
+                del slow[self.slow_capacity :]
+        COUNTERS.inc("pas_traces_recorded_total")
+
+    def find(self, trace_id: str) -> Optional[Span]:
+        with self._lock:
+            for span in reversed(self._recent):
+                if span.trace_id == trace_id:
+                    return span
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._recent.clear()
+            self._slow = []
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._recent)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            recent = list(self._recent)
+            slow = list(self._slow)
+        return {
+            "capacity": self.capacity,
+            "slow_capacity": self.slow_capacity,
+            "recent": [s.to_dict() for s in recent],
+            "slowest": [s.to_dict() for s in slow],
+        }
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.snapshot()).encode() + b"\n"
+
+
+#: the process-wide buffer both front-ends record into
+TRACES = TraceBuffer()
+
+
+# ---------------------------------------------------------------------------
+# JAX compile visibility
+# ---------------------------------------------------------------------------
+
+_jax_hooks_lock = threading.Lock()
+_jax_hooks_installed = False
+
+
+def install_jax_hooks(counters: Optional[CounterSet] = None) -> bool:
+    """Register ``jax.monitoring`` listeners feeding the compile counters.
+    Idempotent; returns False (and stays silent) when jax is absent —
+    the host layer must import without it."""
+    global _jax_hooks_installed
+    with _jax_hooks_lock:
+        if _jax_hooks_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:
+            return False
+        c = counters if counters is not None else COUNTERS
+
+        def _on_duration(name: str, duration: float, **kw) -> None:
+            if name.endswith("backend_compile_duration"):
+                c.inc("pas_jax_backend_compile_total")
+                c.inc("pas_jax_compile_seconds_total", duration)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _jax_hooks_installed = True
+        return True
+
+
+class _JitWatch:
+    """Lowering-count shim around one jitted kernel: growth of the jit
+    cache past the kernel's first compile is a RETRACE — the silent
+    latency cliff this exists to surface.  Attribute access delegates to
+    the wrapped function (``.lower``, NamedTuple returns, everything)."""
+
+    def __init__(self, name: str, fn, counters: CounterSet):
+        self._name = name
+        self._fn = fn
+        self._counters = counters
+        self._lock = threading.Lock()
+        self._seen = 0
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        size = self._fn._cache_size()
+        if size > self._seen:
+            with self._lock:
+                grew = size - self._seen
+                if grew <= 0:
+                    return out
+                first = self._seen == 0
+                self._seen = size
+            self._counters.inc("pas_jax_kernel_compile_total", grew)
+            retraces = grew - 1 if first else grew
+            if retraces > 0:
+                self._counters.inc("pas_jax_retrace_total", retraces)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def watch_jit(name: str, fn, counters: Optional[CounterSet] = None):
+    """Wrap a jitted callable with the retrace shim; a callable without a
+    jit cache (older jax, plain function) passes through untouched."""
+    if not hasattr(fn, "_cache_size"):
+        return fn
+    return _JitWatch(name, fn, counters if counters is not None else COUNTERS)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def help_texts() -> Dict[str, str]:
+    return {name: help_text for name, (_kind, help_text) in METRICS.items()}
+
+
+def exposition(
+    recorders: Iterable[LatencyRecorder] = (),
+    counter_sets: Iterable[CounterSet] = (),
+    include_global: bool = True,
+) -> str:
+    """One valid Prometheus text page: every recorder merged under the
+    single ``pas_request_duration_seconds`` family (one # TYPE line no
+    matter how many recorders feed it), then each counter set, then the
+    process-wide COUNTERS.  HELP text comes from the declared METRICS
+    inventory."""
+    helps = help_texts()
+    parts = [histograms_text(list(recorders), help_texts=helps)]
+    for cs in counter_sets:
+        parts.append(cs.prometheus_text(help_texts=helps))
+    if include_global:
+        parts.append(COUNTERS.prometheus_text(help_texts=helps))
+    return "".join(parts)
+
+
+def metrics_provider(
+    recorders: Iterable[LatencyRecorder] = (),
+    counter_sets: Iterable[CounterSet] = (),
+) -> Callable[[], str]:
+    """A zero-arg /metrics provider closing over the given sources."""
+    recorders = list(recorders)
+    counter_sets = list(counter_sets)
+    return lambda: exposition(recorders, counter_sets)
+
+
+_SAMPLE_VALUE_OK = {"+Inf", "-Inf", "NaN"}
+
+
+def _parse_labels(raw: str, line: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    rest = raw.strip()
+    while rest:
+        eq = rest.find("=")
+        if eq < 0 or len(rest) < eq + 2 or rest[eq + 1] != '"':
+            raise ValueError(f"bad label syntax: {line!r}")
+        name = rest[:eq].strip()
+        if not name.replace("_", "a").isalnum():
+            raise ValueError(f"bad label name {name!r}: {line!r}")
+        i = eq + 2
+        value = []
+        while i < len(rest):
+            ch = rest[i]
+            if ch == "\\":
+                if i + 1 >= len(rest):
+                    raise ValueError(f"dangling escape: {line!r}")
+                value.append({"n": "\n", "\\": "\\", '"': '"'}.get(
+                    rest[i + 1], rest[i + 1]
+                ))
+                i += 2
+                continue
+            if ch == '"':
+                break
+            value.append(ch)
+            i += 1
+        else:
+            raise ValueError(f"unterminated label value: {line!r}")
+        labels[name] = "".join(value)
+        rest = rest[i + 1 :].lstrip()
+        if rest.startswith(","):
+            rest = rest[1:].lstrip()
+        elif rest:
+            raise ValueError(f"junk after label value: {line!r}")
+    return labels
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Dict]:
+    """Parse (and validate) Prometheus text exposition v0.0.4.
+
+    Returns ``{family: {"type", "help", "samples": [(name, labels, value)]}}``
+    where histogram series (``_bucket``/``_sum``/``_count``) fold into
+    their base family.  Raises ValueError on: malformed sample lines,
+    duplicate ``# TYPE`` for a family, a TYPE appearing after the
+    family's samples, duplicate (name, labels) series, or a histogram
+    whose buckets are non-cumulative / missing the ``+Inf`` bucket."""
+    families: Dict[str, Dict] = {}
+    seen_series = set()
+
+    def family_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                if base in families and families[base]["type"] == "histogram":
+                    return base
+        return name
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("TYPE", "HELP"):
+                name = parts[2]
+                fam = families.setdefault(
+                    name, {"type": None, "help": None, "samples": []}
+                )
+                if parts[1] == "TYPE":
+                    kind = parts[3].strip() if len(parts) > 3 else ""
+                    if kind not in (
+                        "counter", "gauge", "histogram", "summary", "untyped"
+                    ):
+                        raise ValueError(f"line {lineno}: bad TYPE {kind!r}")
+                    if fam["type"] is not None:
+                        raise ValueError(
+                            f"line {lineno}: duplicate TYPE for {name}"
+                        )
+                    if fam["samples"]:
+                        raise ValueError(
+                            f"line {lineno}: TYPE after samples of {name}"
+                        )
+                    fam["type"] = kind
+                else:
+                    fam["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        # sample line: name[{labels}] value [timestamp]
+        brace = line.find("{")
+        labels: Dict[str, str] = {}
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                raise ValueError(f"line {lineno}: unbalanced braces")
+            name = line[:brace]
+            labels = _parse_labels(line[brace + 1 : close], line)
+            rest = line[close + 1 :].strip()
+        else:
+            fields = line.split()
+            if len(fields) < 2:
+                raise ValueError(f"line {lineno}: no value: {line!r}")
+            name = fields[0]
+            rest = " ".join(fields[1:])
+        if not name or not all(
+            c.isalnum() or c in "_:" for c in name
+        ) or name[0].isdigit():
+            raise ValueError(f"line {lineno}: bad metric name {name!r}")
+        value_str = rest.split()[0] if rest else ""
+        try:
+            value = float(value_str)
+        except ValueError:
+            if value_str not in _SAMPLE_VALUE_OK:
+                raise ValueError(
+                    f"line {lineno}: bad value {value_str!r}"
+                ) from None
+            value = float(value_str.replace("Inf", "inf"))
+        series_key = (name, tuple(sorted(labels.items())))
+        if series_key in seen_series:
+            raise ValueError(f"line {lineno}: duplicate series {series_key}")
+        seen_series.add(series_key)
+        fam = families.setdefault(
+            family_of(name), {"type": None, "help": None, "samples": []}
+        )
+        fam["samples"].append((name, labels, value))
+
+    # histogram shape checks: cumulative buckets ending at +Inf == count
+    for family, data in families.items():
+        if data["type"] != "histogram":
+            continue
+        by_labelset: Dict[tuple, Dict] = {}
+        for name, labels, value in data["samples"]:
+            key = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            entry = by_labelset.setdefault(
+                key, {"buckets": [], "count": None}
+            )
+            if name.endswith("_bucket"):
+                entry["buckets"].append((labels.get("le", ""), value))
+            elif name.endswith("_count"):
+                entry["count"] = value
+        for key, entry in by_labelset.items():
+            buckets = entry["buckets"]
+            if not buckets:
+                raise ValueError(f"{family}{key}: histogram without buckets")
+            if "+Inf" not in [le for le, _ in buckets]:
+                raise ValueError(f"{family}{key}: missing +Inf bucket")
+            values = [v for _, v in buckets]
+            if any(b > a for a, b in zip(values[1:], values)):
+                raise ValueError(f"{family}{key}: non-cumulative buckets")
+            inf_value = dict(buckets)["+Inf"]
+            if entry["count"] is not None and inf_value != entry["count"]:
+                raise ValueError(f"{family}{key}: +Inf bucket != count")
+    return families

@@ -1,42 +1,66 @@
-"""Per-request latency tracing.
+"""Per-request latency tracing primitives.
 
 The reference has no tracing/profiling at all (SURVEY §5.1: no pprof, no
 OpenTelemetry — only klog verbosity).  Since this framework's north-star
 metric is p99 Prioritize latency, latency histograms are built in: every
-extender verb records into a :class:`LatencyRecorder`, exposed as a
-Prometheus-style text dump (and consumed by bench.py).
+extender verb records into a :class:`LatencyRecorder`, and serving-layer
+counters live in :class:`CounterSet`, both exposed as real Prometheus
+text exposition (``# HELP``/``# TYPE``, ``_bucket``/``_sum``/``_count``
+histogram series) on ``/metrics`` and consumed by bench.py.
+
+The request-level span model, the trace ring buffer, and the metric-name
+inventory build on these in utils/trace.py (docs/observability.md).
 """
 
 from __future__ import annotations
 
+import math
 import threading
 from collections import deque
-from typing import Deque, Dict, Iterable, List, Tuple
+from typing import Deque, Dict, Iterable, List, Optional, Tuple
 
 # exponential bucket bounds in seconds: 100us .. ~105s
 _BUCKETS: List[float] = [0.0001 * (2**i) for i in range(21)]
 
 
 def quantile(sorted_values: List[float], q: float) -> float:
+    """Nearest-rank quantile over an ascending-sorted sample.
+
+    ``ceil(q * n)`` is the classic nearest-rank definition: p99 of 100
+    samples is the 99th value (index 98), p50 of 4 samples is the 2nd.
+    The previous ``int(q * n)`` overshot by one rank — for small windows
+    p99 collapsed to the out-of-range-clamped max every time."""
     if not sorted_values:
         return 0.0
-    idx = min(len(sorted_values) - 1, max(0, int(q * len(sorted_values))))
+    rank = math.ceil(q * len(sorted_values))
+    idx = min(len(sorted_values) - 1, max(0, rank - 1))
     return sorted_values[idx]
+
+
+def _fmt_value(value) -> str:
+    """Prometheus sample value: ints stay exact, floats go %g."""
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, float) and value.is_integer():
+        return str(int(value))
+    return f"{value:g}"
 
 
 class CounterSet:
     """Thread-safe named counters and gauges with Prometheus text
-    exposition — the non-latency half of the serving subsystem's metrics
-    (queue depth, admission rejections, batch sizes; docs/serving.md).
-    Names are emitted verbatim, so callers pass fully-qualified metric
-    names (``pas_serving_queue_depth`` etc.)."""
+    exposition — the non-latency half of the serving metrics (queue
+    depth, admission rejections, batch sizes; docs/serving.md) and the
+    path-attribution / JAX-compile counters (utils/trace.py).  Names are
+    emitted verbatim, so callers pass fully-qualified metric names
+    (``pas_serving_queue_depth`` etc.; the inventory lives in
+    trace.METRICS and ``make trace-lint`` enforces it)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {}
+        self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
 
-    def inc(self, name: str, by: int = 1) -> None:
+    def inc(self, name: str, by: float = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + by
 
@@ -44,18 +68,43 @@ class CounterSet:
         with self._lock:
             self._gauges[name] = value
 
-    def get(self, name: str) -> float:
+    def get(self, name: str, kind: Optional[str] = None) -> float:
+        """The value under ``name``.  When a counter and a gauge collide
+        on one name, ``kind`` ("counter" or "gauge") disambiguates;
+        without it the counter wins (the historical precedence)."""
         with self._lock:
+            if kind == "counter":
+                return self._counters.get(name, 0)
+            if kind == "gauge":
+                return self._gauges.get(name, 0)
+            if kind is not None:
+                raise ValueError(f"unknown kind {kind!r}")
             if name in self._counters:
                 return self._counters[name]
             return self._gauges.get(name, 0)
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(
+        self, help_texts: Optional[Dict[str, str]] = None
+    ) -> str:
+        """Valid exposition: ``# HELP`` (when the name is in the declared
+        inventory) + ``# TYPE`` per family, then the sample.  A name
+        colliding across counter and gauge emits the counter only — two
+        TYPE lines for one name would be invalid exposition (get(kind=)
+        still reads both)."""
         with self._lock:
             counters = sorted(self._counters.items())
-            gauges = sorted(self._gauges.items())
-        lines = [f"{name} {value}" for name, value in counters]
-        lines += [f"{name} {value:g}" for name, value in gauges]
+            gauges = sorted(
+                (name, value)
+                for name, value in self._gauges.items()
+                if name not in self._counters
+            )
+        lines: List[str] = []
+        for kind, items in (("counter", counters), ("gauge", gauges)):
+            for name, value in items:
+                if help_texts and name in help_texts:
+                    lines.append(f"# HELP {name} {help_texts[name]}")
+                lines.append(f"# TYPE {name} {kind}")
+                lines.append(f"{name} {_fmt_value(value)}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -106,29 +155,64 @@ class LatencyRecorder:
             "max": samples[-1] if samples else 0.0,
         }
 
+    def snapshot(self) -> Dict[str, Tuple[List[int], int, float]]:
+        """label -> (bucket counts copy, count, sum): the merge surface
+        behind :func:`histograms_text` (several recorders, one family)."""
+        with self._lock:
+            return {
+                label: (list(buckets), self._counts[label], self._sums[label])
+                for label, buckets in self._buckets.items()
+            }
+
     def prometheus_text(self) -> str:
         """Cumulative-histogram text exposition (the format the reference's
         own metrics pipeline scrapes, docs/custom-metrics.md)."""
-        lines: List[str] = []
-        with self._lock:
-            items: Iterable[Tuple[str, List[int]]] = list(self._buckets.items())
-            counts = dict(self._counts)
-            sums = dict(self._sums)
-        for label, buckets in items:
-            cumulative = 0
-            for bound, n in zip(_BUCKETS, buckets):
-                cumulative += n
-                lines.append(
-                    f'pas_request_duration_seconds_bucket{{verb="{label}",le="{bound:g}"}} {cumulative}'
+        return histograms_text([self])
+
+
+HISTOGRAM_METRIC = "pas_request_duration_seconds"
+
+
+def histograms_text(
+    recorders: Iterable["LatencyRecorder"],
+    metric: str = HISTOGRAM_METRIC,
+    help_texts: Optional[Dict[str, str]] = None,
+) -> str:
+    """All recorders' labels merged under ONE histogram family with a
+    single ``# TYPE`` line — concatenating per-recorder dumps would emit
+    duplicate family headers, which is invalid exposition.  A label
+    recorded by several recorders sums (the serving layer and a verb
+    handler never share labels in practice, but the merge must still be
+    well-formed exposition if they do)."""
+    merged: Dict[str, Tuple[List[int], int, float]] = {}
+    for recorder in recorders:
+        for label, (buckets, count, total) in recorder.snapshot().items():
+            if label in merged:
+                old_buckets, old_count, old_sum = merged[label]
+                merged[label] = (
+                    [a + b for a, b in zip(old_buckets, buckets)],
+                    old_count + count,
+                    old_sum + total,
                 )
-            cumulative += buckets[-1]
+            else:
+                merged[label] = (buckets, count, total)
+    if not merged:
+        return ""
+    help_text = (help_texts or {}).get(metric)
+    lines: List[str] = []
+    if help_text:
+        lines.append(f"# HELP {metric} {help_text}")
+    lines.append(f"# TYPE {metric} histogram")
+    for label in sorted(merged):
+        buckets, count, total = merged[label]
+        cumulative = 0
+        for bound, n in zip(_BUCKETS, buckets):
+            cumulative += n
             lines.append(
-                f'pas_request_duration_seconds_bucket{{verb="{label}",le="+Inf"}} {cumulative}'
+                f'{metric}_bucket{{verb="{label}",le="{bound:g}"}} {cumulative}'
             )
-            lines.append(
-                f'pas_request_duration_seconds_sum{{verb="{label}"}} {sums[label]:.9f}'
-            )
-            lines.append(
-                f'pas_request_duration_seconds_count{{verb="{label}"}} {counts[label]}'
-            )
-        return "\n".join(lines) + ("\n" if lines else "")
+        cumulative += buckets[-1]
+        lines.append(f'{metric}_bucket{{verb="{label}",le="+Inf"}} {cumulative}')
+        lines.append(f'{metric}_sum{{verb="{label}"}} {total:.9f}')
+        lines.append(f'{metric}_count{{verb="{label}"}} {count}')
+    return "\n".join(lines) + "\n"

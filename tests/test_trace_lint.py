@@ -1,0 +1,107 @@
+"""Metric-name convention gate (``make trace-lint``, wired into CI):
+every metric this process can emit is declared once in trace.METRICS,
+follows the ``pas_`` prefix + snake_case convention with the Prometheus
+suffix rules, and live /metrics output contains ONLY declared families
+whose TYPE matches the declaration.  A new metric that skips the
+inventory fails here, not in a scrape dashboard three rounds later."""
+
+import re
+
+from benchmarks.http_load import build_extender, make_bodies
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.utils import trace
+
+NAME_RE = re.compile(r"^pas_[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+KINDS = {"counter", "gauge", "histogram"}
+
+
+class TestDeclaredInventory:
+    def test_names_follow_convention(self):
+        assert trace.METRICS, "the inventory must not be empty"
+        for name, (kind, help_text) in trace.METRICS.items():
+            assert NAME_RE.match(name), f"{name}: not pas_ snake_case"
+            assert kind in KINDS, f"{name}: unknown kind {kind}"
+            assert help_text.strip(), f"{name}: empty help text"
+
+    def test_suffix_conventions(self):
+        """Counters end in _total (Prometheus naming convention); gauges
+        and histograms must NOT claim the counter suffix."""
+        for name, (kind, _help) in trace.METRICS.items():
+            if kind == "counter":
+                assert name.endswith("_total"), f"{name}: counter sans _total"
+            else:
+                assert not name.endswith("_total"), (
+                    f"{name}: _total reserved for counters"
+                )
+
+    def test_declare_rejects_redeclaration(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            trace.declare("pas_request_duration_seconds", "counter", "dup")
+
+
+class TestLiveEmission:
+    """Drive both front-ends, scrape /metrics, and hold every emitted
+    family against the declared inventory."""
+
+    def _assert_only_declared(self, text: str) -> None:
+        families = trace.parse_prometheus_text(text)
+        assert families, "live /metrics must not be empty"
+        for family, data in families.items():
+            assert family in trace.METRICS, f"undeclared metric {family!r}"
+            declared_kind, _help = trace.METRICS[family]
+            assert data["type"] == declared_kind, (
+                f"{family}: emitted TYPE {data['type']} != declared "
+                f"{declared_kind}"
+            )
+            for name, _labels, _value in data["samples"]:
+                base = family if name.startswith(family) else name
+                assert NAME_RE.match(base), f"sample {name!r} off-convention"
+
+    def test_threaded_front_end_emits_declared_names_only(self):
+        ext, names = build_extender(48, device=True)
+        body = make_bodies(names, "nodenames", count=1)[0]
+        for path in ("/scheduler/prioritize", "/scheduler/filter"):
+            ext.__getattribute__(path.rsplit("/", 1)[1])(
+                HTTPRequest(
+                    method="POST",
+                    path=path,
+                    headers={"Content-Type": "application/json"},
+                    body=body,
+                )
+            )
+        self._assert_only_declared(ext.metrics_text())
+
+    def test_async_front_end_emits_declared_names_only(self):
+        from wirehelpers import post_bytes, raw_request, start_async
+
+        ext, names = build_extender(48, device=True)
+        server = start_async(ext)
+        try:
+            body = make_bodies(names, "nodenames", count=1)[0]
+            status, _, _ = raw_request(
+                server.port, post_bytes("/scheduler/prioritize", body)
+            )
+            assert status == 200
+            text = server._router.metrics_provider()
+            self._assert_only_declared(text)
+        finally:
+            server.shutdown()
+
+    def test_gas_extender_emits_declared_names_only(self):
+        from platform_aware_scheduling_tpu.gas.scheduler import GASExtender
+        from platform_aware_scheduling_tpu.testing.fake_kube import (
+            FakeKubeClient,
+        )
+
+        ext = GASExtender(FakeKubeClient(), use_device=False)
+        ext.filter(
+            HTTPRequest(
+                method="POST",
+                path="/scheduler/filter",
+                headers={"Content-Type": "application/json"},
+                body=b"{}",
+            )
+        )
+        self._assert_only_declared(ext.metrics_text())

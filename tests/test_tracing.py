@@ -1,0 +1,218 @@
+"""utils/tracing.py primitives: nearest-rank quantiles, counter/gauge
+disambiguation, thread-safety under concurrent observe/inc, empty-label
+dumps, window rollover, and real Prometheus exposition (round-tripped
+through the in-tree parser, utils/trace.py)."""
+
+import threading
+
+import pytest
+
+from platform_aware_scheduling_tpu.utils import trace
+from platform_aware_scheduling_tpu.utils.tracing import (
+    CounterSet,
+    LatencyRecorder,
+    histograms_text,
+    quantile,
+)
+
+
+class TestQuantile:
+    def test_nearest_rank_p99_of_100(self):
+        """p99 of 100 samples is the 99th value (index 98) — the old
+        int(q*n) indexing overshot to the clamped max."""
+        values = [float(i) for i in range(1, 101)]  # 1..100
+        assert quantile(values, 0.99) == 99.0
+        assert quantile(values, 0.50) == 50.0
+        assert quantile(values, 0.90) == 90.0
+
+    def test_small_windows(self):
+        assert quantile([1.0, 2.0, 3.0, 4.0], 0.50) == 2.0  # ceil(2)=2nd
+        assert quantile([1.0, 2.0, 3.0, 4.0], 0.99) == 4.0
+        assert quantile([7.0], 0.99) == 7.0
+        assert quantile([7.0], 0.01) == 7.0
+
+    def test_edges(self):
+        assert quantile([], 0.99) == 0.0
+        values = [1.0, 2.0, 3.0]
+        assert quantile(values, 0.0) == 1.0
+        assert quantile(values, 1.0) == 3.0
+        # q past 1 clamps to the max, never out of range
+        assert quantile(values, 1.5) == 3.0
+
+    def test_p99_not_max_for_large_samples(self):
+        """With 200 samples and one outlier, p99 (198th value) must NOT
+        collapse to the outlier max."""
+        values = [1.0] * 199 + [100.0]
+        assert quantile(values, 0.99) == 1.0
+        assert max(values) == 100.0
+
+
+class TestCounterSet:
+    def test_counter_gauge_name_collision(self):
+        cs = CounterSet()
+        cs.inc("pas_thing", 3)
+        cs.set_gauge("pas_thing", 99.5)
+        assert cs.get("pas_thing", kind="counter") == 3
+        assert cs.get("pas_thing", kind="gauge") == 99.5
+        # historical precedence without kind: the counter wins
+        assert cs.get("pas_thing") == 3
+        with pytest.raises(ValueError):
+            cs.get("pas_thing", kind="bogus")
+
+    def test_missing_names_read_zero(self):
+        cs = CounterSet()
+        assert cs.get("nope") == 0
+        assert cs.get("nope", kind="counter") == 0
+        assert cs.get("nope", kind="gauge") == 0
+
+    def test_float_increments(self):
+        cs = CounterSet()
+        cs.inc("pas_seconds_total", 0.25)
+        cs.inc("pas_seconds_total", 0.5)
+        assert cs.get("pas_seconds_total") == 0.75
+
+    def test_exposition_types_and_collision_validity(self):
+        """A counter/gauge name collision must still render as VALID
+        exposition (one TYPE line per name)."""
+        cs = CounterSet()
+        cs.inc("pas_a_total", 2)
+        cs.set_gauge("pas_depth", 7)
+        cs.inc("pas_clash", 1)
+        cs.set_gauge("pas_clash", 5)
+        text = cs.prometheus_text(help_texts={"pas_a_total": "a things"})
+        fams = trace.parse_prometheus_text(text)
+        assert fams["pas_a_total"]["type"] == "counter"
+        assert fams["pas_a_total"]["help"] == "a things"
+        assert fams["pas_depth"]["type"] == "gauge"
+        assert fams["pas_clash"]["type"] == "counter"
+        assert text.count("pas_clash") == 2  # one TYPE + one sample
+
+    def test_empty_dump(self):
+        assert CounterSet().prometheus_text() == ""
+
+
+class TestLatencyRecorder:
+    def test_empty_label_dumps(self):
+        rec = LatencyRecorder()
+        assert rec.prometheus_text() == ""
+        assert rec.labels() == []
+        summary = rec.summary("never_observed")
+        assert summary["count"] == 0
+        assert summary["p99"] == 0.0
+        assert summary["max"] == 0.0
+
+    def test_window_rollover(self):
+        """Counts/sums keep the full history; the quantile window is
+        bounded and rolls to the most recent samples."""
+        rec = LatencyRecorder(window=8)
+        for i in range(20):
+            rec.observe("verb", float(i))
+        s = rec.summary("verb")
+        assert s["count"] == 20
+        # window holds 12..19 only: p50 = nearest-rank 4th of 8 = 15
+        assert s["p50"] == 15.0
+        assert s["max"] == 19.0
+        assert s["mean"] == pytest.approx(sum(range(20)) / 20)
+
+    def test_concurrent_observe_and_inc(self):
+        """N threads hammering observe()/inc() concurrently lose nothing:
+        totals are exact afterward."""
+        rec = LatencyRecorder()
+        cs = CounterSet()
+        threads_n, per_thread = 8, 500
+        barrier = threading.Barrier(threads_n)
+
+        def worker(k):
+            barrier.wait(10)
+            for i in range(per_thread):
+                rec.observe(f"label_{k % 2}", 0.001)
+                cs.inc("pas_total")
+                cs.set_gauge("pas_gauge", i)
+
+        threads = [
+            threading.Thread(target=worker, args=(k,))
+            for k in range(threads_n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        total = sum(rec.summary(lbl)["count"] for lbl in rec.labels())
+        assert total == threads_n * per_thread
+        assert cs.get("pas_total") == threads_n * per_thread
+        # the exposition renders while the structures are warm
+        fams = trace.parse_prometheus_text(
+            rec.prometheus_text() + cs.prometheus_text()
+        )
+        count_samples = {
+            labels["verb"]: value
+            for name, labels, value in fams["pas_request_duration_seconds"][
+                "samples"
+            ]
+            if name.endswith("_count")
+        }
+        assert sum(count_samples.values()) == threads_n * per_thread
+
+    def test_histogram_merge_single_family(self):
+        """Several recorders render under ONE # TYPE header with their
+        shared labels summed — never duplicate family headers."""
+        a, b = LatencyRecorder(), LatencyRecorder()
+        a.observe("x", 0.001)
+        a.observe("shared", 0.001)
+        b.observe("shared", 0.002)
+        text = histograms_text([a, b], help_texts=trace.help_texts())
+        assert text.count("# TYPE pas_request_duration_seconds") == 1
+        fams = trace.parse_prometheus_text(text)
+        counts = {
+            labels["verb"]: value
+            for name, labels, value in fams["pas_request_duration_seconds"][
+                "samples"
+            ]
+            if name.endswith("_count")
+        }
+        assert counts == {"x": 1, "shared": 2}
+
+
+class TestPrometheusParser:
+    """The in-tree text-format parser rejects what a real scraper would."""
+
+    def test_rejects_duplicate_type(self):
+        with pytest.raises(ValueError, match="duplicate TYPE"):
+            trace.parse_prometheus_text(
+                "# TYPE pas_x counter\n# TYPE pas_x gauge\npas_x 1\n"
+            )
+
+    def test_rejects_duplicate_series(self):
+        with pytest.raises(ValueError, match="duplicate series"):
+            trace.parse_prometheus_text("pas_x 1\npas_x 2\n")
+
+    def test_rejects_bad_value(self):
+        with pytest.raises(ValueError, match="bad value"):
+            trace.parse_prometheus_text("pas_x nope\n")
+
+    def test_rejects_missing_inf_bucket(self):
+        bad = (
+            "# TYPE pas_h histogram\n"
+            'pas_h_bucket{le="1"} 1\n'
+            "pas_h_sum 1\npas_h_count 1\n"
+        )
+        with pytest.raises(ValueError, match="missing \\+Inf"):
+            trace.parse_prometheus_text(bad)
+
+    def test_rejects_non_cumulative_buckets(self):
+        bad = (
+            "# TYPE pas_h histogram\n"
+            'pas_h_bucket{le="1"} 5\n'
+            'pas_h_bucket{le="2"} 3\n'
+            'pas_h_bucket{le="+Inf"} 5\n'
+        )
+        with pytest.raises(ValueError, match="non-cumulative"):
+            trace.parse_prometheus_text(bad)
+
+    def test_parses_escaped_labels(self):
+        fams = trace.parse_prometheus_text(
+            'pas_x{verb="a\\"b\\\\c"} 2.5\n'
+        )
+        ((name, labels, value),) = fams["pas_x"]["samples"]
+        assert labels == {"verb": 'a"b\\c'}
+        assert value == 2.5

@@ -1,0 +1,78 @@
+"""Shared raw-socket HTTP helpers for the tracing/observability suites:
+one place owns the test-side wire framing (request rendering, response
+parse, server starters) so a framing change never has to be fixed in
+several copies."""
+
+import socket
+import threading
+
+from platform_aware_scheduling_tpu.extender.server import Server
+from platform_aware_scheduling_tpu.serving import AsyncServer
+
+
+def start_threaded(ext) -> Server:
+    server = Server(ext, metrics_provider=ext.metrics_text)
+    threading.Thread(
+        target=lambda: server.start_server(
+            port="0", unsafe=True, host="127.0.0.1", block=True
+        ),
+        daemon=True,
+    ).start()
+    assert server.wait_ready(10)
+    return server
+
+
+def start_async(ext, **kwargs) -> AsyncServer:
+    server = AsyncServer(ext, **kwargs)
+    server.start_server(port="0", unsafe=True, host="127.0.0.1", block=False)
+    assert server.wait_ready(10)
+    return server
+
+
+def post_bytes(path: str, body: bytes, extra: str = "") -> bytes:
+    """Rendered POST request bytes (keep-alive, JSON content type)."""
+    return (
+        f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Type: application/json\r\n{extra}"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+
+
+def raw_request(port: int, payload: bytes, timeout: float = 15.0):
+    """(status, lowercased headers, body) for one request over a fresh
+    socket."""
+    sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        sock.sendall(payload)
+        buf = bytearray()
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(1 << 16)
+            if not chunk:
+                raise ConnectionError("closed before header")
+            buf += chunk
+        head, _, rest = bytes(buf).partition(b"\r\n\r\n")
+        lines = head.split(b"\r\n")
+        status = int(lines[0].split(b" ", 2)[1])
+        headers = {}
+        length = 0
+        for line in lines[1:]:
+            name, _, value = line.partition(b":")
+            headers[name.decode().lower()] = value.strip().decode()
+            if name.lower() == b"content-length":
+                length = int(value)
+        body = bytearray(rest)
+        while len(body) < length:
+            chunk = sock.recv(1 << 16)
+            if not chunk:
+                raise ConnectionError("closed mid-body")
+            body += chunk
+        return status, headers, bytes(body[:length])
+    finally:
+        sock.close()
+
+
+def get_request(port: int, path: str):
+    payload = (
+        f"GET {path} HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n"
+    ).encode()
+    return raw_request(port, payload)
