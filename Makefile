@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving test-serving trace-lint lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving test-serving test-obs trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -43,6 +43,18 @@ test-serving:
 # duplicates, and live /metrics output parses as valid exposition
 trace-lint:
 	python -m pytest tests/test_trace_lint.py -q
+
+# control-plane & device observability suite: /healthz + /readyz
+# condition toggling on both front-ends, workqueue/informer
+# instrumentation, device watermarks / cost analysis / profile capture
+test-obs:
+	python -m pytest tests/test_health.py tests/test_kube_instrumentation.py \
+		tests/test_devicewatch.py -q
+
+# one-command deployment sanity check: boot both front-ends and curl
+# /healthz, /readyz, /metrics, /debug/traces (docs/observability.md)
+obs-smoke:
+	python -m benchmarks.obs_smoke
 
 # BASELINE configs #2/#3/#4/#5 + solver surface + mesh checks alone
 bench-configs:

@@ -274,6 +274,15 @@ def main():
         # per-stage attribution (scraped from /debug/traces): the detail
         # artifact carries the full breakdown; the stderr line answers
         # "where does a device-path request spend its time" at a glance
+        obs = (load.get("device") or {}).get("observability") or {}
+        if "ready" in obs:
+            device_families = sorted((obs.get("device") or {}).keys())
+            print(
+                f"http_load observability: ready={obs['ready']} "
+                f"flaps={obs.get('ready_transitions', 0)} "
+                f"device_families={device_families}",
+                file=sys.stderr,
+            )
         stages = (load.get("device") or {}).get("stages") or {}
         if stages.get("stages"):
             top = ", ".join(

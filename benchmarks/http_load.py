@@ -120,7 +120,7 @@ def build_service(
 
         server = AsyncServer(ext)
     else:
-        server = Server(ext)
+        server = Server(ext, metrics_provider=ext.metrics_text)
     server.start_server(port="0", unsafe=True, host="127.0.0.1", block=False)
     server.wait_ready()
     return server, names
@@ -281,6 +281,21 @@ _PATHS = {
 }
 
 
+def http_get(port: int, path: str, timeout: float = 10.0):
+    """(status, body) for one GET against a local live service — the one
+    scrape-side HTTP helper (stage breakdowns, observability scrapes,
+    obs_smoke all ride it)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        response = conn.getresponse()
+        return response.status, response.read()
+    finally:
+        conn.close()
+
+
 def scrape_stage_breakdown(port: int) -> Dict:
     """Per-stage latency attribution from the live service's
     ``/debug/traces`` ring (utils/trace.py): mean/total milliseconds per
@@ -288,14 +303,7 @@ def scrape_stage_breakdown(port: int) -> Dict:
     This is what gives the BENCH_DETAIL artifact per-stage attribution —
     'where did the p99 go' (read/queue_wait/coalesce/decode/kernel/
     encode/write) instead of one opaque number."""
-    import http.client
-
-    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
-    try:
-        conn.request("GET", "/debug/traces")
-        payload = conn.getresponse().read()
-    finally:
-        conn.close()
+    _status, payload = http_get(port, "/debug/traces")
     data = json.loads(payload)
     stages: Dict[str, Dict[str, float]] = {}
     count = 0
@@ -320,6 +328,45 @@ def scrape_stage_breakdown(port: int) -> Dict:
             if agg["count"]
         },
     }
+
+
+def scrape_observability(port: int) -> Dict:
+    """Control-plane & device health from the live service: readiness
+    state + flap count (/readyz, pas_ready_transitions_total) and the
+    device memory watermark / kernel-cost gauges from /metrics
+    (utils/devicewatch.py).  Rides the BENCH_DETAIL artifact next to the
+    stage breakdowns: a bench round that ran against a not-ready or
+    memory-pressured service says so in its own artifact."""
+    from platform_aware_scheduling_tpu.utils import trace
+
+    out: Dict = {}
+    # two evaluations so pas_ready / the flap counter reflect NOW
+    status, payload = http_get(port, "/readyz")
+    status, payload = http_get(port, "/readyz")
+    out["ready"] = status == 200
+    try:
+        out["conditions"] = json.loads(payload).get("conditions", [])
+    except ValueError:
+        out["conditions"] = []
+    status, payload = http_get(port, "/metrics")
+    if status != 200:
+        out["metrics_error"] = f"status {status}"
+        return out
+    families = trace.parse_prometheus_text(payload.decode())
+    device: Dict[str, Dict[str, float]] = {}
+    for family, data in families.items():
+        if not family.startswith("pas_device_"):
+            continue
+        device[family] = {
+            ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "_": value
+            for _name, labels, value in data["samples"]
+        }
+    out["device"] = device
+    flaps = families.get("pas_ready_transitions_total")
+    out["ready_transitions"] = (
+        flaps["samples"][0][2] if flaps and flaps["samples"] else 0
+    )
+    return out
 
 
 def _configs(concurrency_sweep) -> List[tuple]:
@@ -351,12 +398,17 @@ def _serve_forever(
 
     GC posture (applies to BOTH sides of the A/B): the same serving
     tuning the production mains apply (utils/gctuning.py)."""
+    from platform_aware_scheduling_tpu.utils import devicewatch
     from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 
+    # device visibility, same wiring as the production mains: the cost
+    # capture must precede the warm pass's first kernel compiles
+    devicewatch.install_cost_hooks()
     if builder is not None:
         server, _ = builder(num_nodes, device=device)
     else:
         server, _ = build_service(num_nodes, device=device, serving=serving)
+    devicewatch.DeviceWatcher(period_s=2.0).start()
     tune_for_serving()
     print(f"READY {server.port}", flush=True)
     threading.Event().wait()
@@ -491,13 +543,17 @@ def run(
                 side["stages"] = scrape_stage_breakdown(port)
             except Exception as exc:  # stages are best-effort diagnostics
                 side["stages"] = {"error": str(exc)}
+            try:  # readiness + device watermarks ride it too
+                side["observability"] = scrape_observability(port)
+            except Exception as exc:
+                side["observability"] = {"error": str(exc)}
             out[label] = side
         finally:
             proc.terminate()
             proc.wait(timeout=10)
     speedups: Dict[str, Dict[str, float]] = {}
     for key, dev in out["device"].items():
-        if key == "stages":  # attribution, not a latency config
+        if key in ("stages", "observability"):  # diagnostics, not configs
             continue
         ctl = out["control"].get(key)
         if ctl:
@@ -564,6 +620,10 @@ def serving_scaling(
                 side["stages"] = scrape_stage_breakdown(port)
             except Exception as exc:
                 side["stages"] = {"error": str(exc)}
+            try:  # readiness flaps under load + device watermarks
+                side["observability"] = scrape_observability(port)
+            except Exception as exc:
+                side["observability"] = {"error": str(exc)}
             c0 = f"c{concurrency_sweep[0]}"
             for conc in concurrency_sweep[1:]:
                 key = f"c{conc}"

@@ -1,0 +1,62 @@
+"""Flags and startup shared by the TAS and GAS service mains.
+
+One helper owns the ``--profilePort`` flag AND the
+``jax.profiler.start_server`` startup so the two mains cannot drift
+(the GAS main historically lacked the flag entirely); same for the
+device/observability wiring (cost-analysis hooks + the memory-watermark
+sampler, utils/devicewatch.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+from typing import Optional
+
+from platform_aware_scheduling_tpu.utils import devicewatch, klog
+
+
+def add_profile_flag(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--profilePort", type=int, default=0,
+                        help="start the JAX profiler server on this port "
+                        "(0 = off): connect TensorBoard/xprof on demand to "
+                        "trace the device kernels with zero steady-state "
+                        "overhead (SURVEY §5.1 — the reference has no "
+                        "tracing at all)")
+
+
+def maybe_start_profiler(port: int) -> bool:
+    """Start the JAX profiler server when ``port`` is nonzero; returns
+    whether it is serving.  Profiling must never block serving — any
+    failure logs and the main continues."""
+    if not port:
+        return False
+    try:
+        import jax.profiler
+
+        jax.profiler.start_server(port)
+        klog.v(1).info_s(
+            f"JAX profiler serving on :{port}", component="extender"
+        )
+        return True
+    except Exception as exc:
+        klog.error("profiler server failed: %s", exc)
+        return False
+
+
+def install_cost_visibility() -> None:
+    """Install the one-shot per-kernel cost-analysis capture
+    (utils/devicewatch.py).  Call BEFORE assembly — the capture hangs
+    off each watched kernel's FIRST compile, which assembly's warm pass
+    triggers."""
+    devicewatch.install_cost_hooks()
+
+
+def start_device_watch(
+    stop: Optional[threading.Event] = None, sample_period_s: float = 10.0
+) -> devicewatch.DeviceWatcher:
+    """Start the device memory-watermark sampler on a daemon thread
+    (graceful no-op on CPU); returns the watcher."""
+    watcher = devicewatch.DeviceWatcher(period_s=sample_period_s)
+    watcher.start(stop=stop)
+    return watcher

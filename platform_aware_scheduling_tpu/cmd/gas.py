@@ -12,6 +12,7 @@ import signal
 import threading
 from typing import List, Optional
 
+from platform_aware_scheduling_tpu.cmd import common
 from platform_aware_scheduling_tpu.gas.scheduler import GASExtender
 from platform_aware_scheduling_tpu.kube.client import get_kube_client
 from platform_aware_scheduling_tpu.utils import klog
@@ -44,6 +45,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--queueDepth", type=int, default=256,
                         help="async serving: admission queue bound; past it "
                         "requests get 503 + Retry-After")
+    # parity with cmd/tas.py via the one shared helper (cmd/common.py)
+    common.add_profile_flag(parser)
     return parser
 
 
@@ -52,7 +55,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     klog.set_verbosity(args.v)
 
     kube_client = get_kube_client(args.kubeConfig)
+    # before the extender warms its device binpack kernels (cost capture
+    # rides each kernel's first compile)
+    common.install_cost_visibility()
     extender = GASExtender(kube_client)
+
+    common.maybe_start_profiler(args.profilePort)
+    watch_stop = threading.Event()
+    common.start_device_watch(stop=watch_stop)
 
     from platform_aware_scheduling_tpu.cmd.tas import build_server
     from platform_aware_scheduling_tpu.utils.duration import parse_duration
@@ -89,6 +99,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: done.set())
     done.wait()
+    watch_stop.set()
     extender.cache.stop()
     server.shutdown()
     klog.v(1).info_s("Exiting", component="extender")

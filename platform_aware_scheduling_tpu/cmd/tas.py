@@ -15,6 +15,7 @@ import signal
 import threading
 from typing import List, Optional
 
+from platform_aware_scheduling_tpu.cmd import common
 from platform_aware_scheduling_tpu.extender.server import Server
 from platform_aware_scheduling_tpu.kube.client import KubeClient, get_kube_client
 from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
@@ -79,12 +80,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--queueDepth", type=int, default=256,
                         help="async serving: admission queue bound; past it "
                         "requests get 503 + Retry-After")
-    parser.add_argument("--profilePort", type=int, default=0,
-                        help="start the JAX profiler server on this port "
-                        "(0 = off): connect TensorBoard/xprof on demand to "
-                        "trace the device kernels with zero steady-state "
-                        "overhead (SURVEY §5.1 — the reference has no "
-                        "tracing at all)")
+    common.add_profile_flag(parser)
     return parser
 
 
@@ -175,7 +171,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     kube_client = get_kube_client(args.kubeConfig)
     metrics_client = CustomMetricsClient(kube_client)
-    _, _, extender, _, _, stop = assemble(
+    # cost-analysis capture hangs off each kernel's FIRST compile, which
+    # assemble's warm pass triggers — install before assembly
+    common.install_cost_visibility()
+    _, _, extender, controller, _, stop = assemble(
         kube_client,
         metrics_client,
         sync_period_s,
@@ -184,17 +183,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         node_cache_capable=args.nodeCacheCapable,
     )
 
-    if args.profilePort:
-        try:
-            import jax.profiler
-
-            jax.profiler.start_server(args.profilePort)
-            klog.v(1).info_s(
-                f"JAX profiler serving on :{args.profilePort}",
-                component="extender",
-            )
-        except Exception as exc:  # profiling must never block serving
-            klog.error("profiler server failed: %s", exc)
+    common.maybe_start_profiler(args.profilePort)
+    common.start_device_watch(stop=stop)
 
     from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 
@@ -206,6 +196,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_batch=args.batchMax,
         max_queue_depth=args.queueDepth,
     )
+    # /readyz also waits on the TASPolicy CRD informer's initial list —
+    # the extender's own conditions (warm + telemetry freshness) come
+    # from its readiness_conditions() via the server's probe
+    if controller.informer is not None:
+        from platform_aware_scheduling_tpu.utils import health
+
+        server.probe.register(
+            "policy_informer_synced",
+            health.informer_synced(controller.informer, "taspolicy"),
+        )
     done = threading.Event()
     failed = []
 

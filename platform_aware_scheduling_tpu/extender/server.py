@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, TYPE_CHECKING
 
-from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils import devicewatch, health, klog, trace
 
 if TYPE_CHECKING:  # pragma: no cover
     from platform_aware_scheduling_tpu.extender.types import Scheduler
@@ -315,20 +315,54 @@ class Server:
     """Wraps a Scheduler implementation with the HTTP(S) extender endpoint
     (reference extender/types.go:18-20, scheduler.go:86-143)."""
 
-    def __init__(self, scheduler: "Scheduler", metrics_provider=None):
+    def __init__(self, scheduler: "Scheduler", metrics_provider=None, probe=None):
         """``metrics_provider``: optional zero-arg callable returning
         Prometheus exposition text, served on GET /metrics.  The reference
         consumes metrics but exports none of its own (SURVEY §5.5); since
         this framework's north star is p99 latency, the extenders' latency
-        histograms (utils/tracing.py) are exported here."""
+        histograms (utils/tracing.py) are exported here.
+
+        ``probe``: the /readyz ReadinessProbe; defaults to one seeded from
+        the scheduler's ``readiness_conditions()`` duck-type
+        (utils/health.py) — a scheduler without conditions is always
+        ready."""
         self.scheduler = scheduler
         self.metrics_provider = metrics_provider
+        self.probe = probe if probe is not None else health.probe_for(scheduler)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._ready = threading.Event()
 
     # -- routing -------------------------------------------------------------
 
     def route(self, request: HTTPRequest) -> HTTPResponse:
+        # structured log lines emitted while serving this request carry
+        # its X-Request-ID (utils/klog.py), so /debug/traces entries can
+        # be joined against the logs
+        rid = getattr(trace.of(request), "trace_id", "")
+        with klog.request_context(rid):
+            return self._route(request)
+
+    def _route(self, request: HTTPRequest) -> HTTPResponse:
+        bare_path = request.path.partition("?")[0]
+        if bare_path == "/healthz":
+            # process liveness: answering at all IS the signal
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            return HTTPResponse.json(health.HEALTHZ_BODY)
+        if bare_path == "/readyz":
+            # composite readiness (utils/health.py): 503 + reason list
+            # until kernels are warm, telemetry is fresh, informers are
+            # synced, and (async) the admission queue has headroom
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            status, body = self.probe.readyz_response()
+            return HTTPResponse.json(body, status=status)
+        if bare_path == "/debug/profile":
+            # bounded on-demand jax.profiler capture (utils/devicewatch.py)
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            status, body = devicewatch.profile_response(request.path)
+            return HTTPResponse.json(body, status=status)
         if request.path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of
             # recent + slowest completed request traces as JSON.  Always

@@ -96,7 +96,7 @@ class Cache:
         start: bool = True,
     ):
         self.kube_client = kube_client
-        self.work_queue = WorkQueue()
+        self.work_queue = WorkQueue(name="gas_pods")
         self.annotated_pods: Dict[str, str] = {}
         self.node_statuses: Dict[str, NodeResources] = {}
         self._rwmutex = threading.RLock()
@@ -116,6 +116,7 @@ class Cache:
             on_update=lambda _old, new: self._node_event(new),
             on_delete=self._node_deleted,
             resync_period=resync_period_s,
+            name="gas_nodes",
         )
         self._pod_informer = Informer(
             ListWatch(
@@ -130,6 +131,7 @@ class Cache:
             on_delete=self._delete_pod_from_cache,
             filter_func=self._filter,
             resync_period=resync_period_s,
+            name="gas_pods",
         )
         self._worker: Optional[threading.Thread] = None
         if start:
@@ -150,6 +152,28 @@ class Cache:
         self.work_queue.shut_down()
         self._node_informer.stop()
         self._pod_informer.stop()
+
+    def has_synced(self) -> bool:
+        """True once both informers delivered their initial list."""
+        return (
+            self._node_informer.has_synced()
+            and self._pod_informer.has_synced()
+        )
+
+    def synced_condition(self):
+        """The /readyz condition form of :meth:`has_synced`
+        (utils/health.py)."""
+        pending = [
+            name
+            for name, informer in (
+                ("nodes", self._node_informer),
+                ("pods", self._pod_informer),
+            )
+            if not informer.has_synced()
+        ]
+        if pending:
+            return False, f"informers not yet synced: {pending}"
+        return True, "node + pod informers synced"
 
     def wait_settled(self, timeout: float = 5.0) -> bool:
         """Test helper: wait until the work queue drains."""

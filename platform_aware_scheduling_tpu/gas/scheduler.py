@@ -79,6 +79,9 @@ class GASExtender:
         self.kube_client = kube_client
         self.cache = cache if cache is not None else Cache(kube_client)
         self.recorder = recorder or LatencyRecorder()
+        # workqueue work-latency histogram merges into this extender's
+        # pas_request_duration_seconds family (verb="workqueue_work")
+        self.cache.work_queue.recorder = self.recorder
         self._rwmutex = threading.RLock()
         self._device = None
         if use_device:
@@ -92,6 +95,13 @@ class GASExtender:
     def metrics_text(self) -> str:
         """The /metrics provider for this extender (utils/trace.py)."""
         return trace.exposition(recorders=[self.recorder])
+
+    def readiness_conditions(self):
+        """The /readyz conditions GAS contributes (utils/health.py):
+        node + pod informer sync — GAS serves from its resource cache,
+        so answering before the initial lists land would bind against
+        a fictional cluster."""
+        return [("informers_synced", self.cache.synced_condition)]
 
     def prioritize(self, request: HTTPRequest) -> HTTPResponse:
         # not implemented by GAS (scheduler.go:515-519)

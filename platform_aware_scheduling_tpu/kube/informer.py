@@ -19,7 +19,8 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 
 
 @dataclass
@@ -54,8 +55,22 @@ class Informer:
         on_delete: Optional[Callable[[Any], None]] = None,
         resync_period: float = 0.0,
         filter_func: Optional[Callable[[Any], bool]] = None,
+        name: str = "",
+        counters: Optional[CounterSet] = None,
     ):
+        """A NAMED informer exports controller-loop health
+        (docs/observability.md): ``pas_informer_relists_total`` /
+        ``pas_informer_watch_errors_total`` counters and the
+        ``pas_informer_synced`` gauge (0 until the initial list
+        delivers), all labeled ``informer=<name>``.  Unnamed informers
+        stay silent."""
         self._lw = list_watch
+        self.name = name
+        self.counters = counters if counters is not None else trace.COUNTERS
+        if name:
+            self.counters.set_gauge(
+                "pas_informer_synced", 0, labels={"informer": name}
+            )
         self._on_add = on_add or (lambda obj: None)
         self._on_update = on_update or (lambda old, new: None)
         self._on_delete = on_delete or (lambda obj: None)
@@ -136,6 +151,10 @@ class Informer:
                 self._on_delete(obj)
 
     def _relist(self, initial: bool) -> None:
+        if self.name:
+            self.counters.inc(
+                "pas_informer_relists_total", labels={"informer": self.name}
+            )
         objects, rv = self._lw.list()
         new_state = {self._lw.key(obj): obj for obj in objects}
         with self._store_lock:
@@ -184,6 +203,11 @@ class Informer:
                 self._relist(initial=first)
                 first = False
                 self._synced.set()
+                if self.name:
+                    self.counters.set_gauge(
+                        "pas_informer_synced", 1,
+                        labels={"informer": self.name},
+                    )
                 for event_type, obj in self._lw.watch(self._resource_version):
                     if self._stop.is_set():
                         return
@@ -210,5 +234,10 @@ class Informer:
             except Exception as exc:  # watch broke: back off, re-list
                 if self._stop.is_set():
                     return
+                if self.name:
+                    self.counters.inc(
+                        "pas_informer_watch_errors_total",
+                        labels={"informer": self.name},
+                    )
                 klog.v(4).info_s(f"informer watch error, relisting: {exc}")
                 self._stop.wait(0.2)

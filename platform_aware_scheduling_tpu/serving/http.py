@@ -102,8 +102,13 @@ class AsyncServer:
                 recorders=[self.recorder], counter_sets=[self.counters]
             )
 
-        # unstarted Server: routing + middleware + /metrics only
+        # unstarted Server: routing + middleware + /metrics/health only
         self._router = Server(scheduler, metrics_provider=provider)
+        # readiness gains the async-only condition: admission-queue
+        # headroom.  A saturated queue answers /readyz 503 (with the
+        # queue named in the reasons) while the endpoint itself stays
+        # readable — it bypasses the very queue it reports on
+        self._router.probe.register("admission_queue", self._queue_condition)
         self.batch = BatchExecutor(self._router)
         self.dispatcher = MicroBatchDispatcher(
             route=self._router.route,
@@ -121,6 +126,19 @@ class AsyncServer:
         self._thread: Optional[threading.Thread] = None
         self._port: Optional[int] = None
         self._startup_error: Optional[BaseException] = None
+
+    @property
+    def probe(self):
+        """The /readyz ReadinessProbe (scheduler conditions + the
+        admission-queue condition registered above)."""
+        return self._router.probe
+
+    def _queue_condition(self):
+        depth = len(self.dispatcher._queue)
+        limit = self.dispatcher.max_queue_depth
+        if depth >= limit:
+            return False, f"admission queue saturated ({depth}/{limit})"
+        return True, f"depth {depth}/{limit}"
 
     # -- serving ---------------------------------------------------------------
 
@@ -273,13 +291,27 @@ class AsyncServer:
                     method=method, path=path, headers=headers, body=body,
                     span=span,
                 )
-                if path in ("/metrics", "/debug/traces"):
+                bare_path = path.partition("?")[0]
+                if bare_path in (
+                    "/metrics", "/debug/traces", "/healthz", "/readyz",
+                ):
                     # observability endpoints bypass the admission queue:
                     # they must stay readable precisely when the queue is
                     # saturated (the condition they exist to diagnose),
                     # and they never touch the device
                     try:
                         response = self._router.route(request)
+                    except Exception as exc:
+                        klog.error("handler raised: %r", exc)
+                        response = HTTPResponse(status=500)
+                elif bare_path == "/debug/profile":
+                    # also bypasses the queue, but the bounded capture
+                    # SLEEPS for the requested window — run it off-loop
+                    # so the event loop keeps serving meanwhile
+                    try:
+                        response = await asyncio.get_running_loop().run_in_executor(
+                            None, self._router.route, request
+                        )
                     except Exception as exc:
                         klog.error("handler raised: %r", exc)
                         response = HTTPResponse(status=500)

@@ -19,11 +19,13 @@ sync period.  Mutation listeners let the device-tensor mirror
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from platform_aware_scheduling_tpu.tas.metrics import Client, NodeMetricsInfo
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 
 POLICY_PATH = "policies/{}/{}"
 METRIC_PATH = "metrics/{}"
@@ -58,10 +60,22 @@ class _SerializedStore:
 class AutoUpdatingCache:
     """Reader/Writer/SelfUpdating cache (reference pkg/cache/types.go)."""
 
-    def __init__(self):
+    def __init__(self, counters: Optional[CounterSet] = None):
         self._store = _SerializedStore()
         self._metric_refcounts: Dict[str, int] = {}
         self._mtx = threading.Lock()
+        # telemetry-freshness bookkeeping (docs/observability.md): when
+        # each metric last carried data, when the last refresh pass
+        # completed, and the configured refresh period — the inputs to
+        # the /readyz "telemetry_fresh" condition and the
+        # pas_telemetry_* metric families
+        self.counters = counters if counters is not None else trace.COUNTERS
+        self._last_refresh: Dict[str, float] = {}  # metric -> monotonic
+        self._last_pass: Optional[float] = None
+        self._refresh_period: Optional[float] = None
+        self._synced_once = threading.Event()
+        #: freshness bound override (seconds); None = 3x the refresh period
+        self.freshness_max_age_s: Optional[float] = None
         # held across store mutation + hook delivery so mirror subscribers
         # observe mutations in store order (the reference gets this from its
         # single cache goroutine, cache.go:43-63)
@@ -107,6 +121,11 @@ class AutoUpdatingCache:
                     self._metric_refcounts[metric_name] = (
                         self._metric_refcounts.get(metric_name, 0) + 1
                     )
+            else:
+                # a data-bearing write IS a refresh — the freshness clock
+                # this metric is judged by (telemetry_freshness)
+                with self._mtx:
+                    self._last_refresh[metric_name] = time.monotonic()
             for hook in self.on_metric_write:
                 hook(metric_name, payload)
 
@@ -130,12 +149,20 @@ class AutoUpdatingCache:
                 if total == 1:
                     del self._metric_refcounts[metric_name]
                     self._store.delete(METRIC_PATH.format(metric_name))
+                    self._last_refresh.pop(metric_name, None)
                     evicted = True
                 elif total is not None:
                     self._metric_refcounts[metric_name] = total - 1
                 else:
                     self._metric_refcounts[metric_name] = -1
             if evicted:
+                # the age gauge must not stay frozen in /metrics for a
+                # metric that no longer exists
+                self.counters.remove(
+                    "pas_telemetry_metric_age_seconds",
+                    labels={"metric": metric_name},
+                    kind="gauge",
+                )
                 for hook in self.on_metric_delete:
                     hook(metric_name)
 
@@ -148,6 +175,7 @@ class AutoUpdatingCache:
     def update_all_metrics(self, client: Client) -> None:
         with self._mtx:
             names = list(self._metric_refcounts)
+        errors = 0
         for name in names:
             if not name:
                 with self._mtx:
@@ -156,7 +184,86 @@ class AutoUpdatingCache:
             try:
                 self._update_metric(client, name)
             except Exception as exc:
+                errors += 1
                 klog.v(2).info_s(str(exc), component="controller")
+        # pass accounting: refresh counters + per-metric age gauges (a
+        # metric whose fetch keeps failing shows a GROWING age while the
+        # loop itself keeps ticking — the two failure modes separate)
+        now = time.monotonic()
+        with self._mtx:
+            self._last_pass = now
+            ages = {
+                name: now - stamp
+                for name, stamp in self._last_refresh.items()
+                if name in self._metric_refcounts
+            }
+        self._synced_once.set()
+        self.counters.inc("pas_telemetry_refresh_total")
+        if errors:
+            self.counters.inc("pas_telemetry_refresh_errors_total", errors)
+        for name, age in ages.items():
+            self.counters.set_gauge(
+                "pas_telemetry_metric_age_seconds",
+                round(age, 6),
+                labels={"metric": name},
+            )
+
+    def metric_ages(self) -> Dict[str, Optional[float]]:
+        """Registered metric -> seconds since its last data-bearing write
+        (None = never refreshed)."""
+        now = time.monotonic()
+        with self._mtx:
+            return {
+                name: (
+                    now - self._last_refresh[name]
+                    if name in self._last_refresh
+                    else None
+                )
+                for name in self._metric_refcounts
+                if name
+            }
+
+    def telemetry_freshness(self) -> Tuple[bool, str]:
+        """The /readyz "telemetry_fresh" condition (utils/health.py):
+        ok when the cache has no refresh loop configured (static seed —
+        as fresh as it gets), or when at least one refresh pass has
+        completed, the loop's last pass is recent, and every registered
+        metric's age is within bound (``freshness_max_age_s``, default
+        3x the refresh period)."""
+        period = self._refresh_period
+        if period is None:
+            return True, "static cache (no refresh loop configured)"
+        if not self._synced_once.is_set():
+            return False, "telemetry cache has not completed a refresh pass"
+        bound = (
+            self.freshness_max_age_s
+            if self.freshness_max_age_s is not None
+            else max(3.0 * period, 1.0)
+        )
+        now = time.monotonic()
+        with self._mtx:
+            last_pass = self._last_pass
+            stale = sorted(
+                name
+                for name in self._metric_refcounts
+                if name
+                and (
+                    name not in self._last_refresh
+                    or now - self._last_refresh[name] > bound
+                )
+            )
+            registered = sum(1 for name in self._metric_refcounts if name)
+        if last_pass is None or now - last_pass > bound:
+            since = "never" if last_pass is None else f"{now - last_pass:.1f}s"
+            return False, (
+                f"refresh loop stalled (last pass {since} ago, bound "
+                f"{bound:.1f}s)"
+            )
+        if stale:
+            return False, (
+                f"metrics stale past {bound:.1f}s: {stale[:5]}"
+            )
+        return True, f"{registered} metrics fresh within {bound:.1f}s"
 
     def _update_metric(self, client: Client, metric_name: str) -> None:
         info = client.get_node_metric(metric_name)
@@ -173,6 +280,7 @@ class AutoUpdatingCache:
         (autoupdating.go:37-43: update first, then wait the tick)."""
         for key, value in (initial_data or {}).items():
             self._store.add(key, value)
+        self._refresh_period = period_seconds
         stop = stop or threading.Event()
         while not stop.is_set():
             self.update_all_metrics(client)
@@ -187,6 +295,7 @@ class AutoUpdatingCache:
     ) -> threading.Event:
         """Run :meth:`periodic_update` on a daemon thread; returns the stop
         event (caller-supplied ``stop`` is used when given)."""
+        self._refresh_period = period_seconds
         stop = stop or threading.Event()
         thread = threading.Thread(
             target=self.periodic_update,

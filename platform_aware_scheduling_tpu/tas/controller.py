@@ -69,7 +69,9 @@ class TelemetryPolicyController:
         self.cache = cache
         self.enforcer = enforcer
         self.namespace = namespace
-        self._informer: Optional[Informer] = None
+        #: the CRD informer once :meth:`run` starts it — the mains feed
+        #: its has_synced into /readyz (utils/health.informer_synced)
+        self.informer: Optional[Informer] = None
 
     # -- lifecycle (controller.go:23-57) --------------------------------------
 
@@ -93,19 +95,21 @@ class TelemetryPolicyController:
         def key(policy: TASPolicy) -> str:
             return f"{policy.namespace}/{policy.name}"
 
-        self._informer = Informer(
+        informer = Informer(
             ListWatch(list_policies, watch_policies, key),
             on_add=self._guarded(self.on_add),
             on_update=self._guarded(self.on_update),
             on_delete=self._guarded(self.on_delete),
+            name="taspolicy",
         )
-        self._informer.start()
+        self.informer = informer
+        informer.start()
         if stop is not None:
             threading.Thread(
-                target=lambda: (stop.wait(), self._informer.stop()),
+                target=lambda: (stop.wait(), informer.stop()),
                 daemon=True,
             ).start()
-        return self._informer
+        return informer
 
     def _guarded(self, fn):
         def wrapped(*args):

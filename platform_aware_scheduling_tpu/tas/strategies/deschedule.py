@@ -19,7 +19,7 @@ from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
     TASPolicyStrategy,
 )
 from platform_aware_scheduling_tpu.tas.strategies import core
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
 
 STRATEGY_TYPE = "deschedule"
 
@@ -36,6 +36,9 @@ class Strategy:
     # -- violation detection (strategy.go:31-55) -----------------------------
 
     def violated(self, cache) -> Dict[str, None]:
+        trace.COUNTERS.inc(
+            "pas_strategy_evaluations_total", labels={"strategy": STRATEGY_TYPE}
+        )
         violating: Dict[str, None] = {}
         for rule in self.rules:
             try:
@@ -51,6 +54,12 @@ class Strategy:
                         component="controller",
                     )
                     violating[node_name] = None
+        if violating:
+            trace.COUNTERS.inc(
+                "pas_strategy_violations_total",
+                len(violating),
+                labels={"strategy": STRATEGY_TYPE},
+            )
         return violating
 
     def violated_device(self, mirror) -> "Dict[str, None] | None":
@@ -90,9 +99,23 @@ class Strategy:
             rules = compiled.device_rules("deschedule")
             mask = np.asarray(violated_nodes(view.values, view.present, rules))
             names = view.node_names
-            return {
+            violating = {
                 names[i]: None for i in np.nonzero(mask)[0] if i < len(names)
             }
+            # same counters the host path keeps — the evaluation happened,
+            # just on the device (None returns fall through to the host
+            # path, which counts itself)
+            trace.COUNTERS.inc(
+                "pas_strategy_evaluations_total",
+                labels={"strategy": STRATEGY_TYPE},
+            )
+            if violating:
+                trace.COUNTERS.inc(
+                    "pas_strategy_violations_total",
+                    len(violating),
+                    labels={"strategy": STRATEGY_TYPE},
+                )
+            return violating
         except Exception as exc:
             klog.error("device deschedule failed, host fallback: %s", exc)
             return None
@@ -108,7 +131,11 @@ class Strategy:
             klog.v(2).info_s(f"cannot list nodes: {exc}", component="controller")
             raise
         violations = self._node_status_for_strategy(enforcer, cache)
-        return self._update_node_labels(enforcer, violations, nodes)
+        total = self._update_node_labels(enforcer, violations, nodes)
+        trace.COUNTERS.inc(
+            "pas_strategy_enforcements_total", labels={"strategy": STRATEGY_TYPE}
+        )
+        return total
 
     def cleanup(self, enforcer: core.MetricEnforcer, policy_name: str) -> None:
         """Remove the violation label from labeled nodes when the policy is

@@ -15,7 +15,7 @@ from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
     TASPolicyStrategy,
 )
 from platform_aware_scheduling_tpu.tas.strategies import core
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import klog, trace
 
 STRATEGY_TYPE = "dontschedule"
 
@@ -32,6 +32,9 @@ class Strategy:
     def violated(self, cache) -> Dict[str, None]:
         """Nodes whose current metric values violate any rule
         (strategy.go:25-44).  Unreadable metrics are skipped."""
+        trace.COUNTERS.inc(
+            "pas_strategy_evaluations_total", labels={"strategy": STRATEGY_TYPE}
+        )
         violating: Dict[str, None] = {}
         for rule in self.rules:
             try:
@@ -47,6 +50,12 @@ class Strategy:
                         component="controller",
                     )
                     violating[node_name] = None
+        if violating:
+            trace.COUNTERS.inc(
+                "pas_strategy_violations_total",
+                len(violating),
+                labels={"strategy": STRATEGY_TYPE},
+            )
         return violating
 
     def enforce(self, enforcer, cache) -> int:

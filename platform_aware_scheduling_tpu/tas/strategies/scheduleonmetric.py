@@ -15,6 +15,7 @@ from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
     TASPolicyStrategy,
 )
 from platform_aware_scheduling_tpu.tas.strategies import core
+from platform_aware_scheduling_tpu.utils import trace
 
 STRATEGY_TYPE = "scheduleonmetric"
 
@@ -29,6 +30,11 @@ class Strategy:
         return cls(policy_name=strat.policy_name, rules=list(strat.rules))
 
     def violated(self, cache) -> Dict[str, None]:
+        # a no-op by contract (strategy.go:20-22), but the enforcer DID
+        # evaluate it — visible on the per-strategy counter
+        trace.COUNTERS.inc(
+            "pas_strategy_evaluations_total", labels={"strategy": STRATEGY_TYPE}
+        )
         return {}
 
     def enforce(self, enforcer, cache) -> int:

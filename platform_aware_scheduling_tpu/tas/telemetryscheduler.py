@@ -97,6 +97,9 @@ class MetricsExtender:
         # encoder (tas/fastpath.py) — the per-request device dispatch and
         # per-node Python objects the round-1 verdict flagged are gone
         self.fastpath = PrioritizeFastPath() if mirror is not None else None
+        # /readyz "kernels_warmed": flips true at the end of the first
+        # SUCCESSFUL warm pass (a warm that raised leaves it false)
+        self._warmed = False
         if mirror is not None:
             # warm the fastpath from the state-refresh threads: every
             # mirror publish precomputes rankings/violations/tables for the
@@ -132,8 +135,28 @@ class MetricsExtender:
             for compiled in policies:
                 if self._filter_device_eligible(compiled, host_only):
                     fastpath.violating_names(compiled, view)
+            self._warmed = True
         except Exception as exc:  # warming must never break the writer
             klog.error("fastpath warm failed: %s", exc)
+
+    # -- readiness (utils/health.py) -------------------------------------------
+
+    def readiness_conditions(self):
+        """The /readyz conditions this extender contributes: kernels
+        warmed (device fastpath precomputed at least once) and telemetry
+        freshness (cache synced + every registered metric's age within
+        bound).  The front-end layers queue headroom on top."""
+        return [
+            ("kernels_warmed", self._warm_status),
+            ("telemetry_fresh", self.cache.telemetry_freshness),
+        ]
+
+    def _warm_status(self):
+        if self.fastpath is None:
+            return True, "host-only mode (no device path to warm)"
+        if self._warmed:
+            return True, "fastpath warmed"
+        return False, "fastpath warm has not completed"
 
     def warm_batch(self, path: str, requests: List[HTTPRequest]) -> int:
         """Serving micro-batch hook (serving/batch.py): warm every device

@@ -10,15 +10,41 @@ This module provides the same surface — ``v(level).info_s(msg, component=..)``
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import os
 import sys
 import threading
+from contextlib import contextmanager
 
 _logger = logging.getLogger("pas_tpu")
 _lock = threading.Lock()
 _verbosity = int(os.environ.get("PAS_TPU_LOG_LEVEL", "0") or 0)
 _configured = False
+
+# the active request's X-Request-ID (utils/trace.py span id), stamped
+# onto every structured line emitted while serving that request so a
+# trace in /debug/traces can be joined against the logs.  A ContextVar
+# follows both the threaded handler (one thread per request) and the
+# async dispatcher's worker (route runs synchronously per request).
+_request_id: contextvars.ContextVar = contextvars.ContextVar(
+    "pas_request_id", default=""
+)
+
+
+@contextmanager
+def request_context(request_id: str):
+    """Scope the current request id: structured lines (``info_s``) inside
+    the scope carry ``request_id="..."`` automatically."""
+    token = _request_id.set(request_id or "")
+    try:
+        yield
+    finally:
+        _request_id.reset(token)
+
+
+def current_request_id() -> str:
+    return _request_id.get()
 
 
 def _ensure_configured() -> None:
@@ -46,10 +72,26 @@ def verbosity() -> int:
     return _verbosity
 
 
+def _escape_value(value) -> str:
+    # structured values render inside double quotes on one line; a
+    # client-controlled value (X-Request-ID rides in here) must not be
+    # able to forge fields or break the line
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+        .replace("\r", "\\r")
+    )
+
+
 def _fmt(msg: str, kv: dict) -> str:
+    rid = _request_id.get()
+    if rid and "request_id" not in kv:
+        kv = {**kv, "request_id": rid}
     if not kv:
         return msg
-    pairs = " ".join(f'{k}="{v}"' for k, v in kv.items())
+    pairs = " ".join(f'{k}="{_escape_value(v)}"' for k, v in kv.items())
     return f"{msg} {pairs}"
 
 

@@ -100,6 +100,33 @@ declare("pas_jax_backend_compile_total", "counter", "Process-wide XLA backend co
 declare("pas_jax_compile_seconds_total", "counter", "Process-wide seconds spent in XLA backend compilation.")
 # trace buffer health
 declare("pas_traces_recorded_total", "counter", "Completed spans recorded into the trace ring buffer.")
+# health & readiness (utils/health.py: /healthz + /readyz on both front-ends)
+declare("pas_ready", "gauge", "Composite readiness: 1 when every /readyz condition holds, else 0.")
+declare("pas_ready_transitions_total", "counter", "Readiness flips (ready <-> not ready) observed across /readyz evaluations.")
+# telemetry cache & controller health (tas/cache.py refresh loop,
+# tas/strategies evaluation counters)
+declare("pas_telemetry_metric_age_seconds", "gauge", "Seconds since each registered telemetry metric's last successful refresh (label: metric).")
+declare("pas_telemetry_refresh_total", "counter", "Telemetry cache refresh passes completed.")
+declare("pas_telemetry_refresh_errors_total", "counter", "Individual metric fetch failures across refresh passes.")
+declare("pas_strategy_evaluations_total", "counter", "Strategy violation evaluations (label: strategy).")
+declare("pas_strategy_violations_total", "counter", "Violating nodes found by strategy evaluations (label: strategy).")
+declare("pas_strategy_enforcements_total", "counter", "Enforcement passes completed without error (label: strategy); pairs with pas_strategy_violations_total for whether they changed anything.")
+# controller plumbing (kube/workqueue.py + kube/informer.py; named
+# instances only — an unnamed queue/informer stays silent)
+declare("pas_workqueue_depth", "gauge", "Current work-queue depth (label: queue).")
+declare("pas_workqueue_adds_total", "counter", "Items accepted into the work queue (label: queue).")
+declare("pas_workqueue_retries_total", "counter", "Rate-limited re-adds after failures (label: queue).")
+declare("pas_workqueue_done_total", "counter", "Items finished processing (label: queue).")
+declare("pas_informer_relists_total", "counter", "Informer list/re-list passes started (label: informer).")
+declare("pas_informer_watch_errors_total", "counter", "Informer watch streams that broke and forced a re-list (label: informer).")
+declare("pas_informer_synced", "gauge", "1 once the informer's initial list has delivered (label: informer).")
+# device & compile visibility (utils/devicewatch.py)
+declare("pas_device_memory_in_use_bytes", "gauge", "Device memory currently allocated (label: device; absent on backends without memory_stats).")
+declare("pas_device_memory_peak_bytes", "gauge", "Peak device memory watermark (label: device).")
+declare("pas_device_memory_limit_bytes", "gauge", "Device memory ceiling (label: device).")
+declare("pas_device_kernel_flops", "gauge", "XLA cost-analysis FLOPs for each watched kernel's first compile (label: kernel).")
+declare("pas_device_kernel_bytes", "gauge", "XLA cost-analysis bytes accessed for each watched kernel's first compile (label: kernel).")
+declare("pas_profile_captures_total", "counter", "Bounded jax.profiler traces captured via GET /debug/profile.")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where
@@ -355,6 +382,13 @@ TRACES = TraceBuffer()
 _jax_hooks_lock = threading.Lock()
 _jax_hooks_installed = False
 
+#: callables ``(name, jitted_fn, args, kwargs)`` invoked once per watched
+#: kernel, at its FIRST observed compile — the hook point the device
+#: cost-analysis capture (utils/devicewatch.py) hangs off.  Hooks run in
+#: whatever thread triggered the compile (the warm thread in production)
+#: and must never raise into the caller; failures are swallowed.
+FIRST_COMPILE_HOOKS: List[Callable] = []
+
 
 def install_jax_hooks(counters: Optional[CounterSet] = None) -> bool:
     """Register ``jax.monitoring`` listeners feeding the compile counters.
@@ -407,6 +441,12 @@ class _JitWatch:
             retraces = grew - 1 if first else grew
             if retraces > 0:
                 self._counters.inc("pas_jax_retrace_total", retraces)
+            if first:
+                for hook in list(FIRST_COMPILE_HOOKS):
+                    try:
+                        hook(self._name, self._fn, args, kwargs)
+                    except Exception:
+                        pass  # visibility hooks must never fail the kernel
         return out
 
     def __getattr__(self, item):

@@ -46,65 +46,156 @@ def _fmt_value(value) -> str:
     return f"{value:g}"
 
 
+#: a family's series map: label tuple (sorted (k, v) pairs) -> value.
+#: The unlabeled series uses the empty tuple.
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted(labels.items())) if labels else ()
+
+
+def _escape_label_value(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _render_series(name: str, key: _LabelKey) -> str:
+    if not key:
+        return name
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in key
+    )
+    return f"{name}{{{inner}}}"
+
+
 class CounterSet:
     """Thread-safe named counters and gauges with Prometheus text
     exposition — the non-latency half of the serving metrics (queue
-    depth, admission rejections, batch sizes; docs/serving.md) and the
-    path-attribution / JAX-compile counters (utils/trace.py).  Names are
-    emitted verbatim, so callers pass fully-qualified metric names
-    (``pas_serving_queue_depth`` etc.; the inventory lives in
-    trace.METRICS and ``make trace-lint`` enforces it)."""
+    depth, admission rejections, batch sizes; docs/serving.md), the
+    path-attribution / JAX-compile counters (utils/trace.py), and the
+    control-plane/device families (telemetry ages, workqueue depth,
+    device watermarks).  Names are emitted verbatim, so callers pass
+    fully-qualified metric names (``pas_serving_queue_depth`` etc.; the
+    inventory lives in trace.METRICS and ``make trace-lint`` enforces
+    it).  A family may carry labeled series (``labels={"metric": ...}``)
+    — one ``# TYPE`` line per family, one sample line per label set."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: Dict[str, float] = {}
-        self._gauges: Dict[str, float] = {}
+        self._counters: Dict[str, Dict[_LabelKey, float]] = {}
+        self._gauges: Dict[str, Dict[_LabelKey, float]] = {}
 
-    def inc(self, name: str, by: float = 1) -> None:
+    def inc(
+        self,
+        name: str,
+        by: float = 1,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> None:
+        key = _label_key(labels)
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + by
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0) + by
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(
+        self,
+        name: str,
+        value: float,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> None:
         with self._lock:
-            self._gauges[name] = value
+            self._gauges.setdefault(name, {})[_label_key(labels)] = value
 
-    def get(self, name: str, kind: Optional[str] = None) -> float:
-        """The value under ``name``.  When a counter and a gauge collide
-        on one name, ``kind`` ("counter" or "gauge") disambiguates;
-        without it the counter wins (the historical precedence)."""
+    def get(
+        self,
+        name: str,
+        kind: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> float:
+        """The value under ``name``: the exact series when ``labels`` is
+        given, the sum over every series otherwise (for an unlabeled
+        family that is just its single value).  When a counter and a
+        gauge collide on one name, ``kind`` ("counter" or "gauge")
+        disambiguates; without it the counter wins (the historical
+        precedence)."""
+        key = None if labels is None else _label_key(labels)
+
+        def read(table: Dict[str, Dict[_LabelKey, float]]) -> float:
+            series = table.get(name, {})
+            if key is not None:
+                return series.get(key, 0)
+            return sum(series.values()) if series else 0
+
         with self._lock:
             if kind == "counter":
-                return self._counters.get(name, 0)
+                return read(self._counters)
             if kind == "gauge":
-                return self._gauges.get(name, 0)
+                return read(self._gauges)
             if kind is not None:
                 raise ValueError(f"unknown kind {kind!r}")
             if name in self._counters:
-                return self._counters[name]
-            return self._gauges.get(name, 0)
+                return read(self._counters)
+            return read(self._gauges)
+
+    def remove(
+        self,
+        name: str,
+        labels: Optional[Dict[str, str]] = None,
+        kind: Optional[str] = None,
+    ) -> None:
+        """Drop a series (or, with ``labels=None``, the whole family)
+        from future exposition — for label sets whose subject no longer
+        exists (an evicted telemetry metric's age gauge must not stay
+        frozen in /metrics forever)."""
+        key = None if labels is None else _label_key(labels)
+        tables = (
+            [self._counters] if kind == "counter"
+            else [self._gauges] if kind == "gauge"
+            else [self._counters, self._gauges]
+        )
+        with self._lock:
+            for table in tables:
+                if key is None:
+                    table.pop(name, None)
+                    continue
+                series = table.get(name)
+                if series is not None:
+                    series.pop(key, None)
+                    if not series:
+                        del table[name]
 
     def prometheus_text(
         self, help_texts: Optional[Dict[str, str]] = None
     ) -> str:
         """Valid exposition: ``# HELP`` (when the name is in the declared
-        inventory) + ``# TYPE`` per family, then the sample.  A name
-        colliding across counter and gauge emits the counter only — two
-        TYPE lines for one name would be invalid exposition (get(kind=)
-        still reads both)."""
+        inventory) + ``# TYPE`` per family, then one sample per series.
+        A name colliding across counter and gauge emits the counter only
+        — two TYPE lines for one name would be invalid exposition
+        (get(kind=) still reads both)."""
         with self._lock:
-            counters = sorted(self._counters.items())
+            counters = sorted(
+                (name, sorted(series.items()))
+                for name, series in self._counters.items()
+            )
             gauges = sorted(
-                (name, value)
-                for name, value in self._gauges.items()
+                (name, sorted(series.items()))
+                for name, series in self._gauges.items()
                 if name not in self._counters
             )
         lines: List[str] = []
-        for kind, items in (("counter", counters), ("gauge", gauges)):
-            for name, value in items:
+        for kind, families in (("counter", counters), ("gauge", gauges)):
+            for name, series in families:
                 if help_texts and name in help_texts:
                     lines.append(f"# HELP {name} {help_texts[name]}")
                 lines.append(f"# TYPE {name} {kind}")
-                lines.append(f"{name} {_fmt_value(value)}")
+                for key, value in series:
+                    lines.append(
+                        f"{_render_series(name, key)} {_fmt_value(value)}"
+                    )
         return "\n".join(lines) + ("\n" if lines else "")
 
 

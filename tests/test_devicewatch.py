@@ -1,0 +1,165 @@
+"""Device & compile visibility (utils/devicewatch.py): memory watermark
+gauges (graceful no-op on CPU, real gauges against fake devices),
+one-shot cost-analysis capture (direct + via the first-compile hook),
+and the bounded /debug/profile capture over both front-ends."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from platform_aware_scheduling_tpu.utils import devicewatch, trace
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+
+class FakeDevice:
+    def __init__(self, device_id, stats):
+        self.id = device_id
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+class TestDeviceWatcher:
+    def test_cpu_sample_is_a_clean_noop(self):
+        counters = CounterSet()
+        watcher = devicewatch.DeviceWatcher(counters=counters)
+        watcher.sample()  # CPU devices report no stats -> no gauges, no raise
+        text = counters.prometheus_text()
+        assert "pas_device_memory" not in text
+
+    def test_fake_devices_export_watermarks(self, monkeypatch):
+        counters = CounterSet()
+        devices = [
+            FakeDevice(0, {"bytes_in_use": 100, "peak_bytes_in_use": 200,
+                           "bytes_limit": 1000}),
+            FakeDevice(1, {"bytes_in_use": 50}),
+            FakeDevice(2, None),  # backend without stats: skipped
+        ]
+        monkeypatch.setattr(jax, "local_devices", lambda: devices)
+        watcher = devicewatch.DeviceWatcher(counters=counters)
+        assert watcher.sample() == 2
+        assert counters.get(
+            "pas_device_memory_in_use_bytes", labels={"device": "0"}
+        ) == 100
+        assert counters.get(
+            "pas_device_memory_peak_bytes", labels={"device": "0"}
+        ) == 200
+        assert counters.get(
+            "pas_device_memory_limit_bytes", labels={"device": "0"}
+        ) == 1000
+        assert counters.get(
+            "pas_device_memory_in_use_bytes", labels={"device": "1"}
+        ) == 50
+        # the exposition parses and stays inside the declared inventory
+        families = trace.parse_prometheus_text(counters.prometheus_text())
+        for family in families:
+            assert family in trace.METRICS
+
+
+class TestKernelCostCapture:
+    def test_direct_capture_exports_flops_and_dedupes(self):
+        counters = CounterSet()
+        fn = jax.jit(lambda x: x @ x)
+        x = jnp.ones((8, 8), dtype=jnp.float32)
+        fn(x)
+        captured = devicewatch.capture_kernel_cost(
+            "cost_toy_kernel", fn, (x,), counters=counters
+        )
+        assert captured, "CPU backend supports cost_analysis"
+        flops = counters.get(
+            "pas_device_kernel_flops", labels={"kernel": "cost_toy_kernel"}
+        )
+        assert flops > 0
+        # second capture for the same kernel name is a no-op
+        assert not devicewatch.capture_kernel_cost(
+            "cost_toy_kernel", fn, (x,), counters=counters
+        )
+
+    def test_first_compile_hook_captures_watched_kernel(self):
+        counters = CounterSet()
+        hook = devicewatch.install_cost_hooks(counters=counters)
+        try:
+            watched = trace.watch_jit(
+                "cost_hooked_kernel",
+                jax.jit(lambda x: jnp.sum(x * 2.0)),
+                CounterSet(),
+            )
+            watched(jnp.ones((16,), dtype=jnp.float32))
+            assert counters.get(
+                "pas_device_kernel_flops",
+                labels={"kernel": "cost_hooked_kernel"},
+            ) > 0
+        finally:
+            trace.FIRST_COMPILE_HOOKS.remove(hook)
+
+
+class TestProfileCapture:
+    def test_capture_returns_trace_dir(self):
+        status, body = devicewatch.profile_response("/debug/profile?ms=1")
+        payload = json.loads(body)
+        if status == 404:  # profiler genuinely unavailable on this build
+            assert "error" in payload
+            return
+        assert status == 200
+        assert os.path.isdir(payload["path"])
+        assert payload["ms"] == 1
+
+    def test_bad_ms_is_400(self):
+        status, body = devicewatch.profile_response("/debug/profile?ms=nope")
+        assert status == 400
+
+    def test_unavailable_profiler_is_404(self, monkeypatch):
+        monkeypatch.setattr(devicewatch, "_profiler_tracers", lambda: None)
+        status, body = devicewatch.profile_response("/debug/profile?ms=5")
+        assert status == 404
+        assert "unavailable" in json.loads(body)["error"]
+
+    def test_ms_is_clamped(self, monkeypatch):
+        slept = {}
+        monkeypatch.setattr(
+            devicewatch, "_profiler_tracers",
+            lambda: (lambda _dir: None, lambda: None),
+        )
+        monkeypatch.setattr(
+            devicewatch.time, "sleep", lambda s: slept.setdefault("s", s)
+        )
+        status, body = devicewatch.profile_response(
+            "/debug/profile?ms=999999999"
+        )
+        assert status == 200
+        assert json.loads(body)["ms"] == devicewatch.PROFILE_MAX_MS
+        assert slept["s"] == devicewatch.PROFILE_MAX_MS / 1000.0
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_endpoint_over_the_wire(self, serving, monkeypatch):
+        from benchmarks.http_load import build_extender
+        from wirehelpers import (
+            get_request as _get,
+            post_bytes as _post,
+            raw_request as _raw,
+            start_async as _start_async,
+            start_threaded as _start_threaded,
+        )
+
+        monkeypatch.setattr(
+            devicewatch, "_profiler_tracers",
+            lambda: (lambda _dir: None, lambda: None),
+        )
+        ext, _names = build_extender(32, device=True)
+        server = (
+            _start_threaded(ext) if serving == "threaded"
+            else _start_async(ext)
+        )
+        try:
+            status, _headers, payload = _get(server.port, "/debug/profile?ms=1")
+            assert status == 200
+            assert "path" in json.loads(payload)
+            # GET-only, like the other observability endpoints
+            status, _, _ = _raw(server.port, _post("/debug/profile", b"{}"))
+            assert status == 405
+        finally:
+            server.shutdown()

@@ -1,0 +1,406 @@
+"""Health & readiness (docs/observability.md): /healthz liveness,
+/readyz flipping 503 -> 200 exactly when every documented condition
+(warm + synced + fresh + unsaturated) holds — each condition toggled
+independently on BOTH front-ends — queue-bypass under saturation (same
+bar as /metrics), the telemetry-freshness condition over a real refresh
+loop, readiness flap counting, and the log <-> trace request-id join.
+"""
+
+import json
+import logging
+import threading
+import time
+
+import pytest
+
+from benchmarks.http_load import build_extender, make_bodies
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    HTTPResponse,
+    Server,
+)
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import (
+    DummyMetricsClient,
+    NodeMetric,
+)
+from platform_aware_scheduling_tpu.utils import health, klog, trace
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+from wirehelpers import (
+    get_request as _get,
+    post_bytes as _post,
+    raw_request as _raw,
+    start_async as _start_async,
+    start_threaded as _start_threaded,
+)
+
+CONDITIONS = ("kernels_warmed", "cache_synced", "telemetry_fresh")
+
+
+class FlagScheduler:
+    """A scheduler whose readiness conditions are test-controlled flags."""
+
+    def __init__(self):
+        self.flags = {name: True for name in CONDITIONS}
+
+    def readiness_conditions(self):
+        def check_for(name):
+            def check():
+                ok = self.flags[name]
+                return ok, ("ok" if ok else f"{name} is down")
+
+            return check
+
+        return [(name, check_for(name)) for name in CONDITIONS]
+
+    def metrics_text(self) -> str:
+        return ""
+
+    def prioritize(self, request):
+        return HTTPResponse.json(b"[]\n")
+
+    filter = prioritize
+
+    def bind(self, request):
+        return HTTPResponse(status=404)
+
+
+def _readyz(port):
+    status, _headers, payload = _get(port, "/readyz")
+    return status, json.loads(payload)
+
+
+class TestReadyzConditionToggling:
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_flips_503_to_200_per_condition(self, serving):
+        """ISSUE 3 acceptance: /readyz is 200 exactly when ALL conditions
+        hold; flipping each condition independently flips the endpoint,
+        and the failing condition is named in the JSON reasons."""
+        scheduler = FlagScheduler()
+        server = (
+            _start_threaded(scheduler)
+            if serving == "threaded"
+            else _start_async(scheduler)
+        )
+        try:
+            status, body = _readyz(server.port)
+            assert status == 200 and body["ready"] is True
+            reported = {c["name"] for c in body["conditions"]}
+            assert set(CONDITIONS) <= reported
+            for name in CONDITIONS:
+                scheduler.flags[name] = False
+                status, body = _readyz(server.port)
+                assert status == 503, f"{name} down must unready"
+                assert body["ready"] is False
+                failing = {
+                    c["name"]: c["reason"]
+                    for c in body["conditions"]
+                    if not c["ok"]
+                }
+                assert set(failing) == {name}
+                assert f"{name} is down" in failing[name]
+                scheduler.flags[name] = True
+                status, body = _readyz(server.port)
+                assert status == 200, f"{name} restored must re-ready"
+        finally:
+            server.shutdown()
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_healthz_always_200_and_get_only(self, serving):
+        scheduler = FlagScheduler()
+        scheduler.flags["kernels_warmed"] = False  # unready != unhealthy
+        server = (
+            _start_threaded(scheduler)
+            if serving == "threaded"
+            else _start_async(scheduler)
+        )
+        try:
+            status, _headers, payload = _get(server.port, "/healthz")
+            assert status == 200
+            assert json.loads(payload) == {"status": "ok"}
+            status, _, _ = _raw(server.port, _post("/healthz", b"{}"))
+            assert status == 405
+            status, _, _ = _raw(server.port, _post("/readyz", b"{}"))
+            assert status == 405
+        finally:
+            server.shutdown()
+
+    def test_flap_counter_moves_on_transitions(self):
+        counters = CounterSet()
+        probe = health.ReadinessProbe(counters=counters)
+        flag = {"ok": True}
+        probe.register("cond", lambda: (flag["ok"], ""))
+        probe.evaluate()
+        assert counters.get("pas_ready", kind="gauge") == 1
+        assert counters.get("pas_ready_transitions_total") == 0
+        flag["ok"] = False
+        probe.evaluate()
+        assert counters.get("pas_ready", kind="gauge") == 0
+        assert counters.get("pas_ready_transitions_total") == 1
+        probe.evaluate()  # steady state: no extra flap
+        assert counters.get("pas_ready_transitions_total") == 1
+        flag["ok"] = True
+        probe.evaluate()
+        assert counters.get("pas_ready_transitions_total") == 2
+
+    def test_raising_condition_fails_closed(self):
+        probe = health.ReadinessProbe(counters=CounterSet())
+
+        def broken():
+            raise RuntimeError("boom")
+
+        probe.register("broken", broken)
+        ready, results = probe.evaluate()
+        assert ready is False
+        assert "boom" in results[0]["reason"]
+
+    def test_empty_probe_is_ready(self):
+        status, body = health.ReadinessProbe(
+            counters=CounterSet()
+        ).readyz_response()
+        assert status == 200
+        assert json.loads(body)["ready"] is True
+
+    def test_raising_conditions_provider_fails_closed(self):
+        """A readiness_conditions() provider that raises must NOT yield
+        an empty always-ready probe — /readyz reports 503 with the
+        provider failure as the reason."""
+
+        class Broken:
+            def readiness_conditions(self):
+                raise AttributeError("no freshness surface")
+
+        probe = health.probe_for(Broken(), counters=CounterSet())
+        status, body = probe.readyz_response()
+        assert status == 503
+        payload = json.loads(body)
+        assert payload["ready"] is False
+        assert "provider raised" in payload["conditions"][0]["reason"]
+
+
+class TestRealExtenderReadiness:
+    def test_warm_extender_with_static_cache_is_ready(self):
+        """The bench/service assembly: device fastpath warmed at
+        construction, seed cache with no refresh loop -> ready on both
+        extender conditions."""
+        ext, _names = build_extender(32, device=True)
+        server = _start_threaded(ext)
+        try:
+            status, body = _readyz(server.port)
+            assert status == 200, body
+            names = {c["name"] for c in body["conditions"]}
+            assert {"kernels_warmed", "telemetry_fresh"} <= names
+        finally:
+            server.shutdown()
+
+    def test_registered_informer_condition_gates_readiness(self):
+        class FakeInformer:
+            synced = False
+
+            def has_synced(self):
+                return self.synced
+
+        ext, _names = build_extender(32, device=True)
+        informer = FakeInformer()
+        server = Server(ext, metrics_provider=ext.metrics_text)
+        server.probe.register(
+            "policy_informer_synced",
+            health.informer_synced(informer, "taspolicy"),
+        )
+        request = HTTPRequest(method="GET", path="/readyz", headers={}, body=b"")
+        response = server.route(request)
+        assert response.status == 503
+        assert b"taspolicy" in response.body
+        informer.synced = True
+        assert server.route(request).status == 200
+
+
+class TestTelemetryFreshness:
+    def _store(self):
+        return {"m1": {"node-a": NodeMetric(value=Quantity(5))}}
+
+    def test_static_cache_is_fresh(self):
+        cache = AutoUpdatingCache(counters=CounterSet())
+        ok, reason = cache.telemetry_freshness()
+        assert ok and "static" in reason
+
+    def test_refresh_loop_lifecycle(self):
+        """Unsynced -> not ready; refreshed -> fresh; loop stalled past
+        the bound -> stale again (with the reason saying why)."""
+        counters = CounterSet()
+        cache = AutoUpdatingCache(counters=counters)
+        cache.write_metric("m1", None)  # registered by a policy
+        client = DummyMetricsClient(self._store())
+        stop = threading.Event()
+        cache.start_periodic_update(0.01, client, stop=stop)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if cache.telemetry_freshness()[0]:
+                break
+            time.sleep(0.005)
+        ok, reason = cache.telemetry_freshness()
+        assert ok, reason
+        assert counters.get("pas_telemetry_refresh_total") >= 1
+        assert (
+            counters.get(
+                "pas_telemetry_metric_age_seconds",
+                kind="gauge",
+                labels={"metric": "m1"},
+            )
+            >= 0
+        )
+        # stall the loop; freshness decays past the bound
+        stop.set()
+        cache.freshness_max_age_s = 0.05
+        time.sleep(0.15)
+        ok, reason = cache.telemetry_freshness()
+        assert not ok
+        assert "stalled" in reason or "stale" in reason
+
+    def test_unsynced_loop_is_not_fresh(self):
+        cache = AutoUpdatingCache(counters=CounterSet())
+        cache._refresh_period = 5.0  # configured but never ran a pass
+        ok, reason = cache.telemetry_freshness()
+        assert not ok and "refresh pass" in reason
+
+    def test_failing_metric_counts_errors_and_goes_stale(self):
+        counters = CounterSet()
+        cache = AutoUpdatingCache(counters=counters)
+        cache.write_metric("m1", None)
+        client = DummyMetricsClient({})  # fetch always fails
+        cache._refresh_period = 0.01
+        cache.update_all_metrics(client)
+        assert counters.get("pas_telemetry_refresh_errors_total") == 1
+        ok, reason = cache.telemetry_freshness()
+        assert not ok and "m1" in reason
+
+
+class TestBypassUnderSaturation:
+    def test_health_endpoints_readable_when_queue_saturated(self):
+        """ISSUE 3 acceptance: /healthz, /readyz, and /debug/profile stay
+        readable while the async admission queue is saturated — and
+        /readyz reports the saturation as the failing condition."""
+
+        class Blocking:
+            release = threading.Event()
+
+            def prioritize(self, request):
+                Blocking.release.wait(15)
+                return HTTPResponse.json(b"[]\n")
+
+            filter = prioritize
+
+            def bind(self, request):
+                return HTTPResponse(status=404)
+
+            def metrics_text(self):
+                return ""
+
+        server = _start_async(
+            Blocking(), window_s=0.0, max_batch=1, max_queue_depth=1
+        )
+        blockers = []
+        try:
+            blockers = [
+                threading.Thread(
+                    target=lambda: _raw(
+                        server.port, _post("/scheduler/prioritize", b"{}")
+                    )
+                )
+                for _ in range(2)
+            ]
+            for thread in blockers:
+                thread.start()
+                time.sleep(0.05)
+            time.sleep(0.1)
+            status, _headers, payload = _get(server.port, "/healthz")
+            assert status == 200
+            status, body = _readyz(server.port)
+            assert status == 503
+            failing = {c["name"] for c in body["conditions"] if not c["ok"]}
+            assert failing == {"admission_queue"}
+            # /debug/profile responds too (fake tracers: no real capture)
+            from platform_aware_scheduling_tpu.utils import devicewatch
+
+            original = devicewatch._profiler_tracers
+            devicewatch._profiler_tracers = lambda: (
+                lambda _dir: None,
+                lambda: None,
+            )
+            try:
+                status, _headers, payload = _get(
+                    server.port, "/debug/profile?ms=1"
+                )
+                assert status == 200
+                assert "path" in json.loads(payload)
+            finally:
+                devicewatch._profiler_tracers = original
+            # queue drains -> ready again
+            Blocking.release.set()
+            for thread in blockers:
+                thread.join(20)
+            blockers = []
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                status, _body = _readyz(server.port)
+                if status == 200:
+                    break
+                time.sleep(0.02)
+            assert status == 200
+        finally:
+            Blocking.release.set()
+            for thread in blockers:
+                thread.join(20)
+            server.shutdown()
+
+
+class TestLogTraceCorrelation:
+    def test_structured_lines_carry_request_id(self):
+        """A klog structured line emitted inside a verb handler carries
+        the request's X-Request-ID, so /debug/traces entries join
+        against the logs (ISSUE 3 satellite)."""
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = Capture()
+        logging.getLogger("pas_tpu").addHandler(handler)
+        old_verbosity = klog.verbosity()
+        klog.set_verbosity(2)
+        ext, names = build_extender(32, device=True)
+        server = _start_threaded(ext)
+        try:
+            body = make_bodies(names, "nodenames", count=1)[0]
+            status, _, _ = _raw(
+                server.port,
+                _post(
+                    "/scheduler/filter", body,
+                    extra="X-Request-ID: log-join-1\r\n",
+                ),
+            )
+            assert status == 200
+            joined = [m for m in records if 'request_id="log-join-1"' in m]
+            assert joined, records[-5:]
+        finally:
+            klog.set_verbosity(old_verbosity)
+            logging.getLogger("pas_tpu").removeHandler(handler)
+            server.shutdown()
+
+    def test_request_context_scopes_and_restores(self):
+        assert klog.current_request_id() == ""
+        with klog.request_context("abc"):
+            assert klog.current_request_id() == "abc"
+            with klog.request_context(""):
+                assert klog.current_request_id() == ""
+        assert klog.current_request_id() == ""
+
+    def test_structured_values_escape_injection(self):
+        """A client-controlled X-Request-ID cannot forge structured
+        fields: quotes/newlines in values are escaped in the line."""
+        with klog.request_context('x" component="forged'):
+            line = klog._fmt("msg", {})
+        assert 'component="forged' not in line
+        assert '\\"' in line

@@ -40,6 +40,37 @@ class TestDeclaredInventory:
         with pytest.raises(ValueError):
             trace.declare("pas_request_duration_seconds", "counter", "dup")
 
+    def test_control_plane_families_declared(self):
+        """ISSUE 3: the health/telemetry/workqueue/informer/device
+        families are part of the declared inventory (and therefore under
+        every other convention check in this gate)."""
+        expected = {
+            "pas_ready": "gauge",
+            "pas_ready_transitions_total": "counter",
+            "pas_telemetry_metric_age_seconds": "gauge",
+            "pas_telemetry_refresh_total": "counter",
+            "pas_telemetry_refresh_errors_total": "counter",
+            "pas_strategy_evaluations_total": "counter",
+            "pas_strategy_violations_total": "counter",
+            "pas_strategy_enforcements_total": "counter",
+            "pas_workqueue_depth": "gauge",
+            "pas_workqueue_adds_total": "counter",
+            "pas_workqueue_retries_total": "counter",
+            "pas_workqueue_done_total": "counter",
+            "pas_informer_relists_total": "counter",
+            "pas_informer_watch_errors_total": "counter",
+            "pas_informer_synced": "gauge",
+            "pas_device_memory_in_use_bytes": "gauge",
+            "pas_device_memory_peak_bytes": "gauge",
+            "pas_device_memory_limit_bytes": "gauge",
+            "pas_device_kernel_flops": "gauge",
+            "pas_device_kernel_bytes": "gauge",
+            "pas_profile_captures_total": "counter",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
 
 class TestLiveEmission:
     """Drive both front-ends, scrape /metrics, and hold every emitted
@@ -88,6 +119,37 @@ class TestLiveEmission:
             self._assert_only_declared(text)
         finally:
             server.shutdown()
+
+    def test_health_and_device_families_emit_declared_names_only(self):
+        """Readiness evaluations + device watermark/cost gauges land on
+        the same exposition and stay inside the inventory — labels and
+        all (the parser separates them; the base family must be
+        declared)."""
+        from platform_aware_scheduling_tpu.utils import health
+
+        ext, names = build_extender(48, device=True)
+        probe = health.probe_for(ext)
+        probe.evaluate()  # pas_ready (+ transitions on later flips)
+        # a labeled device gauge without real accelerator stats: exported
+        # through the same CounterSet path the real sampler uses
+        trace.COUNTERS.set_gauge(
+            "pas_device_kernel_flops", 123.0,
+            labels={"kernel": "lint_probe_kernel"},
+        )
+        body = make_bodies(names, "nodenames", count=1)[0]
+        ext.prioritize(
+            HTTPRequest(
+                method="POST",
+                path="/scheduler/prioritize",
+                headers={"Content-Type": "application/json"},
+                body=body,
+            )
+        )
+        text = ext.metrics_text()
+        self._assert_only_declared(text)
+        families = trace.parse_prometheus_text(text)
+        assert "pas_ready" in families
+        assert "pas_device_kernel_flops" in families
 
     def test_gas_extender_emits_declared_names_only(self):
         from platform_aware_scheduling_tpu.gas.scheduler import GASExtender

@@ -90,6 +90,97 @@ class TestCounterSet:
     def test_empty_dump(self):
         assert CounterSet().prometheus_text() == ""
 
+    def test_labeled_series_accumulate_and_sum(self):
+        cs = CounterSet()
+        cs.inc("pas_evals_total", labels={"strategy": "dontschedule"})
+        cs.inc("pas_evals_total", 2, labels={"strategy": "deschedule"})
+        cs.inc("pas_evals_total", labels={"strategy": "dontschedule"})
+        assert cs.get(
+            "pas_evals_total", labels={"strategy": "dontschedule"}
+        ) == 2
+        assert cs.get(
+            "pas_evals_total", labels={"strategy": "deschedule"}
+        ) == 2
+        # labels=None sums every series of the family
+        assert cs.get("pas_evals_total") == 4
+        # missing series reads zero
+        assert cs.get("pas_evals_total", labels={"strategy": "nope"}) == 0
+
+    def test_labeled_exposition_round_trips(self):
+        cs = CounterSet()
+        cs.inc("pas_evals_total", 3, labels={"strategy": "dontschedule"})
+        cs.inc("pas_evals_total", 1, labels={"strategy": "deschedule"})
+        cs.set_gauge("pas_age_seconds", 1.5, labels={"metric": "cpu"})
+        cs.set_gauge("pas_age_seconds", 0.5, labels={"metric": "mem"})
+        cs.set_gauge("pas_plain", 7)
+        text = cs.prometheus_text(help_texts={"pas_evals_total": "evals"})
+        fams = trace.parse_prometheus_text(text)
+        # one TYPE line per family, one sample per label set
+        assert text.count("# TYPE pas_evals_total") == 1
+        samples = {
+            labels.get("strategy"): value
+            for _n, labels, value in fams["pas_evals_total"]["samples"]
+        }
+        assert samples == {"dontschedule": 3, "deschedule": 1}
+        ages = {
+            labels.get("metric"): value
+            for _n, labels, value in fams["pas_age_seconds"]["samples"]
+        }
+        assert ages == {"cpu": 1.5, "mem": 0.5}
+        assert fams["pas_plain"]["samples"][0][2] == 7
+
+    def test_label_values_escape(self):
+        cs = CounterSet()
+        tricky = 'quo"te\\back\nnewline'
+        cs.set_gauge("pas_esc", 1, labels={"metric": tricky})
+        fams = trace.parse_prometheus_text(cs.prometheus_text())
+        (_name, labels, value) = fams["pas_esc"]["samples"][0]
+        assert labels["metric"] == tricky
+        assert value == 1
+
+    def test_remove_drops_series_from_exposition(self):
+        cs = CounterSet()
+        cs.set_gauge("pas_age_seconds", 1.0, labels={"metric": "gone"})
+        cs.set_gauge("pas_age_seconds", 2.0, labels={"metric": "kept"})
+        cs.remove("pas_age_seconds", labels={"metric": "gone"}, kind="gauge")
+        fams = trace.parse_prometheus_text(cs.prometheus_text())
+        metrics = {
+            labels["metric"] for _n, labels, _v
+            in fams["pas_age_seconds"]["samples"]
+        }
+        assert metrics == {"kept"}
+        # removing the last series drops the family (no orphan TYPE line)
+        cs.remove("pas_age_seconds", labels={"metric": "kept"})
+        assert cs.prometheus_text() == ""
+        cs.remove("pas_never", labels={"metric": "x"})  # no-op, no raise
+
+    def test_evicted_metric_age_gauge_is_removed(self):
+        """tas/cache.delete_metric evicting the last ref drops the
+        metric's age-gauge series from the exposition."""
+        from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+        from platform_aware_scheduling_tpu.tas.metrics import DummyMetricsClient
+        from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+        from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+        counters = CounterSet()
+        cache = AutoUpdatingCache(counters=counters)
+        cache.write_metric("doomed", None)
+        client = DummyMetricsClient(
+            {"doomed": {"n": NodeMetric(value=Quantity(1))}}
+        )
+        cache.update_all_metrics(client)
+        assert "doomed" in counters.prometheus_text()
+        cache.delete_metric("doomed")
+        assert "doomed" not in counters.prometheus_text()
+
+    def test_labeled_and_unlabeled_series_coexist(self):
+        cs = CounterSet()
+        cs.inc("pas_mixed_total")
+        cs.inc("pas_mixed_total", 5, labels={"kind": "x"})
+        assert cs.get("pas_mixed_total") == 6
+        fams = trace.parse_prometheus_text(cs.prometheus_text())
+        assert len(fams["pas_mixed_total"]["samples"]) == 2
+
 
 class TestLatencyRecorder:
     def test_empty_label_dumps(self):
