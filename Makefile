@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving test-serving test-obs trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving bench-rebalance test-serving test-obs test-rebalance trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -37,6 +37,15 @@ bench-serving:
 # backpressure, the c=8 <= 3x c=1 bar) — CI runs this as its own step
 test-serving:
 	python -m pytest tests/test_serving.py -q
+
+# closed-loop rebalancer suite (docs/rebalance.md): hysteresis, dry-run
+# plan parity, actuation guards, active-vs-label-only convergence
+test-rebalance:
+	python -m pytest tests/test_rebalance.py -q
+
+# rebalance convergence A/B alone: synthetic churn, active vs label-only
+bench-rebalance:
+	python -m benchmarks.rebalance_load
 
 # metric-name convention gate (docs/observability.md): every emitted
 # metric is declared in trace.METRICS, pas_-prefixed snake_case, no

@@ -167,7 +167,9 @@ def _detail_path(round_override=None) -> str:
     return os.path.join(root, f"BENCH_DETAIL_r{n:02d}.json")
 
 
-def assemble_line(headline, load, configs_out, gas=None, serving=None):
+def assemble_line(
+    headline, load, configs_out, gas=None, serving=None, rebalance=None
+):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
     LAST keys (driver tail-capture keeps the end of the line) — and the
@@ -212,6 +214,22 @@ def assemble_line(headline, load, configs_out, gas=None, serving=None):
                     if k.startswith(("p99_scaling", "rps_scaling"))
                 }
         result["serving_scaling"] = compact
+    if rebalance is not None:
+        # full per-mode cycle records to disk; the line keeps only the
+        # convergence headline (active closes the loop, label-only cannot)
+        detail["rebalance"] = rebalance
+        active = rebalance.get("active") or {}
+        label_only = rebalance.get("label_only") or {}
+        result["rebalance"] = {
+            "num_nodes": rebalance.get("num_nodes"),
+            "cycles_to_zero_active": active.get("cycles_to_zero"),
+            "evictions_active": active.get("evictions"),
+            "plan_ms_p99": active.get("plan_ms_p99"),
+            "label_only_converged": label_only.get("converged"),
+            "label_only_residual_violations": label_only.get(
+                "residual_violations"
+            ),
+        }
     if load is not None:
         # structural note: the filter MISS tier is ratio-capped independent
         # of implementation quality — the filter control skips the sort
@@ -346,6 +364,25 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"serving_scaling failed: {exc}", file=sys.stderr)
 
+    # --- closed-loop rebalancer: synthetic churn, active vs label-only
+    # convergence (benchmarks/rebalance_load.py; docs/rebalance.md) ---
+    rebalance = None
+    try:
+        from benchmarks import rebalance_load
+
+        rebalance = rebalance_load.run()
+        active = rebalance["active"]
+        print(
+            f"rebalance: active converged in {active['cycles_to_zero']} "
+            f"cycles ({active['evictions']} evictions, plan p99 "
+            f"{active['plan_ms_p99']} ms); label-only residual "
+            f"{rebalance['label_only']['residual_violations']} violating "
+            f"nodes after {rebalance['label_only']['cycles']} cycles",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"rebalance bench failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -355,7 +392,9 @@ def main():
     except Exception as exc:  # config benches must never sink the headline
         print(f"config benches failed: {exc}", file=sys.stderr)
 
-    result, detail = assemble_line(headline, load, configs_out, gas, serving)
+    result, detail = assemble_line(
+        headline, load, configs_out, gas, serving, rebalance
+    )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it
     # (ADVICE r5 #3 — r03/r04 lost the headline to output after it)

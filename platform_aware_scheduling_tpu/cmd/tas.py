@@ -80,6 +80,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--queueDepth", type=int, default=256,
                         help="async serving: admission queue bound; past it "
                         "requests get 503 + Retry-After")
+    parser.add_argument("--rebalance", default="off",
+                        choices=["off", "dry-run", "active"],
+                        help="closed-loop rebalancer (docs/rebalance.md): "
+                        "dry-run computes and publishes plans on "
+                        "/debug/rebalance without touching the cluster; "
+                        "active evicts through pods/eviction behind "
+                        "rate-limit, cooldown and min-available guards")
+    parser.add_argument("--rebalanceHysteresis", type=int, default=3,
+                        help="consecutive violating enforcement cycles "
+                        "before a node becomes an eviction candidate")
+    parser.add_argument("--rebalanceMaxMoves", type=int, default=5,
+                        help="churn budget: max evictions planned per cycle")
+    parser.add_argument("--rebalanceSolver", default="greedy",
+                        choices=["greedy", "sinkhorn"],
+                        help="replan solver (mirrors --batchSolver)")
+    parser.add_argument("--rebalanceCooldown", default="5m",
+                        help="per-pod eviction cooldown (Go duration)")
+    parser.add_argument("--rebalanceRate", type=float, default=0.5,
+                        help="token-bucket eviction rate (evictions/s)")
+    parser.add_argument("--rebalanceBurst", type=int, default=3,
+                        help="token-bucket eviction burst")
+    parser.add_argument("--rebalanceMinAvailable", type=int, default=1,
+                        help="per-workload-group running-pod floor the "
+                        "actuator must not evict below")
     common.add_profile_flag(parser)
     return parser
 
@@ -92,6 +116,8 @@ def assemble(
     enable_batch_planner: bool = False,
     batch_solver: str = "greedy",
     node_cache_capable: bool = False,
+    rebalance_mode: str = "off",
+    rebalance_options: Optional[dict] = None,
 ):
     """Wire cache + mirror + extender + controller + enforcer (the body of
     ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
@@ -117,6 +143,21 @@ def assemble(
     enforcer.register_strategy_type(deschedule.Strategy())
     enforcer.register_strategy_type(scheduleonmetric.Strategy())
     enforcer.register_strategy_type(dontschedule.Strategy())
+
+    # closed-loop rebalancer (docs/rebalance.md): each deschedule
+    # enforcement cycle feeds the drift detector; past the hysteresis
+    # threshold the evictable pods are replanned on-device and (active
+    # mode) evicted behind the actuator's guards.  Needs the mirror —
+    # host-only assemblies stay label-only like the reference.
+    if rebalance_mode != "off" and mirror is not None:
+        from platform_aware_scheduling_tpu.rebalance import Rebalancer
+
+        rebalancer = Rebalancer(
+            kube_client, mirror, mode=rebalance_mode,
+            **(rebalance_options or {}),
+        )
+        rebalancer.attach(enforcer)
+        extender.rebalancer = rebalancer
 
     controller = TelemetryPolicyController(kube_client, cache, enforcer)
 
@@ -181,6 +222,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         enable_batch_planner=args.batchPlanner,
         batch_solver=args.batchSolver,
         node_cache_capable=args.nodeCacheCapable,
+        rebalance_mode=args.rebalance,
+        rebalance_options={
+            "hysteresis_cycles": args.rebalanceHysteresis,
+            "max_moves": args.rebalanceMaxMoves,
+            "solver": args.rebalanceSolver,
+            "cooldown_s": parse_duration(args.rebalanceCooldown),
+            "rate_per_s": args.rebalanceRate,
+            "burst": args.rebalanceBurst,
+            "min_available": args.rebalanceMinAvailable,
+        },
     )
 
     common.maybe_start_profiler(args.profilePort)

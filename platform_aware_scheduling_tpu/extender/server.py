@@ -363,6 +363,21 @@ class Server:
                 return HTTPResponse(status=405)
             status, body = devicewatch.profile_response(request.path)
             return HTTPResponse.json(body, status=status)
+        if bare_path == "/debug/rebalance":
+            # last rebalance plan + loop state (rebalance/loop.py); 404
+            # when no rebalancer is wired (--rebalance=off or GAS)
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            rebalancer = getattr(self.scheduler, "rebalancer", None)
+            if rebalancer is None:
+                return HTTPResponse.json(
+                    {"error": "rebalancer not configured"}, status=404
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=rebalancer.to_json(),
+            )
         if request.path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of
             # recent + slowest completed request traces as JSON.  Always

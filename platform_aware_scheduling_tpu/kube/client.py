@@ -260,6 +260,32 @@ class KubeClient:
             body=binding,
         )
 
+    def evict_pod(
+        self,
+        namespace: str,
+        pod_name: str,
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        """POST the pods/eviction subresource (policy/v1 Eviction).  The
+        API server enforces PodDisruptionBudgets here — a guarded pod
+        answers 409/429, surfaced as :class:`ConflictError`/`KubeError`,
+        which the rebalance actuator records as a skipped move rather
+        than retrying into the budget."""
+        eviction: Dict[str, Any] = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": pod_name, "namespace": namespace},
+        }
+        if grace_period_seconds is not None:
+            eviction["deleteOptions"] = {
+                "gracePeriodSeconds": int(grace_period_seconds)
+            }
+        self.request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{pod_name}/eviction",
+            body=eviction,
+        )
+
     # -- TASPolicy CRD (reference pkg/telemetrypolicy/client/v1alpha1) --------
 
     def _crd_base(self, namespace: Optional[str]) -> str:

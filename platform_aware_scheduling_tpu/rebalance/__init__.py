@@ -1,0 +1,47 @@
+"""Closed-loop rebalancer: native descheduling with incremental TPU
+replan and safe eviction actuation (docs/rebalance.md).
+
+The reference's enforcement layer stops at node labels
+(deschedule/enforce.go) and delegates actual eviction to the external
+kubernetes-sigs/descheduler, so the loop from "telemetry says this node
+is bad" to "workload lands somewhere good" is never closed in-tree
+(SURVEY §L6, §7 step 6).  This package closes it natively:
+
+  * :mod:`drift` — hysteresis over per-cycle violation sets: a node must
+    violate for K consecutive enforcement cycles before it becomes an
+    eviction candidate; a clean cycle resets the streak;
+  * :mod:`replan` — the incremental on-device solve: evictable pods on
+    candidate nodes + the current telemetry matrix, scored through the
+    existing batched kernels with a migration-cost penalty so pods stay
+    put unless moving buys real headroom, bounded by a per-cycle churn
+    budget;
+  * :mod:`actuator` — eviction through the pods/eviction subresource
+    behind a token-bucket rate limit, per-pod cooldown, and a
+    per-workload-group min-available guard;
+  * :mod:`loop` — the controller tying them together, driven by the
+    MetricEnforcer's per-cycle violation publications, with
+    ``off | dry-run | active`` modes, ``pas_rebalance_*`` metrics, and
+    the ``GET /debug/rebalance`` last-plan view.
+"""
+
+from platform_aware_scheduling_tpu.rebalance.actuator import (
+    ActuationResult,
+    SafeActuator,
+    TokenBucket,
+)
+from platform_aware_scheduling_tpu.rebalance.drift import DriftDetector
+from platform_aware_scheduling_tpu.rebalance.loop import Rebalancer
+from platform_aware_scheduling_tpu.rebalance.replan import (
+    IncrementalReplanner,
+    Move,
+)
+
+__all__ = [
+    "ActuationResult",
+    "DriftDetector",
+    "IncrementalReplanner",
+    "Move",
+    "Rebalancer",
+    "SafeActuator",
+    "TokenBucket",
+]

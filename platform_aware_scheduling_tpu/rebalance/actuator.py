@@ -1,0 +1,212 @@
+"""Safe eviction actuation: the only component that touches the cluster.
+
+Every planned move passes four gates before the pods/eviction
+subresource is called, in order:
+
+  1. per-pod cooldown — a pod EVICTED recently is left alone, so a
+     workload cannot be bounced every cycle (skipped moves do not start
+     a cooldown: a pdb- or rate-blocked pod stays eligible and is simply
+     re-gated next cycle);
+  2. per-workload-group min-available — evicting must not drop the
+     group's running count below the floor (the in-tree analogue of a
+     PodDisruptionBudget, enforced BEFORE the API server gets a say);
+  3. token-bucket rate limit — cluster-wide evictions per second with a
+     small burst, so even a pathological plan drains slowly;
+  4. mode — ``dry-run`` stops here (the move is recorded as skipped with
+     reason ``dry_run``), ``active`` evicts.
+
+A 409 from the API server (a real PodDisruptionBudget) is recorded as a
+skipped move with reason ``pdb`` and never retried within the cycle.
+Every outcome increments ``pas_rebalance_moves_{executed,skipped}_total``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from platform_aware_scheduling_tpu.kube.client import KubeError
+from platform_aware_scheduling_tpu.kube.objects import Pod
+from platform_aware_scheduling_tpu.rebalance.replan import Move
+from platform_aware_scheduling_tpu.utils import klog, trace
+
+MODE_OFF = "off"
+MODE_DRY_RUN = "dry-run"
+MODE_ACTIVE = "active"
+MODES = (MODE_OFF, MODE_DRY_RUN, MODE_ACTIVE)
+
+DEFAULT_RATE_PER_S = 0.5
+DEFAULT_BURST = 3
+DEFAULT_COOLDOWN_S = 300.0
+DEFAULT_MIN_AVAILABLE = 1
+GROUP_LABEL = "pas-workload-group"
+
+
+class TokenBucket:
+    """Classic token bucket; ``clock`` injectable for hermetic tests."""
+
+    def __init__(
+        self,
+        rate_per_s: float = DEFAULT_RATE_PER_S,
+        burst: int = DEFAULT_BURST,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rate_per_s = float(rate_per_s)
+        self.burst = max(1, int(burst))
+        self._clock = clock
+        self._tokens = float(self.burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                float(self.burst),
+                self._tokens + (now - self._last) * self.rate_per_s,
+            )
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+@dataclass
+class ActuationResult:
+    executed: List[Move] = field(default_factory=list)
+    skipped: Dict[str, List[Move]] = field(default_factory=dict)
+
+    def skip(self, reason: str, move: Move) -> None:
+        self.skipped.setdefault(reason, []).append(move)
+
+    def skip_counts(self) -> Dict[str, int]:
+        return {reason: len(moves) for reason, moves in self.skipped.items()}
+
+
+def workload_group(pod: Pod) -> str:
+    """The min-available accounting unit: the explicit group label, else
+    the first ownerReference's name (ReplicaSet/Job/StatefulSet), else
+    the pod's own name (a bare pod is its own group of one)."""
+    label = pod.get_labels().get(GROUP_LABEL)
+    if label:
+        return f"label/{pod.namespace}/{label}"
+    owners = pod.metadata.get("ownerReferences") or []
+    if owners and owners[0].get("name"):
+        return f"owner/{pod.namespace}/{owners[0]['name']}"
+    return f"pod/{pod.namespace}/{pod.name}"
+
+
+class SafeActuator:
+    """Executes a plan's moves through the eviction subresource, behind
+    the cooldown / min-available / rate-limit gates."""
+
+    def __init__(
+        self,
+        kube_client,
+        mode: str = MODE_DRY_RUN,
+        rate_per_s: float = DEFAULT_RATE_PER_S,
+        burst: int = DEFAULT_BURST,
+        cooldown_s: float = DEFAULT_COOLDOWN_S,
+        min_available: int = DEFAULT_MIN_AVAILABLE,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"unknown rebalance mode {mode!r}")
+        self.kube_client = kube_client
+        self.mode = mode
+        self.cooldown_s = float(cooldown_s)
+        self.min_available = int(min_available)
+        self._clock = clock
+        self._bucket = TokenBucket(rate_per_s, burst, clock)
+        self._lock = threading.Lock()
+        self._last_evicted: Dict[str, float] = {}  # pod key -> stamp
+
+    # -- gates -----------------------------------------------------------------
+
+    def _in_cooldown(self, pod_key: str) -> bool:
+        with self._lock:
+            stamp = self._last_evicted.get(pod_key)
+        return stamp is not None and (self._clock() - stamp) < self.cooldown_s
+
+    def _stamp(self, pod_key: str) -> None:
+        with self._lock:
+            self._last_evicted[pod_key] = self._clock()
+
+    # -- actuation -------------------------------------------------------------
+
+    def actuate(
+        self,
+        moves: List[Move],
+        pods_by_key: Dict[str, Pod],
+        all_pods: Optional[List[Pod]] = None,
+    ) -> ActuationResult:
+        """Apply the plan.  ``pods_by_key`` maps move.pod_key to the live
+        Pod object; ``all_pods`` is the cluster pod list used for group
+        min-available accounting (group members evicted earlier in this
+        same call count against the floor)."""
+        result = ActuationResult()
+        group_running: Dict[str, int] = {}
+        if all_pods is not None:
+            for pod in all_pods:
+                # terminating pods (deletionTimestamp set) are already on
+                # their way out — counting them as available would let an
+                # eviction drop the group below the floor
+                if (
+                    pod.phase in ("Succeeded", "Failed")
+                    or pod.deletion_timestamp is not None
+                ):
+                    continue
+                group = workload_group(pod)
+                group_running[group] = group_running.get(group, 0) + 1
+        for move in moves:
+            pod = pods_by_key.get(move.pod_key)
+            if pod is None:
+                result.skip("error", move)
+                continue
+            if self._in_cooldown(move.pod_key):
+                result.skip("cooldown", move)
+                continue
+            group = workload_group(pod)
+            if all_pods is not None:
+                if group_running.get(group, 0) - 1 < self.min_available:
+                    result.skip("min_available", move)
+                    continue
+            if not self._bucket.try_take():
+                result.skip("rate_limit", move)
+                continue
+            if self.mode != MODE_ACTIVE:
+                result.skip("dry_run", move)
+                continue
+            try:
+                self.kube_client.evict_pod(pod.namespace, pod.name)
+            except KubeError as exc:
+                reason = "pdb" if exc.status == 409 else "error"
+                klog.v(2).info_s(
+                    f"eviction of {move.pod_key} refused ({reason}): {exc}",
+                    component="rebalance",
+                )
+                result.skip(reason, move)
+                continue
+            self._stamp(move.pod_key)
+            if group in group_running:
+                group_running[group] -= 1
+            result.executed.append(move)
+            klog.v(2).info_s(
+                f"evicted {move.pod_key}: {move.from_node} -> "
+                f"{move.to_node} (gain {move.gain})",
+                component="rebalance",
+            )
+        if result.executed:
+            trace.COUNTERS.inc(
+                "pas_rebalance_moves_executed_total", len(result.executed)
+            )
+        for reason, skipped in result.skipped.items():
+            trace.COUNTERS.inc(
+                "pas_rebalance_moves_skipped_total",
+                len(skipped),
+                labels={"reason": reason},
+            )
+        return result

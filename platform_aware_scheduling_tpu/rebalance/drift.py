@@ -1,0 +1,49 @@
+"""Drift detection with hysteresis over per-cycle violation sets.
+
+One transiently hot scrape must not evict anything: a node becomes an
+eviction candidate only after K CONSECUTIVE enforcement cycles in the
+violation set (the deschedule strategy publishes its node -> [policies]
+map every cycle, empty included).  A cycle in which the node is absent
+resets its streak to zero — recovery is immediate, escalation is slow,
+which is the asymmetry a safe eviction loop wants.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+DEFAULT_HYSTERESIS_CYCLES = 3
+
+
+class DriftDetector:
+    """Streak counter over violation cycles.  Not thread-safe on its own;
+    the rebalance loop calls :meth:`observe` from the single enforcement
+    thread that publishes violations."""
+
+    def __init__(self, k: int = DEFAULT_HYSTERESIS_CYCLES):
+        if k < 1:
+            raise ValueError(f"hysteresis cycles must be >= 1, got {k}")
+        self.k = k
+        self._streaks: Dict[str, int] = {}
+
+    def observe(self, violations: Dict[str, List[str]]) -> Dict[str, List[str]]:
+        """Fold one enforcement cycle in; returns the candidate map
+        (node -> policies violated this cycle) for nodes whose streak has
+        reached K."""
+        streaks: Dict[str, int] = {}
+        for node in violations:
+            streaks[node] = self._streaks.get(node, 0) + 1
+        # nodes absent from this cycle's set simply drop out: streak reset
+        self._streaks = streaks
+        return {
+            node: list(policies)
+            for node, policies in violations.items()
+            if streaks[node] >= self.k
+        }
+
+    def streaks(self) -> Dict[str, int]:
+        """Current per-node consecutive-violation counts (for /debug)."""
+        return dict(self._streaks)
+
+    def reset(self) -> None:
+        self._streaks = {}

@@ -1,0 +1,254 @@
+"""The rebalance control loop: drift -> replan -> actuate, once per
+enforcement cycle.
+
+The loop owns no timer.  It subscribes to the MetricEnforcer's
+per-cycle violation publications (``enforcer.violation_observers``), so
+each deschedule enforcement pass IS a rebalance cycle: the drift
+detector folds the cycle in, nodes past the hysteresis threshold become
+candidates, the evictable pods on candidate nodes are replanned
+on-device with the migration-cost penalty, and the actuator applies the
+bounded move list behind its guards.  Everything runs in the enforcer's
+thread — a failing cycle is logged and the next enforcement pass simply
+starts a fresh one.
+
+The most recent plan (and the loop's configuration and streaks) is
+published as JSON on ``GET /debug/rebalance`` on both front-ends.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from platform_aware_scheduling_tpu.kube.objects import Pod, object_key
+from platform_aware_scheduling_tpu.rebalance.actuator import (
+    DEFAULT_BURST,
+    DEFAULT_COOLDOWN_S,
+    DEFAULT_MIN_AVAILABLE,
+    DEFAULT_RATE_PER_S,
+    MODE_ACTIVE,
+    MODE_OFF,
+    MODES,
+    SafeActuator,
+)
+from platform_aware_scheduling_tpu.rebalance.drift import (
+    DEFAULT_HYSTERESIS_CYCLES,
+    DriftDetector,
+)
+from platform_aware_scheduling_tpu.rebalance.replan import (
+    DEFAULT_MAX_MOVES,
+    DEFAULT_MIGRATION_COST,
+    IncrementalReplanner,
+    PlanResult,
+)
+from platform_aware_scheduling_tpu.tas.planner import (
+    DEFAULT_NODE_CAPACITY,
+    TAS_POLICY_LABEL,
+)
+from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+DESCHEDULE_STRATEGY = "deschedule"
+
+
+class Rebalancer:
+    """Drift detector + incremental replanner + safe actuator, driven by
+    enforcement-cycle violation publications."""
+
+    def __init__(
+        self,
+        kube_client,
+        mirror,
+        mode: str = "dry-run",
+        hysteresis_cycles: int = DEFAULT_HYSTERESIS_CYCLES,
+        solver: str = "greedy",
+        max_moves: int = DEFAULT_MAX_MOVES,
+        migration_cost: float = DEFAULT_MIGRATION_COST,
+        rate_per_s: float = DEFAULT_RATE_PER_S,
+        burst: int = DEFAULT_BURST,
+        cooldown_s: float = DEFAULT_COOLDOWN_S,
+        min_available: int = DEFAULT_MIN_AVAILABLE,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"unknown rebalance mode {mode!r}")
+        self.kube_client = kube_client
+        self.mode = mode
+        self.drift = DriftDetector(k=hysteresis_cycles)
+        self.replanner = IncrementalReplanner(
+            mirror,
+            solver=solver,
+            migration_cost=migration_cost,
+            max_moves=max_moves,
+        )
+        self.actuator = SafeActuator(
+            kube_client,
+            mode=mode,
+            rate_per_s=rate_per_s,
+            burst=burst,
+            cooldown_s=cooldown_s,
+            min_available=min_available,
+            clock=clock,
+        )
+        self._lock = threading.Lock()
+        self._cycles = 0
+        self._last_plan: Optional[Dict] = None
+        # convergence episode tracking: first violating cycle after a
+        # clean one opens an episode; the next clean cycle closes it and
+        # publishes its length
+        self._episode_start: Optional[int] = None
+        self._last_convergence: Optional[int] = None
+
+    # -- wiring ----------------------------------------------------------------
+
+    def attach(self, enforcer) -> None:
+        """Subscribe to the enforcer's violation publications."""
+        enforcer.violation_observers.append(self.on_violations)
+
+    def on_violations(
+        self, strategy_type: str, violations: Dict[str, List[str]]
+    ) -> None:
+        if strategy_type != DESCHEDULE_STRATEGY:
+            return
+        try:
+            self.cycle(violations)
+        except Exception as exc:  # a bad cycle must not break enforcement
+            klog.error("rebalance cycle failed: %r", exc)
+
+    # -- the cycle -------------------------------------------------------------
+
+    def cycle(self, violations: Dict[str, List[str]]) -> Dict:
+        """One rebalance cycle over this enforcement pass's violation
+        map; returns (and stores for /debug/rebalance) the plan record."""
+        with self._lock:
+            self._cycles += 1
+            cycle_no = self._cycles
+            if violations and self._episode_start is None:
+                self._episode_start = cycle_no
+            elif not violations and self._episode_start is not None:
+                self._last_convergence = cycle_no - self._episode_start
+                self._episode_start = None
+                trace.COUNTERS.set_gauge(
+                    "pas_rebalance_convergence_cycles",
+                    float(self._last_convergence),
+                )
+        candidates = self.drift.observe(violations)
+        trace.COUNTERS.set_gauge(
+            "pas_rebalance_candidate_nodes", float(len(candidates))
+        )
+        record: Dict = {
+            "cycle": cycle_no,
+            "mode": self.mode,
+            "violating_nodes": sorted(violations),
+            "candidate_nodes": sorted(candidates),
+            "moves": [],
+            "executed": [],
+            "skipped": {},
+            "plan_ms": 0.0,
+            "view_version": None,
+        }
+        if self.mode == MODE_OFF or not candidates:
+            with self._lock:
+                self._last_plan = record
+            return record
+        evictable, pods_by_key, all_pods, remaining = self._evictable_pods(
+            candidates
+        )
+        plan = self.replanner.plan(evictable, violations, remaining)
+        trace.COUNTERS.inc("pas_rebalance_plans_total")
+        trace.COUNTERS.set_gauge(
+            "pas_rebalance_plan_latency_seconds", plan.latency_s
+        )
+        if plan.moves:
+            trace.COUNTERS.inc(
+                "pas_rebalance_moves_planned_total", len(plan.moves)
+            )
+        actuation = self.actuator.actuate(plan.moves, pods_by_key, all_pods)
+        record.update(
+            {
+                "considered_pods": plan.considered,
+                "skipped_pods": plan.skipped_pods,
+                "truncated_moves": plan.truncated,
+                "moves": [m._asdict() for m in plan.moves],
+                "executed": [m.pod_key for m in actuation.executed],
+                "skipped": actuation.skip_counts(),
+                "plan_ms": round(plan.latency_s * 1e3, 3),
+                "view_version": plan.view_version,
+            }
+        )
+        with self._lock:
+            self._last_plan = record
+        if plan.moves:
+            klog.v(2).info_s(
+                f"rebalance cycle {cycle_no}: {len(plan.moves)} moves "
+                f"planned, {len(actuation.executed)} executed, "
+                f"skipped {actuation.skip_counts()}",
+                component="rebalance",
+            )
+        return record
+
+    def _evictable_pods(self, candidates: Dict[str, List[str]]):
+        """(evictable pods on candidate nodes, key -> Pod, all pods,
+        remaining capacity per node).  Evictable = bound to a candidate
+        node, policy-managed (carries the telemetry-policy label), still
+        running, and not already terminating."""
+        all_pods = self.kube_client.list_pods()
+        bound: Dict[str, int] = {}
+        evictable: List[Pod] = []
+        pods_by_key: Dict[str, Pod] = {}
+        for pod in all_pods:
+            node = pod.spec_node_name
+            if node and pod.phase not in ("Succeeded", "Failed"):
+                bound[node] = bound.get(node, 0) + 1
+            if (
+                node in candidates
+                and pod.phase not in ("Succeeded", "Failed")
+                and pod.deletion_timestamp is None
+                and TAS_POLICY_LABEL in pod.get_labels()
+            ):
+                evictable.append(pod)
+                pods_by_key[object_key(pod)] = pod
+        remaining: Dict[str, int] = {}
+        # a list_nodes failure aborts the cycle (cycle() propagates to the
+        # guarded observer): proceeding would hand the replan a fabricated
+        # default capacity for every node and actuate evictions against it
+        nodes = self.kube_client.list_nodes()
+        for node in nodes:
+            alloc = DEFAULT_NODE_CAPACITY
+            raw = node.allocatable.get("pods")
+            if raw is not None:
+                try:
+                    value, _exact = Quantity(str(raw)).as_int64()
+                    alloc = int(value)
+                except Exception:
+                    pass
+            remaining[node.name] = alloc - bound.get(node.name, 0)
+        return evictable, pods_by_key, all_pods, remaining
+
+    # -- debug surface ---------------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._lock:
+            last_plan = self._last_plan
+            cycles = self._cycles
+            episode_start = self._episode_start
+            last_convergence = self._last_convergence
+        return {
+            "mode": self.mode,
+            "solver": self.replanner.solver,
+            "hysteresis_cycles": self.drift.k,
+            "max_moves_per_cycle": self.replanner.max_moves,
+            "migration_cost": self.replanner.migration_cost,
+            "cooldown_s": self.actuator.cooldown_s,
+            "min_available": self.actuator.min_available,
+            "cycles": cycles,
+            "streaks": self.drift.streaks(),
+            "in_episode": episode_start is not None,
+            "last_convergence_cycles": last_convergence,
+            "last_plan": last_plan,
+        }
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.status()).encode() + b"\n"

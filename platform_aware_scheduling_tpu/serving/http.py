@@ -293,7 +293,8 @@ class AsyncServer:
                 )
                 bare_path = path.partition("?")[0]
                 if bare_path in (
-                    "/metrics", "/debug/traces", "/healthz", "/readyz",
+                    "/metrics", "/debug/traces", "/debug/rebalance",
+                    "/healthz", "/readyz",
                 ):
                     # observability endpoints bypass the admission queue:
                     # they must stay readable precisely when the queue is

@@ -109,7 +109,24 @@ class MetricEnforcer:
         # optional TensorStateMirror: strategies with a device-path
         # ``violated_device`` use it during enforcement
         self.mirror = mirror
+        # per-cycle violation subscribers: callables
+        # ``(strategy_type, {node: [policy names]})`` invoked by strategies
+        # at the end of every enforcement pass (including empty ones) —
+        # the rebalance loop's drift detector feeds off this
+        self.violation_observers: List = []
         self._lock = threading.RLock()
+
+    def publish_violations(
+        self, strategy_type: str, violations: Dict[str, List[str]]
+    ) -> None:
+        """Fan a finished enforcement cycle's violation map out to the
+        registered observers; a failing observer must never break the
+        enforcement loop."""
+        for observer in list(self.violation_observers):
+            try:
+                observer(strategy_type, violations)
+            except Exception as exc:  # noqa: BLE001 — observer errors are theirs
+                klog.error("violation observer failed: %r", exc)
 
     def register_strategy_type(self, strategy: StrategyInterface) -> None:
         with self._lock:

@@ -131,7 +131,16 @@ class Strategy:
             klog.v(2).info_s(f"cannot list nodes: {exc}", component="controller")
             raise
         violations = self._node_status_for_strategy(enforcer, cache)
-        total = self._update_node_labels(enforcer, violations, nodes)
+        try:
+            total = self._update_node_labels(enforcer, violations, nodes)
+        finally:
+            # close-the-loop feed: every enforcement cycle publishes its
+            # full node -> [violated policies] map — including the empty
+            # one (hysteresis streaks reset on clean cycles) and even when
+            # label patching fails (the violations are already final; a
+            # patch-failure window must not freeze the drift detector's
+            # consecutive-cycle accounting)
+            enforcer.publish_violations(STRATEGY_TYPE, violations)
         trace.COUNTERS.inc(
             "pas_strategy_enforcements_total", labels={"strategy": STRATEGY_TYPE}
         )
@@ -234,7 +243,10 @@ class Strategy:
                             "value": "null",
                         }
                     )
-                total_violations += 1
+            # the count is the node's ACTUAL violations; the old placement
+            # inside the non-violated loop returned the number of
+            # non-violating registered policies per node instead
+            total_violations += len(violations.get(node.name, []))
             try:
                 self._patch_node(node.name, enforcer, payload)
             except Exception as exc:

@@ -93,6 +93,10 @@ class MetricsExtender:
         # opt-in tas.planner.BatchPlanner: prioritize answers steer planned
         # pods onto their batch-assigned node (see planner module doc)
         self.planner = planner
+        # opt-in rebalance.Rebalancer, set by the service main when
+        # --rebalance != off; the front-ends serve its last plan on
+        # GET /debug/rebalance (404 while this is None)
+        self.rebalancer = None
         # request-independent ranking/violation caches + byte-fragment
         # encoder (tas/fastpath.py) — the per-request device dispatch and
         # per-node Python objects the round-1 verdict flagged are gone

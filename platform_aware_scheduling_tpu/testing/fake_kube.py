@@ -89,10 +89,16 @@ class FakeKubeClient:
         self._hubs = {"nodes": _WatchHub(), "pods": _WatchHub(), "taspolicies": _WatchHub()}
         self.bindings: List[Dict[str, Any]] = []
         self.node_patches: List[Tuple[str, List[Dict[str, Any]]]] = []
+        self.evictions: List[Dict[str, Any]] = []
+        # PDB-style eviction guard: (namespace, name) keys whose eviction
+        # the fake refuses with 409 (the API server's disruption-budget
+        # rejection), recorded but never applied
+        self.evict_denials: set = set()
         # fault injection
         self.update_pod_conflicts_remaining = 0
         self.fail_next_bind: Optional[Exception] = None
         self.fail_metric_fetch: Optional[Exception] = None
+        self.fail_next_evict: Optional[Exception] = None
 
     def _next_rv(self) -> str:
         self._rv += 1
@@ -212,6 +218,41 @@ class FakeKubeClient:
             )
             snapshot = copy.deepcopy(self._pods[key])
         self._hubs["pods"].publish("MODIFIED", snapshot)
+
+    def evict_pod(
+        self,
+        namespace: str,
+        pod_name: str,
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        """pods/eviction subresource: a denied key answers 409 (the
+        PDB-style guard); success records the eviction and deletes the
+        pod (DELETED published to pod watchers)."""
+        if self.fail_next_evict is not None:
+            exc, self.fail_next_evict = self.fail_next_evict, None
+            raise exc
+        key = (namespace, pod_name)
+        with self._lock:
+            if key not in self._pods:
+                raise NotFoundError(
+                    f"pod {namespace}/{pod_name} not found", status=404
+                )
+            if key in self.evict_denials:
+                raise ConflictError(
+                    "Cannot evict pod as it would violate the pod's "
+                    "disruption budget.",
+                    status=409,
+                )
+            raw = self._pods.pop(key)
+            self.evictions.append(
+                {
+                    "namespace": namespace,
+                    "pod": pod_name,
+                    "node": (raw.get("spec") or {}).get("nodeName", ""),
+                    "grace_period_seconds": grace_period_seconds,
+                }
+            )
+        self._hubs["pods"].publish("DELETED", raw)
 
     # -- TASPolicy CRD -------------------------------------------------------
 

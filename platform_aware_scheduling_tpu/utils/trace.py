@@ -127,6 +127,15 @@ declare("pas_device_memory_limit_bytes", "gauge", "Device memory ceiling (label:
 declare("pas_device_kernel_flops", "gauge", "XLA cost-analysis FLOPs for each watched kernel's first compile (label: kernel).")
 declare("pas_device_kernel_bytes", "gauge", "XLA cost-analysis bytes accessed for each watched kernel's first compile (label: kernel).")
 declare("pas_profile_captures_total", "counter", "Bounded jax.profiler traces captured via GET /debug/profile.")
+# closed-loop rebalancer (rebalance/: drift detector -> incremental
+# replan -> safe eviction actuation; docs/rebalance.md)
+declare("pas_rebalance_plans_total", "counter", "Rebalance cycles that produced a plan (including empty plans).")
+declare("pas_rebalance_moves_planned_total", "counter", "Pod moves proposed by rebalance plans (within the churn budget).")
+declare("pas_rebalance_moves_executed_total", "counter", "Pod evictions actually executed by the rebalance actuator.")
+declare("pas_rebalance_moves_skipped_total", "counter", "Planned moves not executed (label: reason in dry_run/rate_limit/cooldown/min_available/pdb/error).")
+declare("pas_rebalance_candidate_nodes", "gauge", "Nodes currently past the deschedule hysteresis threshold (eviction candidates).")
+declare("pas_rebalance_convergence_cycles", "gauge", "Enforcement cycles the most recent violation episode took from first violation back to zero.")
+declare("pas_rebalance_plan_latency_seconds", "gauge", "Wall latency of the most recent incremental replan solve.")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where

@@ -202,6 +202,48 @@ class TestDescheduleEnforce:
         strategy.cleanup(enforcer, "deschedule-test")
         assert "deschedule-test" not in fake.get_node("node1").get_labels()
 
+    def test_enforce_returns_actual_violation_count(self):
+        """Regression (ISSUE 4): the count used to be incremented inside
+        the NON-violated policy loop, so with one registered policy and
+        three nodes (one violating) enforce() returned 2 — the number of
+        non-violating registered policies per node — instead of 1."""
+        fake, enforcer, strategy = self.setup_enforcer()
+        fake.add_node(make_node("node3", labels={}))
+        cache = metric_cache(
+            health_metric={"node1": "1", "node2": "0", "node3": "0"}
+        )
+        assert strategy.enforce(enforcer, cache) == 1
+        # two violating nodes -> 2
+        cache2 = metric_cache(
+            health_metric={"node1": "1", "node2": "1", "node3": "0"}
+        )
+        assert strategy.enforce(enforcer, cache2) == 2
+        # no violations -> 0 (the old code would have returned 3)
+        cache3 = metric_cache(
+            health_metric={"node1": "0", "node2": "0", "node3": "0"}
+        )
+        assert strategy.enforce(enforcer, cache3) == 0
+
+    def test_enforce_publishes_violations_each_cycle(self):
+        """Every enforcement pass publishes its node -> [policies] map to
+        the enforcer's violation observers — including the empty map, so
+        hysteresis streaks downstream can reset on clean cycles."""
+        fake, enforcer, strategy = self.setup_enforcer()
+        seen = []
+        enforcer.violation_observers.append(
+            lambda stype, violations: seen.append((stype, violations))
+        )
+        strategy.enforce(
+            enforcer, metric_cache(health_metric={"node1": "1", "node2": "0"})
+        )
+        strategy.enforce(
+            enforcer, metric_cache(health_metric={"node1": "0", "node2": "0"})
+        )
+        assert seen == [
+            ("deschedule", {"node1": ["deschedule-test"]}),
+            ("deschedule", {}),
+        ]
+
     def test_periodic_enforcement_loop(self):
         import time
 

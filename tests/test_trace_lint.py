@@ -71,6 +71,22 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_rebalance_families_declared(self):
+        """ISSUE 4: the closed-loop rebalancer's metric families are part
+        of the declared inventory (docs/rebalance.md)."""
+        expected = {
+            "pas_rebalance_plans_total": "counter",
+            "pas_rebalance_moves_planned_total": "counter",
+            "pas_rebalance_moves_executed_total": "counter",
+            "pas_rebalance_moves_skipped_total": "counter",
+            "pas_rebalance_candidate_nodes": "gauge",
+            "pas_rebalance_convergence_cycles": "gauge",
+            "pas_rebalance_plan_latency_seconds": "gauge",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
 
 class TestLiveEmission:
     """Drive both front-ends, scrape /metrics, and hold every emitted
