@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving bench-rebalance test-serving test-obs test-rebalance trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving bench-rebalance bench-chaos test-serving test-obs test-rebalance test-faults trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -46,6 +46,18 @@ test-rebalance:
 # rebalance convergence A/B alone: synthetic churn, active vs label-only
 bench-rebalance:
 	python -m benchmarks.rebalance_load
+
+# fault-tolerance & chaos suite (docs/robustness.md): retry/backoff
+# schedules, circuit transitions, degraded modes, and the end-to-end
+# outage -> degrade -> recover -> resume invariant (zero evictions on
+# stale data) — deterministic: fault plans + fake clocks, no real sleeps
+test-faults:
+	python -m pytest tests/test_faults.py -q
+
+# chaos A/B alone: availability + p99 through the live front-end under a
+# scripted 10% metrics-API error rate vs a clean baseline
+bench-chaos:
+	python -m benchmarks.chaos_load
 
 # metric-name convention gate (docs/observability.md): every emitted
 # metric is declared in trace.METRICS, pas_-prefixed snake_case, no

@@ -168,7 +168,8 @@ def _detail_path(round_override=None) -> str:
 
 
 def assemble_line(
-    headline, load, configs_out, gas=None, serving=None, rebalance=None
+    headline, load, configs_out, gas=None, serving=None, rebalance=None,
+    chaos=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -228,6 +229,21 @@ def assemble_line(
             "label_only_converged": label_only.get("converged"),
             "label_only_residual_violations": label_only.get(
                 "residual_violations"
+            ),
+        }
+    if chaos is not None:
+        # full per-side latency dicts to disk; the line keeps only the
+        # availability + p99-ratio headline (service stays flat through
+        # a scripted 10% metrics-API error rate — docs/robustness.md)
+        detail["chaos"] = chaos
+        clean = chaos.get("clean") or {}
+        faulty = chaos.get("faulty") or {}
+        result["chaos"] = {
+            "num_nodes": chaos.get("num_nodes"),
+            "availability_clean": clean.get("availability"),
+            "availability_faulty": faulty.get("availability"),
+            "p99_ratio_faulty_vs_clean": chaos.get(
+                "p99_ratio_faulty_vs_clean"
             ),
         }
     if load is not None:
@@ -383,6 +399,22 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"rebalance bench failed: {exc}", file=sys.stderr)
 
+    # --- chaos: availability + p99 under a scripted 10% metrics-API
+    # error rate vs clean baseline (benchmarks/chaos_load.py) ---
+    chaos = None
+    try:
+        from benchmarks import chaos_load
+
+        chaos = chaos_load.run()
+        print(
+            f"chaos: availability clean={chaos['clean']['availability']} "
+            f"faulty={chaos['faulty']['availability']} at 10% API errors; "
+            f"p99 ratio x{chaos['p99_ratio_faulty_vs_clean']}",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"chaos bench failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -393,7 +425,7 @@ def main():
         print(f"config benches failed: {exc}", file=sys.stderr)
 
     result, detail = assemble_line(
-        headline, load, configs_out, gas, serving, rebalance
+        headline, load, configs_out, gas, serving, rebalance, chaos
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

@@ -25,6 +25,72 @@ def add_profile_flag(parser: argparse.ArgumentParser) -> None:
                         "tracing at all)")
 
 
+def add_robustness_flags(
+    parser: argparse.ArgumentParser, degraded: bool = True
+) -> None:
+    """Fault-tolerance flag surface shared by both mains
+    (docs/robustness.md): retry/backoff and circuit-breaker tuning.
+    ``--degradedMode`` only exists where a DegradedModeController is
+    actually built (TAS); offering a flag GAS would silently ignore is
+    worse than not offering it."""
+    parser.add_argument("--retryMaxAttempts", type=int, default=4,
+                        help="max attempts per idempotent API read "
+                        "(writes never blind-retry)")
+    parser.add_argument("--retryBaseDelay", default="100ms",
+                        help="first retry backoff (Go duration); doubles "
+                        "per attempt with deterministic jitter")
+    parser.add_argument("--retryMaxDelay", default="5s",
+                        help="backoff cap (Go duration)")
+    parser.add_argument("--retryDeadline", default="30s",
+                        help="per-call deadline across all retry attempts "
+                        "(Go duration)")
+    parser.add_argument("--circuitFailureThreshold", type=int, default=5,
+                        help="consecutive transport failures that open an "
+                        "endpoint group's circuit")
+    parser.add_argument("--circuitResetTimeout", default="30s",
+                        help="how long an open circuit waits before the "
+                        "half-open probe (Go duration)")
+    if degraded:
+        parser.add_argument("--degradedMode", default="last-known-good",
+                            choices=["fail-open", "fail-closed",
+                                     "last-known-good"],
+                            help="dontschedule Filter policy while telemetry "
+                            "is degraded: fail-open passes every candidate, "
+                            "fail-closed passes none, last-known-good keeps "
+                            "serving retained values within a bounded age "
+                            "then fails open.  Evictions are ALWAYS "
+                            "suspended while degraded (not configurable)")
+
+
+def build_fault_tolerance(args):
+    """(RetryPolicy, CircuitBreakerRegistry) from the shared flags."""
+    from platform_aware_scheduling_tpu.kube.retry import (
+        CircuitBreakerRegistry,
+        RetryPolicy,
+    )
+    from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+    policy = RetryPolicy(
+        max_attempts=args.retryMaxAttempts,
+        base_delay_s=parse_duration(args.retryBaseDelay),
+        max_delay_s=parse_duration(args.retryMaxDelay),
+        deadline_s=parse_duration(args.retryDeadline),
+    )
+    breakers = CircuitBreakerRegistry(
+        failure_threshold=args.circuitFailureThreshold,
+        reset_timeout_s=parse_duration(args.circuitResetTimeout),
+    )
+    return policy, breakers
+
+
+def wrap_kube_client(kube_client, policy, breakers):
+    """The fault-tolerant proxy both mains put in front of every API
+    consumer (kube/retry.py)."""
+    from platform_aware_scheduling_tpu.kube.retry import FaultTolerantClient
+
+    return FaultTolerantClient(kube_client, policy=policy, breakers=breakers)
+
+
 def maybe_start_profiler(port: int) -> bool:
     """Start the JAX profiler server when ``port`` is nonzero; returns
     whether it is serving.  Profiling must never block serving — any

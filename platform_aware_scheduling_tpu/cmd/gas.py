@@ -47,6 +47,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "requests get 503 + Retry-After")
     # parity with cmd/tas.py via the one shared helper (cmd/common.py)
     common.add_profile_flag(parser)
+    common.add_robustness_flags(parser, degraded=False)
     return parser
 
 
@@ -54,11 +55,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_arg_parser().parse_args(argv)
     klog.set_verbosity(args.v)
 
-    kube_client = get_kube_client(args.kubeConfig)
+    # fault-tolerant proxy in front of every API consumer — GAS has no
+    # telemetry cache so no degraded-mode controller, but its informers
+    # and bind/annotate traffic get the same retry/backoff/circuit
+    # treatment as TAS (docs/robustness.md)
+    retry_policy, breakers = common.build_fault_tolerance(args)
+    kube_client = common.wrap_kube_client(
+        get_kube_client(args.kubeConfig), retry_policy, breakers
+    )
     # before the extender warms its device binpack kernels (cost capture
     # rides each kernel's first compile)
     common.install_cost_visibility()
-    extender = GASExtender(kube_client)
+    extender = GASExtender(kube_client, retry_policy=retry_policy)
 
     common.maybe_start_profiler(args.profilePort)
     watch_stop = threading.Event()

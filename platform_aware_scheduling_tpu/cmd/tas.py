@@ -105,6 +105,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="per-workload-group running-pod floor the "
                         "actuator must not evict below")
     common.add_profile_flag(parser)
+    common.add_robustness_flags(parser)
     return parser
 
 
@@ -118,10 +119,18 @@ def assemble(
     node_cache_capable: bool = False,
     rebalance_mode: str = "off",
     rebalance_options: Optional[dict] = None,
+    breakers=None,
+    degraded_mode: Optional[str] = None,
 ):
     """Wire cache + mirror + extender + controller + enforcer (the body of
     ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
-    a stop Event controlling every background loop."""
+    a stop Event controlling every background loop.
+
+    ``breakers``/``degraded_mode``: when either is given, a
+    DegradedModeController (tas/degraded.py) is built over the cache's
+    freshness signal and the circuit states and attached to the
+    extender, the enforcer, and the rebalancer — degraded Filter/
+    Prioritize policy plus the unconditional eviction suspension."""
     cache = AutoUpdatingCache()
     mirror: Optional[TensorStateMirror] = None
     if enable_device_path:
@@ -144,6 +153,21 @@ def assemble(
     enforcer.register_strategy_type(scheduleonmetric.Strategy())
     enforcer.register_strategy_type(dontschedule.Strategy())
 
+    degraded = None
+    if breakers is not None or degraded_mode is not None:
+        from platform_aware_scheduling_tpu.tas.degraded import (
+            MODE_LAST_KNOWN_GOOD,
+            DegradedModeController,
+        )
+
+        degraded = DegradedModeController(
+            cache,
+            breakers=breakers,
+            mode=degraded_mode or MODE_LAST_KNOWN_GOOD,
+        )
+        extender.degraded = degraded
+        enforcer.degraded = degraded
+
     # closed-loop rebalancer (docs/rebalance.md): each deschedule
     # enforcement cycle feeds the drift detector; past the hysteresis
     # threshold the evictable pods are replanned on-device and (active
@@ -156,6 +180,7 @@ def assemble(
             kube_client, mirror, mode=rebalance_mode,
             **(rebalance_options or {}),
         )
+        rebalancer.degraded = degraded
         rebalancer.attach(enforcer)
         extender.rebalancer = rebalancer
 
@@ -210,7 +235,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     klog.set_verbosity(args.v)
     sync_period_s = parse_duration(args.syncPeriod)
 
-    kube_client = get_kube_client(args.kubeConfig)
+    # every remote call goes through the fault-tolerant proxy: retried
+    # reads, breaker-gated writes, per-endpoint-group circuits
+    # (kube/retry.py; docs/robustness.md).  The metrics client rides the
+    # same proxy — its get_node_custom_metric verb lands in the
+    # "metrics" circuit group
+    retry_policy, breakers = common.build_fault_tolerance(args)
+    kube_client = common.wrap_kube_client(
+        get_kube_client(args.kubeConfig), retry_policy, breakers
+    )
     metrics_client = CustomMetricsClient(kube_client)
     # cost-analysis capture hangs off each kernel's FIRST compile, which
     # assemble's warm pass triggers — install before assembly
@@ -222,6 +255,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         enable_batch_planner=args.batchPlanner,
         batch_solver=args.batchSolver,
         node_cache_capable=args.nodeCacheCapable,
+        breakers=breakers,
+        degraded_mode=args.degradedMode,
         rebalance_mode=args.rebalance,
         rebalance_options={
             "hysteresis_cycles": args.rebalanceHysteresis,

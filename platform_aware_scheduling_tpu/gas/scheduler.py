@@ -53,6 +53,7 @@ from platform_aware_scheduling_tpu.gas.utils import (
     container_requests,
 )
 from platform_aware_scheduling_tpu.kube.client import ConflictError
+from platform_aware_scheduling_tpu.kube.retry import RetryPolicy
 from platform_aware_scheduling_tpu.kube.objects import Node, Pod
 from platform_aware_scheduling_tpu.utils import klog, trace
 from platform_aware_scheduling_tpu.utils.quantity import Quantity
@@ -75,8 +76,24 @@ class GASExtender:
         recorder: Optional[LatencyRecorder] = None,
         use_device: bool = True,
         use_mirror: bool = True,
+        retry_policy: Optional[RetryPolicy] = None,
+        sleep=time.sleep,
     ):
         self.kube_client = kube_client
+        # backoff between annotate conflict-retries (the reference loop
+        # at scheduler.go:82-119 retried with ZERO sleep, hammering the
+        # API server exactly when it reported contention); deterministic
+        # jitter, injectable sleep for hermetic tests
+        self.retry_policy = (
+            retry_policy
+            if retry_policy is not None
+            else RetryPolicy(
+                max_attempts=UPDATE_RETRY_COUNT,
+                base_delay_s=0.05,
+                max_delay_s=1.0,
+            )
+        )
+        self._sleep = sleep
         self.cache = cache if cache is not None else Cache(kube_client)
         self.recorder = recorder or LatencyRecorder()
         # workqueue work-latency histogram merges into this extender's
@@ -307,7 +324,7 @@ class GASExtender:
         pod_copy = pod.deep_copy()
         ts = str(time.time_ns())
         last_exc: Optional[Exception] = None
-        for _attempt in range(UPDATE_RETRY_COUNT):
+        for attempt in range(UPDATE_RETRY_COUNT):
             pod_copy.annotations[TS_ANNOTATION] = ts
             pod_copy.annotations[CARD_ANNOTATION] = annotation
             try:
@@ -327,6 +344,16 @@ class GASExtender:
                     klog.error("pod refresh failed")
                     break
                 klog.error("pod update failed, retrying with refreshed pod")
+                # back off before re-applying: a 409 means the API server
+                # is under write contention on this object — re-hammering
+                # it with zero sleep (the reference behavior) just
+                # prolongs the conflict storm
+                if attempt + 1 < UPDATE_RETRY_COUNT:
+                    self._sleep(
+                        self.retry_policy.backoff(
+                            attempt + 1, verb="update_pod"
+                        )
+                    )
             except Exception as exc:
                 last_exc = exc
                 break

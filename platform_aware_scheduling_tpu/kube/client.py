@@ -41,9 +41,17 @@ CUSTOM_METRICS_VERSIONS = ("v1beta2", "v1beta1")
 
 
 class KubeError(Exception):
-    def __init__(self, message: str, status: int = 0):
+    def __init__(
+        self,
+        message: str,
+        status: int = 0,
+        retry_after: Optional[float] = None,
+    ):
         super().__init__(message)
         self.status = status
+        #: server-sent Retry-After in seconds (429/503), honored as a
+        #: backoff floor by kube.retry.RetryPolicy; None when absent
+        self.retry_after = retry_after
 
 
 class ConflictError(KubeError):
@@ -120,6 +128,22 @@ class KubeConfig:
         )
 
 
+def _parse_retry_after(headers) -> Optional[float]:
+    """Seconds from a ``Retry-After`` header (delta-seconds form only —
+    kube API throttling always sends the integer form); None when absent
+    or unparseable."""
+    if headers is None:
+        return None
+    raw = headers.get("Retry-After")
+    if raw is None:
+        return None
+    try:
+        value = float(str(raw).strip())
+    except ValueError:
+        return None
+    return value if value >= 0 else None
+
+
 def get_kube_client(kube_config_path: str) -> "KubeClient":
     """In-cluster config with kubeconfig-file fallback
     (reference extender/client.go:12-26)."""
@@ -193,7 +217,11 @@ class KubeClient:
                 ) from exc
             if exc.code == 404:
                 raise NotFoundError(msg or "not found", status=404) from exc
-            raise KubeError(f"{method} {path}: HTTP {exc.code}: {msg}", status=exc.code) from exc
+            raise KubeError(
+                f"{method} {path}: HTTP {exc.code}: {msg}",
+                status=exc.code,
+                retry_after=_parse_retry_after(exc.headers),
+            ) from exc
         except urllib.error.URLError as exc:
             raise KubeError(f"{method} {path}: {exc.reason}") from exc
         if stream:

@@ -16,6 +16,7 @@ Both schedulers hang their state off informers: TAS watches the TASPolicy CRD
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -57,15 +58,28 @@ class Informer:
         filter_func: Optional[Callable[[Any], bool]] = None,
         name: str = "",
         counters: Optional[CounterSet] = None,
+        relist_backoff_base_s: float = 0.2,
+        relist_backoff_max_s: float = 30.0,
     ):
         """A NAMED informer exports controller-loop health
         (docs/observability.md): ``pas_informer_relists_total`` /
         ``pas_informer_watch_errors_total`` counters and the
         ``pas_informer_synced`` gauge (0 until the initial list
         delivers), all labeled ``informer=<name>``.  Unnamed informers
-        stay silent."""
+        stay silent.
+
+        Consecutive watch failures back off between relists with capped
+        exponential delays and deterministic jitter (kube.retry.
+        backoff_delay, seeded off the informer name) — a dead API server
+        sees one relist per backoff window, not a tight relist storm.
+        A watch that delivered at least one event resets the streak."""
         self._lw = list_watch
         self.name = name
+        self.relist_backoff_base_s = relist_backoff_base_s
+        self.relist_backoff_max_s = relist_backoff_max_s
+        self._watch_failures = 0
+        #: recent computed backoff delays (bounded), pinned by tests
+        self.relist_backoffs: List[float] = []
         self.counters = counters if counters is not None else trace.COUNTERS
         if name:
             self.counters.set_gauge(
@@ -196,8 +210,26 @@ class Informer:
                 if self._passes(current):
                     self._on_update(current, current)
 
+    def _backoff(self) -> float:
+        """Delay before the next relist after a watch/list failure."""
+        from platform_aware_scheduling_tpu.kube.retry import (
+            backoff_delay,
+            stable_hash,
+        )
+
+        delay = backoff_delay(
+            self._watch_failures,
+            self.relist_backoff_base_s,
+            self.relist_backoff_max_s,
+            seed=stable_hash(self.name or "informer"),
+        )
+        self.relist_backoffs.append(delay)
+        del self.relist_backoffs[:-32]
+        return delay
+
     def _run(self) -> None:
         first = True
+        watch_started: Optional[float] = None
         while not self._stop.is_set():
             try:
                 self._relist(initial=first)
@@ -208,9 +240,14 @@ class Informer:
                         "pas_informer_synced", 1,
                         labels={"informer": self.name},
                     )
+                watch_started = time.monotonic()
                 for event_type, obj in self._lw.watch(self._resource_version):
                     if self._stop.is_set():
                         return
+                    # a delivering watch is a healthy watch: reset the
+                    # consecutive-failure streak so one blip after hours
+                    # of uptime pays the base delay, not the cap
+                    self._watch_failures = 0
                     key = self._lw.key(obj)
                     if event_type == "ADDED":
                         with self._store_lock:
@@ -239,5 +276,21 @@ class Informer:
                         "pas_informer_watch_errors_total",
                         labels={"informer": self.name},
                     )
-                klog.v(4).info_s(f"informer watch error, relisting: {exc}")
-                self._stop.wait(0.2)
+                # a watch that ran healthily past the backoff cap before
+                # breaking is a fresh incident, not a continuation of the
+                # old streak — without this, a quiet cluster (no events
+                # to trigger the delivery reset) pays the CAPPED delay
+                # for a single blip hours after the last storm
+                if (
+                    watch_started is not None
+                    and time.monotonic() - watch_started
+                    > max(self.relist_backoff_max_s, 1.0)
+                ):
+                    self._watch_failures = 0
+                watch_started = None
+                self._watch_failures += 1
+                delay = self._backoff()
+                klog.v(4).info_s(
+                    f"informer watch error, relisting in {delay:.3f}s: {exc}"
+                )
+                self._stop.wait(delay)

@@ -1,0 +1,486 @@
+"""Retry, backoff, and circuit-breaking for every remote dependency.
+
+The reference extenders live or die by two remote APIs — the kube API
+server and the custom-metrics API — and both its clients are one-shot:
+the first transport error propagates straight into whatever loop made
+the call (SURVEY §5.3).  This module is the shared fault-tolerance
+substrate wrapped around ``kube.client.KubeClient`` and the
+custom-metrics client (docs/robustness.md):
+
+  * :class:`RetryPolicy` — per-verb deadlines and capped exponential
+    backoff with DETERMINISTIC jitter (seeded LCG over (seed, verb,
+    attempt) — reproducible in tests, no wall-clock randomness), honoring
+    a server-sent ``Retry-After`` on 429/503;
+  * :class:`CircuitBreaker` — per endpoint group (``kube`` vs
+    ``metrics``): closed → open after N consecutive transport failures →
+    one half-open probe after the reset timeout → closed again on probe
+    success.  While open, calls fail fast with :class:`CircuitOpenError`
+    instead of stacking doomed sockets behind a dead API server;
+  * :class:`FaultTolerantClient` — the wrapper: idempotent reads retry
+    freely under the policy; non-idempotent writes (bind, evict, patch,
+    update) are NEVER blind-retried — an ambiguous transport error on a
+    write is raised to the caller, which owns the decision (the GAS
+    annotate loop keeps exactly the reference's conflict-retry
+    semantics).  Watches pass through untouched — the informer owns
+    relist/backoff for streams.
+
+Metric families (declared in utils/trace.py, linted by trace-lint):
+``pas_kube_retry_total{verb,reason}``, ``pas_kube_giveup_total{verb}``,
+``pas_circuit_state{group}`` (0 closed / 1 half-open / 2 open),
+``pas_circuit_transitions_total{group,to}``.
+
+Everything takes an injectable ``clock``/``sleep`` so the chaos tests
+(tests/test_faults.py) run on a fake clock with zero real sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from platform_aware_scheduling_tpu.kube.client import (
+    ConflictError,
+    KubeError,
+    NotFoundError,
+)
+from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+# circuit states, also the pas_circuit_state gauge encoding (severity
+# order: 0 = healthy, 2 = failing fast)
+STATE_CLOSED = "closed"
+STATE_HALF_OPEN = "half-open"
+STATE_OPEN = "open"
+_STATE_GAUGE = {STATE_CLOSED: 0, STATE_HALF_OPEN: 1, STATE_OPEN: 2}
+
+#: endpoint groups: the kube API server proper vs the custom-metrics
+#: aggregated API (reference pkg/metrics/client.go) — they fail
+#: independently (a dead Prometheus adapter must not open the kube
+#: circuit and suspend bind/evict traffic, and vice versa)
+GROUP_KUBE = "kube"
+GROUP_METRICS = "metrics"
+
+#: idempotent read verbs: safe to retry any number of times
+READ_VERBS = frozenset(
+    {
+        "list_nodes",
+        "get_node",
+        "list_pods",
+        "get_pod",
+        "list_taspolicies",
+        "get_taspolicy",
+        "get_node_custom_metric",
+        "get_node_metric",
+    }
+)
+
+#: non-idempotent writes: at most ONE attempt here.  Conflict-retry
+#: semantics (refresh + re-apply on 409) belong to the callers that can
+#: re-read state — blind transport-level retry of a bind/evict that may
+#: have committed is how pods get double-evicted.
+WRITE_VERBS = frozenset(
+    {
+        "patch_node",
+        "update_pod",
+        "bind_pod",
+        "evict_pod",
+        "create_taspolicy",
+        "update_taspolicy",
+        "delete_taspolicy",
+    }
+)
+
+#: verb -> endpoint group (default kube)
+_VERB_GROUP = {
+    "get_node_custom_metric": GROUP_METRICS,
+    "get_node_metric": GROUP_METRICS,
+}
+
+
+def verb_group(verb: str) -> str:
+    return _VERB_GROUP.get(verb, GROUP_KUBE)
+
+
+class CircuitOpenError(KubeError):
+    """Fail-fast refusal while a circuit is open; carries the group so
+    degraded-mode consumers can attribute it."""
+
+    def __init__(self, group: str):
+        super().__init__(f"circuit open for {group} API group", status=0)
+        self.group = group
+
+
+def retry_reason(exc: BaseException) -> Optional[str]:
+    """The bounded retry-reason label when ``exc`` is retryable, else
+    None.  Server-responded client errors (404, 409, 4xx) are NOT
+    retryable — the API server answered; retrying cannot change a
+    deterministic answer."""
+    if isinstance(exc, CircuitOpenError):
+        return None  # the breaker already refused; retrying is pointless
+    if isinstance(exc, (NotFoundError, ConflictError)):
+        return None
+    status = getattr(exc, "status", None)
+    if isinstance(status, int) and status:
+        if status == 429:
+            return "throttled"
+        if status >= 500:
+            return "server_error"
+        return None
+    if isinstance(exc, KubeError):
+        return "network"  # status 0: URLError / transport-level failure
+    if isinstance(exc, (TimeoutError, OSError)):
+        return "network"
+    # the metrics client wraps transport trouble into MetricsError WITH
+    # a __cause__; classify that.  A cause-less MetricsError ("no metric
+    # X found", "no metrics returned") is the server ANSWERING that the
+    # data does not exist — deterministic, not retryable, and above all
+    # not a circuit failure (a healthy-but-empty metric must never open
+    # the metrics circuit and force degraded mode)
+    from platform_aware_scheduling_tpu.tas.metrics import MetricsError
+
+    if isinstance(exc, MetricsError):
+        cause = exc.__cause__
+        return retry_reason(cause) if cause is not None else None
+    return "api_error"
+
+
+def circuit_failure(exc: BaseException) -> bool:
+    """Whether ``exc`` counts against the breaker: transport-level and
+    5xx/429 failures do; a 404/409/4xx means the server is up."""
+    return retry_reason(exc) is not None
+
+
+def stable_hash(text: str) -> int:
+    """FNV-1a over the UTF-8 bytes: a process-independent string hash
+    (``hash()`` is salted per process, which would silently break the
+    'same seed, same schedule' contract)."""
+    h = 2166136261
+    for byte in text.encode("utf-8"):
+        h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
+def _deterministic_jitter(seed: int, n: int) -> float:
+    """A reproducible jitter factor in [0.5, 1.0): one LCG step over a
+    mixed (seed, n) — same inputs, same schedule, forever.  Wall-clock
+    randomness in backoff schedules makes chaos tests flaky by
+    construction; determinism here is a feature, not a shortcut."""
+    x = (seed * 2654435761 + n * 40503 + 12345) & 0x7FFFFFFF
+    x = (1103515245 * x + 12345) & 0x7FFFFFFF
+    return 0.5 + (x / float(0x80000000)) * 0.5
+
+
+def backoff_delay(
+    attempt: int,
+    base_delay_s: float,
+    max_delay_s: float,
+    seed: int = 0,
+) -> float:
+    """Capped exponential backoff with deterministic jitter for the
+    ``attempt``-th consecutive failure (1-based)."""
+    n = max(1, int(attempt))
+    raw = min(float(max_delay_s), float(base_delay_s) * (2.0 ** (n - 1)))
+    return raw * _deterministic_jitter(seed, n)
+
+
+@dataclass
+class RetryPolicy:
+    """How many times, how long apart, and for how long in total a verb
+    may be retried.  ``verb_deadlines`` overrides the shared deadline for
+    specific verbs (a watch re-establishment can afford more patience
+    than a request on the serving path)."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.1
+    max_delay_s: float = 5.0
+    deadline_s: float = 30.0
+    seed: int = 0
+    verb_deadlines: Dict[str, float] = field(default_factory=dict)
+
+    def deadline_for(self, verb: str) -> float:
+        return self.verb_deadlines.get(verb, self.deadline_s)
+
+    def backoff(
+        self,
+        attempt: int,
+        verb: str = "",
+        retry_after_s: Optional[float] = None,
+    ) -> float:
+        """Delay before the next try after ``attempt`` failures.  A
+        server-sent ``Retry-After`` (429/503) is honored as a FLOOR —
+        the server knows its own load better than our schedule does."""
+        delay = backoff_delay(
+            attempt,
+            self.base_delay_s,
+            self.max_delay_s,
+            seed=self.seed ^ stable_hash(verb),
+        )
+        if retry_after_s is not None and retry_after_s > 0:
+            delay = max(delay, float(retry_after_s))
+        return delay
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker for one endpoint group.
+
+    closed: all calls pass; N consecutive failures trip it open.
+    open: calls refused (CircuitOpenError) until ``reset_timeout_s``
+    elapses, then ONE half-open probe is let through.
+    half-open: probe success closes the circuit; probe failure re-opens
+    it (and re-arms the timer).
+    """
+
+    def __init__(
+        self,
+        group: str,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        counters: Optional[CounterSet] = None,
+    ):
+        self.group = group
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self.counters = counters if counters is not None else trace.COUNTERS
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_in_flight = False
+        self._publish(STATE_CLOSED, transition=False)
+
+    # -- state plumbing --------------------------------------------------------
+
+    def _publish(self, state: str, transition: bool = True) -> None:
+        self.counters.set_gauge(
+            "pas_circuit_state",
+            _STATE_GAUGE[state],
+            labels={"group": self.group},
+        )
+        if transition:
+            self.counters.inc(
+                "pas_circuit_transitions_total",
+                labels={"group": self.group, "to": state},
+            )
+
+    def _set_state(self, state: str) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        klog.v(2).info_s(
+            f"circuit {self.group}: -> {state}", component="retry"
+        )
+        self._publish(state)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (
+            self._state == STATE_OPEN
+            and self._opened_at is not None
+            and self._clock() - self._opened_at >= self.reset_timeout_s
+        ):
+            self._set_state(STATE_HALF_OPEN)
+            self._probe_in_flight = False
+
+    # -- the contract ----------------------------------------------------------
+
+    def allow(self) -> bool:
+        """Whether a call may proceed right now; half-open admits exactly
+        one in-flight probe."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == STATE_CLOSED:
+                return True
+            if self._state == STATE_HALF_OPEN and not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_in_flight = False
+            if self._state != STATE_CLOSED:
+                self._set_state(STATE_CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probe_in_flight = False
+            if self._state == STATE_HALF_OPEN:
+                # the probe failed: straight back to open, timer re-armed
+                self._opened_at = self._clock()
+                self._set_state(STATE_OPEN)
+                return
+            self._failures += 1
+            if (
+                self._state == STATE_CLOSED
+                and self._failures >= self.failure_threshold
+            ):
+                self._opened_at = self._clock()
+                self._set_state(STATE_OPEN)
+
+
+class CircuitBreakerRegistry:
+    """The per-process breaker set, one per endpoint group, shared by
+    every wrapped client and read by the DegradedModeController."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        counters: Optional[CounterSet] = None,
+    ):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._counters = counters
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def breaker(self, group: str) -> CircuitBreaker:
+        with self._lock:
+            if group not in self._breakers:
+                self._breakers[group] = CircuitBreaker(
+                    group,
+                    failure_threshold=self.failure_threshold,
+                    reset_timeout_s=self.reset_timeout_s,
+                    clock=self._clock,
+                    counters=self._counters,
+                )
+            return self._breakers[group]
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return {b.group: b.state for b in breakers}
+
+    def open_groups(self) -> List[str]:
+        """Groups currently refusing calls (open or probing half-open) —
+        the degraded-mode input."""
+        return sorted(
+            group
+            for group, state in self.states().items()
+            if state != STATE_CLOSED
+        )
+
+
+class FaultTolerantClient:
+    """Retry/backoff/circuit-breaking proxy over any client exposing the
+    ``KubeClient`` (or metrics ``Client``) method surface — including the
+    test fakes, whose seeding helpers pass straight through.
+
+    Reads retry under the policy; writes get one attempt behind the
+    breaker; unknown attributes (seeding helpers, watches, config)
+    delegate untouched."""
+
+    def __init__(
+        self,
+        inner,
+        policy: Optional[RetryPolicy] = None,
+        breakers: Optional[CircuitBreakerRegistry] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        counters: Optional[CounterSet] = None,
+    ):
+        self._inner = inner
+        self.policy = policy if policy is not None else RetryPolicy()
+        self.breakers = (
+            breakers if breakers is not None else CircuitBreakerRegistry()
+        )
+        self._clock = clock
+        self._sleep = sleep
+        self.counters = counters if counters is not None else trace.COUNTERS
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in READ_VERBS:
+            return self._wrap_read(name, attr)
+        if name in WRITE_VERBS:
+            return self._wrap_write(name, attr)
+        return attr
+
+    # -- reads: retry freely ---------------------------------------------------
+
+    def _wrap_read(self, verb: str, fn):
+        def call(*args, **kwargs):
+            breaker = self.breakers.breaker(verb_group(verb))
+            deadline = self._clock() + self.policy.deadline_for(verb)
+            attempt = 0
+            last_exc: Optional[BaseException] = None
+            while attempt < self.policy.max_attempts:
+                attempt += 1
+                if not breaker.allow():
+                    raise CircuitOpenError(breaker.group)
+                try:
+                    result = fn(*args, **kwargs)
+                except Exception as exc:
+                    reason = retry_reason(exc)
+                    if reason is None:
+                        # deterministic answer (404, 409, 4xx): the
+                        # server is up — not a circuit event, not
+                        # retryable
+                        breaker.record_success()
+                        raise
+                    breaker.record_failure()
+                    last_exc = exc
+                    if attempt >= self.policy.max_attempts:
+                        break
+                    delay = self.policy.backoff(
+                        attempt,
+                        verb=verb,
+                        retry_after_s=getattr(exc, "retry_after", None),
+                    )
+                    if self._clock() + delay > deadline:
+                        break  # the deadline would expire mid-sleep
+                    self.counters.inc(
+                        "pas_kube_retry_total",
+                        labels={"verb": verb, "reason": reason},
+                    )
+                    klog.v(4).info_s(
+                        f"{verb} failed ({reason}), retry "
+                        f"{attempt}/{self.policy.max_attempts} in "
+                        f"{delay:.3f}s: {exc}",
+                        component="retry",
+                    )
+                    self._sleep(delay)
+                    continue
+                breaker.record_success()
+                return result
+            self.counters.inc(
+                "pas_kube_giveup_total", labels={"verb": verb}
+            )
+            assert last_exc is not None
+            raise last_exc
+
+        call.__name__ = verb
+        return call
+
+    # -- writes: one attempt, breaker-gated ------------------------------------
+
+    def _wrap_write(self, verb: str, fn):
+        def call(*args, **kwargs):
+            breaker = self.breakers.breaker(verb_group(verb))
+            if not breaker.allow():
+                raise CircuitOpenError(breaker.group)
+            try:
+                result = fn(*args, **kwargs)
+            except Exception as exc:
+                if circuit_failure(exc):
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+                raise
+            breaker.record_success()
+            return result
+
+        call.__name__ = verb
+        return call

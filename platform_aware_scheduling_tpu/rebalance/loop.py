@@ -95,6 +95,13 @@ class Rebalancer:
         self._lock = threading.Lock()
         self._cycles = 0
         self._last_plan: Optional[Dict] = None
+        # optional tas.degraded.DegradedModeController: while evictions
+        # are suspended (stale telemetry / open kube circuit) the cycle
+        # does NOTHING — no drift accounting, no planning, no actuation.
+        # Defense in depth on top of the deschedule-side gate: this loop
+        # must hold the zero-evictions invariant even when driven
+        # directly (docs/robustness.md)
+        self.degraded = None
         # convergence episode tracking: first violating cycle after a
         # clean one opens an episode; the next clean cycle closes it and
         # publishes its length
@@ -122,6 +129,27 @@ class Rebalancer:
     def cycle(self, violations: Dict[str, List[str]]) -> Dict:
         """One rebalance cycle over this enforcement pass's violation
         map; returns (and stores for /debug/rebalance) the plan record."""
+        if self.degraded is not None:
+            allowed, reason = self.degraded.evictions_allowed()
+            if not allowed:
+                # freeze: streaks neither grow (stale violations are not
+                # evidence) nor reset (the hot node may still be hot);
+                # the suspension is visible on /debug/rebalance
+                record = {
+                    "mode": self.mode,
+                    "suspended": reason,
+                    "violating_nodes": sorted(violations),
+                    "moves": [],
+                    "executed": [],
+                    "skipped": {},
+                }
+                with self._lock:
+                    self._last_plan = record
+                klog.v(2).info_s(
+                    f"rebalance cycle suspended: {reason}",
+                    component="rebalance",
+                )
+                return record
         with self._lock:
             self._cycles += 1
             cycle_no = self._cycles
@@ -235,8 +263,16 @@ class Rebalancer:
             cycles = self._cycles
             episode_start = self._episode_start
             last_convergence = self._last_convergence
+        degraded_status = (
+            self.degraded.status() if self.degraded is not None else None
+        )
         return {
             "mode": self.mode,
+            "degraded": degraded_status,
+            "evictions_suspended": bool(
+                degraded_status
+                and not degraded_status["evictions"]["allowed"]
+            ),
             "solver": self.replanner.solver,
             "hysteresis_cycles": self.drift.k,
             "max_moves_per_cycle": self.replanner.max_moves,

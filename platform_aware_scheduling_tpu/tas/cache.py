@@ -35,6 +35,42 @@ class CacheMissError(KeyError):
     pass
 
 
+def _refresh_error_reason(exc: BaseException) -> str:
+    """Bounded ``reason`` label for pas_telemetry_refresh_errors_total:
+    circuit_open / throttled / server_error / network / no_data /
+    fetch_error — never a raw message (unbounded label values are a
+    cardinality leak).  Walks the ``__cause__`` chain first: the
+    production metrics client (tas/metrics.CustomMetricsClient) wraps
+    every failure in a bare MetricsError whose CAUSE carries the real
+    KubeError/CircuitOpenError — classifying only the wrapper would
+    collapse the whole taxonomy to fetch_error."""
+    seen = 0
+    while exc.__cause__ is not None and seen < 8:
+        exc = exc.__cause__
+        seen += 1
+    # local import: kube.retry pulls in kube.client; keep the cache
+    # importable in metric-only unit tests that stub the kube layer
+    try:
+        from platform_aware_scheduling_tpu.kube.retry import CircuitOpenError
+
+        if isinstance(exc, CircuitOpenError):
+            return "circuit_open"
+    except Exception:
+        pass
+    status = getattr(exc, "status", None)
+    if isinstance(status, int) and status:
+        if status == 429:
+            return "throttled"
+        if status >= 500:
+            return "server_error"
+        return "fetch_error"
+    if isinstance(exc, (TimeoutError, OSError)):
+        return "network"
+    if "no metric" in str(exc) or "no metrics returned" in str(exc):
+        return "no_data"
+    return "fetch_error"
+
+
 class _SerializedStore:
     """Serialized KV with the reference's write-nil-preserves rule."""
 
@@ -60,10 +96,18 @@ class _SerializedStore:
 class AutoUpdatingCache:
     """Reader/Writer/SelfUpdating cache (reference pkg/cache/types.go)."""
 
-    def __init__(self, counters: Optional[CounterSet] = None):
+    def __init__(
+        self,
+        counters: Optional[CounterSet] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self._store = _SerializedStore()
         self._metric_refcounts: Dict[str, int] = {}
         self._mtx = threading.Lock()
+        # injectable monotonic clock: freshness/aging decisions gate real
+        # evictions (docs/robustness.md), so the chaos tests drive them
+        # from a fake clock instead of sleeping
+        self._clock = clock
         # telemetry-freshness bookkeeping (docs/observability.md): when
         # each metric last carried data, when the last refresh pass
         # completed, and the configured refresh period — the inputs to
@@ -125,7 +169,7 @@ class AutoUpdatingCache:
                 # a data-bearing write IS a refresh — the freshness clock
                 # this metric is judged by (telemetry_freshness)
                 with self._mtx:
-                    self._last_refresh[metric_name] = time.monotonic()
+                    self._last_refresh[metric_name] = self._clock()
             for hook in self.on_metric_write:
                 hook(metric_name, payload)
 
@@ -175,7 +219,7 @@ class AutoUpdatingCache:
     def update_all_metrics(self, client: Client) -> None:
         with self._mtx:
             names = list(self._metric_refcounts)
-        errors = 0
+        errors: Dict[str, int] = {}  # reason -> count
         for name in names:
             if not name:
                 with self._mtx:
@@ -184,12 +228,17 @@ class AutoUpdatingCache:
             try:
                 self._update_metric(client, name)
             except Exception as exc:
-                errors += 1
+                # a failed refresh preserves the prior NodeMetricsInfo
+                # (the store's write-nil rule — last-known-good) while
+                # the metric keeps AGING (_last_refresh untouched), so
+                # freshness decay stays visible
+                reason = _refresh_error_reason(exc)
+                errors[reason] = errors.get(reason, 0) + 1
                 klog.v(2).info_s(str(exc), component="controller")
         # pass accounting: refresh counters + per-metric age gauges (a
         # metric whose fetch keeps failing shows a GROWING age while the
         # loop itself keeps ticking — the two failure modes separate)
-        now = time.monotonic()
+        now = self._clock()
         with self._mtx:
             self._last_pass = now
             ages = {
@@ -199,8 +248,12 @@ class AutoUpdatingCache:
             }
         self._synced_once.set()
         self.counters.inc("pas_telemetry_refresh_total")
-        if errors:
-            self.counters.inc("pas_telemetry_refresh_errors_total", errors)
+        for reason, count in errors.items():
+            self.counters.inc(
+                "pas_telemetry_refresh_errors_total",
+                count,
+                labels={"reason": reason},
+            )
         for name, age in ages.items():
             self.counters.set_gauge(
                 "pas_telemetry_metric_age_seconds",
@@ -211,7 +264,7 @@ class AutoUpdatingCache:
     def metric_ages(self) -> Dict[str, Optional[float]]:
         """Registered metric -> seconds since its last data-bearing write
         (None = never refreshed)."""
-        now = time.monotonic()
+        now = self._clock()
         with self._mtx:
             return {
                 name: (
@@ -235,12 +288,8 @@ class AutoUpdatingCache:
             return True, "static cache (no refresh loop configured)"
         if not self._synced_once.is_set():
             return False, "telemetry cache has not completed a refresh pass"
-        bound = (
-            self.freshness_max_age_s
-            if self.freshness_max_age_s is not None
-            else max(3.0 * period, 1.0)
-        )
-        now = time.monotonic()
+        bound = self.freshness_bound()
+        now = self._clock()
         with self._mtx:
             last_pass = self._last_pass
             stale = sorted(
@@ -264,6 +313,18 @@ class AutoUpdatingCache:
                 f"metrics stale past {bound:.1f}s: {stale[:5]}"
             )
         return True, f"{registered} metrics fresh within {bound:.1f}s"
+
+    def freshness_bound(self) -> Optional[float]:
+        """The staleness bound in seconds (``freshness_max_age_s`` or 3x
+        the refresh period); None for a static cache.  Degraded-mode
+        consumers derive their last-known-good window from this
+        (tas/degraded.py)."""
+        period = self._refresh_period
+        if period is None:
+            return None
+        if self.freshness_max_age_s is not None:
+            return self.freshness_max_age_s
+        return max(3.0 * period, 1.0)
 
     def _update_metric(self, client: Client, metric_name: str) -> None:
         info = client.get_node_metric(metric_name)

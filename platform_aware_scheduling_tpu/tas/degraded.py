@@ -1,0 +1,267 @@
+"""Degraded-mode policy: what every telemetry consumer does when the
+remote dependencies misbehave (docs/robustness.md).
+
+PR 3 made telemetry staleness *visible* (``AutoUpdatingCache.
+telemetry_freshness``) and PR 4 added an actuator that *evicts real
+pods*; this controller is the strategy between them.  It consumes the
+freshness signal and the circuit-breaker states (kube/retry.py) and
+answers three questions, one per consumer:
+
+  * ``filter_decision`` — dontschedule/Filter: ``--degradedMode``
+    decides between ``fail_open`` (stop filtering: every candidate
+    passes — capacity over precision), ``fail_closed`` (every candidate
+    fails — precision over capacity), and ``last-known-good`` (keep
+    serving the cache's retained values while their age stays within a
+    bounded multiple of the freshness bound, then fail open);
+  * ``prioritize_decision`` — scheduleonmetric is NOT flag-driven: it
+    serves last-known-good scores within the bounded age and degrades to
+    NEUTRAL priorities (every node scored equally) past it.  A stale
+    ranking mis-orders placements; a neutral one just stops helping;
+  * ``evictions_allowed`` — the HARD invariant, not configurable: the
+    deschedule labeler and the PR 4 rebalancer suspend ALL evictions
+    whenever telemetry is degraded or the kube circuit is not closed.
+    Eviction is the one action that destroys work; it never runs on
+    data we cannot trust or against an API server we cannot see.
+
+Degraded state surfaces three ways: the ``pas_degraded{subsystem}``
+gauge family, a named ``/readyz`` condition (the service keeps serving,
+but reports why it is not fully ready), and the rebalance status JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from platform_aware_scheduling_tpu.kube.retry import (
+    GROUP_KUBE,
+    GROUP_METRICS,
+    STATE_CLOSED,
+)
+from platform_aware_scheduling_tpu.utils import trace
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+MODE_FAIL_OPEN = "fail-open"
+MODE_FAIL_CLOSED = "fail-closed"
+MODE_LAST_KNOWN_GOOD = "last-known-good"
+MODES = (MODE_FAIL_OPEN, MODE_FAIL_CLOSED, MODE_LAST_KNOWN_GOOD)
+
+#: last-known-good values stay servable this many freshness bounds past
+#: freshness loss (with the default 3x-period bound: 3x3 = 9 periods)
+DEFAULT_LKG_BOUND_MULTIPLE = 3.0
+
+ACTION_NORMAL = "normal"
+ACTION_LAST_KNOWN_GOOD = "last_known_good"
+ACTION_NEUTRAL = "neutral"
+ACTION_FAIL_OPEN = "fail_open"
+ACTION_FAIL_CLOSED = "fail_closed"
+
+
+class DegradedModeController:
+    """One per assembled service; attached to the extender (verbs), the
+    enforcer (deschedule labeling), and the rebalancer (actuation)."""
+
+    def __init__(
+        self,
+        cache=None,
+        breakers=None,
+        mode: str = MODE_LAST_KNOWN_GOOD,
+        lkg_max_age_s: Optional[float] = None,
+        counters: Optional[CounterSet] = None,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"unknown degraded mode {mode!r}")
+        self.cache = cache
+        self.breakers = breakers  # CircuitBreakerRegistry or None
+        self.mode = mode
+        #: explicit last-known-good age bound; None derives it from the
+        #: cache's freshness bound x DEFAULT_LKG_BOUND_MULTIPLE
+        self.lkg_max_age_s = lkg_max_age_s
+        self.counters = counters if counters is not None else trace.COUNTERS
+        self._lock = threading.Lock()
+
+    # -- inputs ----------------------------------------------------------------
+
+    def _circuit_state(self, group: str) -> str:
+        if self.breakers is None:
+            return STATE_CLOSED
+        return self.breakers.states().get(group, STATE_CLOSED)
+
+    def telemetry_status(self) -> Tuple[bool, str]:
+        """(healthy, reason): telemetry counts as degraded when the cache
+        reports staleness OR the metrics-API circuit is not closed (an
+        open metrics circuit means refreshes are being refused — the
+        values WILL go stale; act before they mislead)."""
+        if self.cache is not None:
+            fresh, reason = self.cache.telemetry_freshness()
+            if not fresh:
+                return False, f"telemetry stale: {reason}"
+        state = self._circuit_state(GROUP_METRICS)
+        if state != STATE_CLOSED:
+            return False, f"metrics-API circuit {state}"
+        return True, "telemetry fresh"
+
+    def kube_status(self) -> Tuple[bool, str]:
+        state = self._circuit_state(GROUP_KUBE)
+        if state != STATE_CLOSED:
+            return False, f"kube-API circuit {state}"
+        return True, "kube API reachable"
+
+    def _lkg_bound(self) -> Optional[float]:
+        if self.lkg_max_age_s is not None:
+            return self.lkg_max_age_s
+        bound = None
+        if self.cache is not None:
+            bound = self.cache.freshness_bound()
+        if bound is None:
+            return None
+        return bound * DEFAULT_LKG_BOUND_MULTIPLE
+
+    def _within_lkg_bound(self) -> bool:
+        """Every registered metric still has retained data younger than
+        the last-known-good bound."""
+        if self.cache is None:
+            return False
+        bound = self._lkg_bound()
+        if bound is None:
+            return False
+        ages = self.cache.metric_ages()
+        if not ages:
+            return False
+        return all(age is not None and age <= bound for age in ages.values())
+
+    # -- the three consumer answers --------------------------------------------
+
+    def filter_decision(self) -> Tuple[str, str]:
+        """dontschedule/Filter behavior right now: ``normal`` when
+        telemetry is healthy, else per ``--degradedMode``."""
+        ok, reason = self.telemetry_status()
+        if ok:
+            self._publish(telemetry=False)
+            return ACTION_NORMAL, reason
+        self._publish(telemetry=True)
+        if self.mode == MODE_FAIL_CLOSED:
+            return ACTION_FAIL_CLOSED, reason
+        if self.mode == MODE_LAST_KNOWN_GOOD and self._within_lkg_bound():
+            return ACTION_LAST_KNOWN_GOOD, reason
+        return ACTION_FAIL_OPEN, reason
+
+    def prioritize_decision(self) -> Tuple[str, str]:
+        """scheduleonmetric behavior right now (mode-independent):
+        last-known-good scores within the bounded age, neutral past it."""
+        ok, reason = self.telemetry_status()
+        if ok:
+            self._publish(telemetry=False)
+            return ACTION_NORMAL, reason
+        self._publish(telemetry=True)
+        if self._within_lkg_bound():
+            return ACTION_LAST_KNOWN_GOOD, reason
+        return ACTION_NEUTRAL, reason
+
+    def evictions_allowed(self) -> Tuple[bool, str]:
+        """The hard invariant: no eviction while telemetry is degraded
+        or the kube circuit is not closed.  Not configurable."""
+        telemetry_ok, telemetry_reason = self.telemetry_status()
+        kube_ok, kube_reason = self.kube_status()
+        allowed = telemetry_ok and kube_ok
+        reasons = [
+            r
+            for ok, r in (
+                (telemetry_ok, telemetry_reason),
+                (kube_ok, kube_reason),
+            )
+            if not ok
+        ]
+        self._publish(
+            telemetry=not telemetry_ok,
+            kube=not kube_ok,
+            evictions=not allowed,
+        )
+        if allowed:
+            return True, "telemetry fresh, kube circuit closed"
+        return False, "evictions suspended: " + "; ".join(reasons)
+
+    # -- surfaces --------------------------------------------------------------
+
+    def degraded_subsystems(self) -> List[str]:
+        out = []
+        if not self.telemetry_status()[0]:
+            out.append("telemetry")
+        if self._circuit_state(GROUP_METRICS) != STATE_CLOSED:
+            out.append("metrics_api")
+        if not self.kube_status()[0]:
+            out.append("kube_api")
+        if not self.evictions_allowed()[0]:
+            out.append("evictions")
+        return out
+
+    def readiness_condition(self) -> Tuple[bool, str]:
+        """The /readyz "degraded_mode" condition: the process keeps
+        serving while degraded, but /readyz reports WHY it is not fully
+        ready so rollouts and dashboards see the outage."""
+        telemetry_ok, telemetry_reason = self.telemetry_status()
+        kube_ok, kube_reason = self.kube_status()
+        if telemetry_ok and kube_ok:
+            return True, f"not degraded (mode {self.mode})"
+        reasons = [
+            r
+            for ok, r in (
+                (telemetry_ok, telemetry_reason),
+                (kube_ok, kube_reason),
+            )
+            if not ok
+        ]
+        filter_action, _ = self.filter_decision()
+        prioritize_action, _ = self.prioritize_decision()
+        return False, (
+            f"degraded ({'; '.join(reasons)}); filter={filter_action}, "
+            f"prioritize={prioritize_action}, evictions=suspended"
+        )
+
+    def status(self) -> Dict:
+        """The JSON block for /debug surfaces (rebalance status, tests)."""
+        telemetry_ok, telemetry_reason = self.telemetry_status()
+        kube_ok, kube_reason = self.kube_status()
+        evictions_ok, evictions_reason = self.evictions_allowed()
+        filter_action, _ = self.filter_decision()
+        prioritize_action, _ = self.prioritize_decision()
+        return {
+            "mode": self.mode,
+            "degraded": sorted(self.degraded_subsystems()),
+            "telemetry": {"ok": telemetry_ok, "reason": telemetry_reason},
+            "kube_api": {"ok": kube_ok, "reason": kube_reason},
+            "evictions": {
+                "allowed": evictions_ok,
+                "reason": evictions_reason,
+            },
+            "filter_action": filter_action,
+            "prioritize_action": prioritize_action,
+            "circuits": dict(self.breakers.states()) if self.breakers else {},
+        }
+
+    def _publish(
+        self,
+        telemetry: Optional[bool] = None,
+        kube: Optional[bool] = None,
+        evictions: Optional[bool] = None,
+    ) -> None:
+        """Keep the pas_degraded{subsystem} gauges current; each decision
+        call refreshes the subsystems it actually evaluated."""
+        updates = {
+            "telemetry": telemetry,
+            "kube_api": kube,
+            "evictions": evictions,
+        }
+        for subsystem, value in updates.items():
+            if value is None:
+                continue
+            self.counters.set_gauge(
+                "pas_degraded", 1 if value else 0,
+                labels={"subsystem": subsystem},
+            )
+        if telemetry is not None:
+            self.counters.set_gauge(
+                "pas_degraded",
+                1 if self._circuit_state(GROUP_METRICS) != STATE_CLOSED else 0,
+                labels={"subsystem": "metrics_api"},
+            )

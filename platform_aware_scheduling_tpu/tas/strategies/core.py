@@ -114,6 +114,12 @@ class MetricEnforcer:
         # at the end of every enforcement pass (including empty ones) —
         # the rebalance loop's drift detector feeds off this
         self.violation_observers: List = []
+        # optional tas.degraded.DegradedModeController: while it reports
+        # evictions suspended (stale telemetry / open kube circuit), the
+        # deschedule strategy skips its label pass — no new eviction
+        # pressure (in-tree or external) is created from data we cannot
+        # trust (docs/robustness.md, hard invariant)
+        self.degraded = None
         self._lock = threading.RLock()
 
     def publish_violations(

@@ -124,7 +124,45 @@ class Strategy:
 
     def enforce(self, enforcer: core.MetricEnforcer, cache) -> int:
         """List all nodes, compute per-policy violations, patch labels
-        (enforce.go:57-71)."""
+        (enforce.go:57-71).
+
+        Hard invariant (docs/robustness.md): while the degraded-mode
+        controller reports evictions suspended — telemetry stale or the
+        kube circuit open — the LABEL pass is skipped.  Violations
+        computed from untrustworthy data must not become ``=violating``
+        labels (the eviction trigger external deschedulers act on).  The
+        stale violation map is still published so the rebalancer can
+        record the suspension on /debug/rebalance — its own gate
+        guarantees it neither plans, actuates, nor advances drift
+        streaks from it."""
+        degraded = getattr(enforcer, "degraded", None)
+        if degraded is not None:
+            allowed, reason = degraded.evictions_allowed()
+            if not allowed:
+                klog.v(2).info_s(
+                    f"deschedule enforcement suspended: {reason}",
+                    component="controller",
+                )
+                # liveness: with the label pass skipped, NOTHING else in
+                # this process may be calling the kube group — and a
+                # breaker can only leave half-open through a probe CALL.
+                # This read is that probe: refused instantly while the
+                # circuit is open, it becomes the half-open probe once
+                # the reset timeout elapses, closing the circuit (and
+                # ending the suspension) as soon as the API server is
+                # really back
+                try:
+                    enforcer.kube_client.list_nodes()
+                except Exception as probe_exc:
+                    klog.v(4).info_s(
+                        f"suspended-cycle kube probe: {probe_exc}",
+                        component="controller",
+                    )
+                enforcer.publish_violations(
+                    STRATEGY_TYPE,
+                    self._node_status_for_strategy(enforcer, cache),
+                )
+                return 0
         try:
             nodes = enforcer.kube_client.list_nodes()
         except Exception as exc:

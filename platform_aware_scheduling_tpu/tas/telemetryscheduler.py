@@ -54,6 +54,7 @@ from platform_aware_scheduling_tpu.ops.state import (
     TensorStateMirror,
 )
 from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
+from platform_aware_scheduling_tpu.tas import degraded as degraded_mode
 from platform_aware_scheduling_tpu.native import get_wirec
 from platform_aware_scheduling_tpu.tas.fastpath import PrioritizeFastPath
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
@@ -97,6 +98,12 @@ class MetricsExtender:
         # --rebalance != off; the front-ends serve its last plan on
         # GET /debug/rebalance (404 while this is None)
         self.rebalancer = None
+        # opt-in tas.degraded.DegradedModeController, set by assembly:
+        # when telemetry goes stale or a circuit opens, Filter fails
+        # open/closed per --degradedMode and Prioritize degrades to
+        # last-known-good then neutral scores (docs/robustness.md).
+        # None (the default) keeps exact reference behavior.
+        self.degraded = None
         # request-independent ranking/violation caches + byte-fragment
         # encoder (tas/fastpath.py) — the per-request device dispatch and
         # per-node Python objects the round-1 verdict flagged are gone
@@ -150,10 +157,18 @@ class MetricsExtender:
         warmed (device fastpath precomputed at least once) and telemetry
         freshness (cache synced + every registered metric's age within
         bound).  The front-end layers queue headroom on top."""
-        return [
+        conditions = [
             ("kernels_warmed", self._warm_status),
             ("telemetry_fresh", self.cache.telemetry_freshness),
         ]
+        if self.degraded is not None:
+            # degraded state surfaces on /readyz with its reason — the
+            # service keeps serving (degraded), but rollouts see why it
+            # is not fully ready (docs/robustness.md)
+            conditions.append(
+                ("degraded_mode", self.degraded.readiness_condition)
+            )
+        return conditions
 
     def _warm_status(self):
         if self.fastpath is None:
@@ -246,6 +261,18 @@ class MetricsExtender:
         span = trace.of(request)
         span.set("verb", "prioritize")
         try:
+            if self.degraded is not None:
+                action, reason = self.degraded.prioritize_decision()
+                if action == degraded_mode.ACTION_NEUTRAL:
+                    # telemetry too stale even for last-known-good:
+                    # neutral priorities (every candidate scored equally)
+                    # keep the scheduler unblocked without letting a
+                    # stale ranking mis-order placements
+                    span.set("degraded", reason)
+                    span.set("path", "neutral")
+                    return self._neutral_prioritize(request, span)
+                if action == degraded_mode.ACTION_LAST_KNOWN_GOOD:
+                    span.set("degraded", reason)  # serving retained scores
             # the native path attributes itself (native vs native_host —
             # partition counters, see trace.py declarations)
             response = self._prioritize_native(request)
@@ -254,25 +281,50 @@ class MetricsExtender:
             trace.COUNTERS.inc("pas_prioritize_exact_total")
             span.set("path", "exact")
             klog.v(2).info_s("Received prioritize request", component="extender")
-            with span.stage("decode"):
-                args = self._decode(request)
-            if args is None:
-                return HTTPResponse()
-            names = self._candidate_names(args)
-            if not names:
-                klog.v(2).info_s(
-                    "bad extender arguments. No nodes in list", component="extender"
-                )
-                return HTTPResponse()
-            status = 200
-            if TAS_POLICY_LABEL not in args.pod.get_labels():
-                klog.v(2).info_s("no policy associated with pod", component="extender")
-                status = 400  # and still prioritize (telemetryscheduler.go:50-54)
+            decoded = self._decode_prioritize_args(request, span)
+            if isinstance(decoded, HTTPResponse):
+                return decoded
+            args, names, status = decoded
             return HTTPResponse.json(
                 self._prioritize_body(args, names, span=span), status=status
             )
         finally:
             self.recorder.observe("prioritize", time.perf_counter() - start)
+
+    def _decode_prioritize_args(self, request: HTTPRequest, span):
+        """The exact path's decode quirks, shared with the degraded
+        neutral path so they can never drift: decode failure / empty
+        candidate list -> empty 200; missing policy label -> 400 but the
+        verb still answers (telemetryscheduler.go:41-54).  Returns
+        ``(args, names, status)`` or the quirk HTTPResponse."""
+        with span.stage("decode"):
+            args = self._decode(request)
+        if args is None:
+            return HTTPResponse()
+        names = self._candidate_names(args)
+        if not names:
+            klog.v(2).info_s(
+                "bad extender arguments. No nodes in list", component="extender"
+            )
+            return HTTPResponse()
+        status = 200
+        if TAS_POLICY_LABEL not in args.pod.get_labels():
+            klog.v(2).info_s("no policy associated with pod", component="extender")
+            status = 400  # and still prioritize (telemetryscheduler.go:50-54)
+        return args, names, status
+
+    def _neutral_prioritize(self, request: HTTPRequest, span) -> HTTPResponse:
+        """Degraded Prioritize: every candidate gets the same score, on
+        top of the exact path's shared decode quirks."""
+        decoded = self._decode_prioritize_args(request, span)
+        if isinstance(decoded, HTTPResponse):
+            return decoded
+        _args, names, status = decoded
+        with span.stage("encode"):
+            body = encode_host_priority_list(
+                [HostPriority(host=name, score=0) for name in names]
+            )
+        return HTTPResponse.json(body, status=status)
 
     def filter(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()
@@ -280,8 +332,22 @@ class MetricsExtender:
         span.set("verb", "filter")
         try:
             klog.v(2).info_s("Filter request received", component="extender")
-            with span.stage("cache_probe"):
-                probe = self._filter_cache_probe(request)
+            degraded_action = None
+            if self.degraded is not None:
+                action, reason = self.degraded.filter_decision()
+                if action in (
+                    degraded_mode.ACTION_FAIL_OPEN,
+                    degraded_mode.ACTION_FAIL_CLOSED,
+                ):
+                    # fail open/closed per --degradedMode; the response
+                    # cache must not serve (its entries were keyed on
+                    # healthy state), so the probe is skipped -> bypass
+                    degraded_action = action
+                    span.set("degraded", reason)
+            probe = None
+            if degraded_action is None:
+                with span.stage("cache_probe"):
+                    probe = self._filter_cache_probe(request)
             # hit/miss attribution happens inside the probe, at its
             # non-None return sites only (it alone can tell a true
             # span-cache hit from the native encode that merely SEEDS the
@@ -297,7 +363,7 @@ class MetricsExtender:
             if args is None:
                 return HTTPResponse()
             with span.stage("kernel"):
-                result = self._filter_nodes(args)
+                result = self._filter_nodes(args, degraded=degraded_action)
             if result is None:
                 klog.v(2).info_s("No filtered nodes returned", component="extender")
                 return HTTPResponse.json(b"null\n", status=404)
@@ -607,8 +673,13 @@ class MetricsExtender:
 
     # -- filter logic ----------------------------------------------------------
 
-    def _filter_nodes(self, args: Args) -> Optional[FilterResult]:
-        """filterNodes (telemetryscheduler.go:184-225)."""
+    def _filter_nodes(
+        self, args: Args, degraded: Optional[str] = None
+    ) -> Optional[FilterResult]:
+        """filterNodes (telemetryscheduler.go:184-225).  ``degraded``
+        overrides ONLY the telemetry-dependent violation set: fail_open
+        -> no node violates, fail_closed -> every candidate violates;
+        policy resolution (informer-fed, not telemetry) stays exact."""
         try:
             policy = self._policy_from_pod(args.pod)
         except Exception as exc:
@@ -623,7 +694,17 @@ class MetricsExtender:
                 component="extender",
             )
             return None
-        violating = self._violating_nodes(policy, strategy)
+        if degraded == degraded_mode.ACTION_FAIL_OPEN:
+            violating: Dict[str, None] = {}
+        elif degraded == degraded_mode.ACTION_FAIL_CLOSED:
+            names = (
+                [node.name for node in args.nodes]
+                if args.nodes
+                else list(args.node_names or [])
+            )
+            violating = {name: None for name in names}
+        else:
+            violating = self._violating_nodes(policy, strategy)
         if not args.nodes:
             if self.node_cache_capable and args.node_names:
                 return self._filter_node_names(policy, args.node_names, violating)

@@ -99,6 +99,16 @@ class FakeKubeClient:
         self.fail_next_bind: Optional[Exception] = None
         self.fail_metric_fetch: Optional[Exception] = None
         self.fail_next_evict: Optional[Exception] = None
+        # scripted deterministic faults (testing/faults.py): when a
+        # FaultPlan is attached, every verb consults it by name before
+        # touching the store; latencies advance fault_clock, never the
+        # wall clock
+        self.fault_plan = None
+        self.fault_clock = None
+
+    def _fault(self, verb: str) -> None:
+        if self.fault_plan is not None:
+            self.fault_plan.apply(verb, self.fault_clock)
 
     def _next_rv(self) -> str:
         self._rv += 1
@@ -137,6 +147,7 @@ class FakeKubeClient:
     # -- nodes ---------------------------------------------------------------
 
     def list_nodes(self, label_selector: Optional[str] = None) -> List[Node]:
+        self._fault("list_nodes")
         with self._lock:
             nodes = [Node(copy.deepcopy(raw)) for raw in self._nodes.values()]
         if label_selector:
@@ -151,12 +162,14 @@ class FakeKubeClient:
         return nodes
 
     def get_node(self, name: str) -> Node:
+        self._fault("get_node")
         with self._lock:
             if name not in self._nodes:
                 raise NotFoundError(f"node {name} not found", status=404)
             return Node(copy.deepcopy(self._nodes[name]))
 
     def patch_node(self, name: str, json_patch: List[Dict[str, Any]]) -> Node:
+        self._fault("patch_node")
         with self._lock:
             if name not in self._nodes:
                 raise NotFoundError(f"node {name} not found", status=404)
@@ -171,6 +184,7 @@ class FakeKubeClient:
     # -- pods ----------------------------------------------------------------
 
     def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        self._fault("list_pods")
         with self._lock:
             return [
                 Pod(copy.deepcopy(raw))
@@ -179,6 +193,7 @@ class FakeKubeClient:
             ]
 
     def get_pod(self, namespace: str, name: str) -> Pod:
+        self._fault("get_pod")
         with self._lock:
             raw = self._pods.get((namespace, name))
             if raw is None:
@@ -186,6 +201,7 @@ class FakeKubeClient:
             return Pod(copy.deepcopy(raw))
 
     def update_pod(self, pod: Pod) -> Pod:
+        self._fault("update_pod")
         with self._lock:
             key = (pod.namespace, pod.name)
             if key not in self._pods:
@@ -257,6 +273,7 @@ class FakeKubeClient:
     # -- TASPolicy CRD -------------------------------------------------------
 
     def list_taspolicies(self, namespace: Optional[str] = None) -> Dict[str, Any]:
+        self._fault("list_taspolicies")
         with self._lock:
             items = [
                 copy.deepcopy(raw)

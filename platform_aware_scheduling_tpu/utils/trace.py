@@ -136,6 +136,14 @@ declare("pas_rebalance_moves_skipped_total", "counter", "Planned moves not execu
 declare("pas_rebalance_candidate_nodes", "gauge", "Nodes currently past the deschedule hysteresis threshold (eviction candidates).")
 declare("pas_rebalance_convergence_cycles", "gauge", "Enforcement cycles the most recent violation episode took from first violation back to zero.")
 declare("pas_rebalance_plan_latency_seconds", "gauge", "Wall latency of the most recent incremental replan solve.")
+# fault-tolerant control plane (kube/retry.py + tas/degraded.py;
+# docs/robustness.md): retried API calls, circuit-breaker state, and the
+# per-subsystem degraded gauges
+declare("pas_kube_retry_total", "counter", "API-call retries performed by the fault-tolerant client (labels: verb, reason in throttled/server_error/network/api_error).")
+declare("pas_kube_giveup_total", "counter", "API calls abandoned after exhausting the retry budget or deadline (label: verb).")
+declare("pas_circuit_state", "gauge", "Circuit-breaker state per endpoint group: 0 closed, 1 half-open, 2 open (label: group).")
+declare("pas_circuit_transitions_total", "counter", "Circuit-breaker state transitions (labels: group, to).")
+declare("pas_degraded", "gauge", "1 while the named subsystem runs degraded: telemetry (stale/unrefreshable), kube_api / metrics_api (circuit not closed), evictions (suspended) (label: subsystem).")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where

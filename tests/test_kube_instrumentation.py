@@ -163,6 +163,103 @@ class TestInformerCounters:
         finally:
             informer.stop()
 
+    def test_repeated_watch_failures_back_off_with_jitter(self):
+        """ISSUE 5 satellite: K consecutive ListWatch failures must space
+        their relists with growing, capped, deterministically-jittered
+        delays — not the old fixed 0.2 s relist hammer — while the
+        watch-error counter keeps moving."""
+        from platform_aware_scheduling_tpu.kube.retry import (
+            backoff_delay,
+            stable_hash,
+        )
+
+        counters = CounterSet()
+        labels = {"informer": "storm"}
+        fails = 6
+        attempts = {"n": 0}
+        done = threading.Event()
+
+        def list_func():
+            attempts["n"] += 1
+            if attempts["n"] <= fails:
+                raise ConnectionError("apiserver away")
+            done.set()
+            return [], "rv1"
+
+        def watch_func(_rv):
+            threading.Event().wait(5)  # hold the watch open (daemon thread)
+            return iter(())
+
+        informer = Informer(
+            ListWatch(list_func, watch_func, lambda obj: obj["name"]),
+            name="storm",
+            counters=counters,
+            relist_backoff_base_s=0.001,
+            relist_backoff_max_s=0.008,
+        )
+        informer.start()
+        try:
+            assert done.wait(10)
+            assert counters.get(
+                "pas_informer_watch_errors_total", labels=labels
+            ) == fails
+            backoffs = list(informer.relist_backoffs)
+            assert len(backoffs) == fails
+            # the exact deterministic schedule: capped exponential with
+            # seeded jitter off the informer name
+            expected = [
+                backoff_delay(n, 0.001, 0.008, seed=stable_hash("storm"))
+                for n in range(1, fails + 1)
+            ]
+            assert backoffs == expected
+            assert max(backoffs) <= 0.008  # capped
+            # pre-jitter schedule grows to the cap; jitter keeps every
+            # delay within [0.5, 1.0) of it
+            assert backoffs[0] < 0.001 and backoffs[-1] >= 0.004
+        finally:
+            informer.stop()
+
+    def test_event_delivery_resets_backoff_streak(self):
+        """A watch that delivered an event is healthy again: the next
+        failure pays the BASE delay, not the accumulated cap."""
+        counters = CounterSet()
+        rounds = {"n": 0}
+
+        def list_func():
+            return [{"name": "a"}], "rv1"
+
+        def watch_func(_rv):
+            rounds["n"] += 1
+            if rounds["n"] <= 4:
+                def broken():
+                    yield ("MODIFIED", {"name": "a"})
+                    raise ConnectionError("reset")
+
+                return broken()
+            threading.Event().wait(5)
+            return iter(())
+
+        informer = Informer(
+            ListWatch(list_func, watch_func, lambda obj: obj["name"]),
+            name="flappy",
+            counters=counters,
+            relist_backoff_base_s=0.001,
+            relist_backoff_max_s=1.0,
+        )
+        informer.start()
+        try:
+            deadline = time.monotonic() + 10
+            while rounds["n"] <= 4 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert rounds["n"] > 4
+            # every failure followed a delivered event -> streak reset to
+            # 1 each time -> all four delays identical (the base tier)
+            backoffs = list(informer.relist_backoffs)
+            assert len(backoffs) == 4
+            assert len(set(backoffs)) == 1
+        finally:
+            informer.stop()
+
     def test_unnamed_informer_stays_silent(self):
         counters = CounterSet()
         def watch_func(_rv):

@@ -103,6 +103,102 @@ class TestAutoUpdatingCache:
         assert events == [("w", "m"), ("w", "m"), ("d", "m")]
 
 
+class TestLastKnownGoodRetention:
+    """ISSUE 5 satellite: a failed per-metric refresh preserves the
+    prior NodeMetricsInfo (the store's write-nil rule) while the metric
+    keeps AGING for freshness, and the refresh-error counter carries a
+    bounded ``reason`` label."""
+
+    def _cache_on_fake_clock(self):
+        from platform_aware_scheduling_tpu.testing.faults import FakeClock
+        from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+        clock = FakeClock()
+        counters = CounterSet()
+        cache = AutoUpdatingCache(counters=counters, clock=clock.now)
+        cache._refresh_period = 1.0
+        cache.write_metric(
+            "m1", {"node A": NodeMetric(value=Quantity("7"))}
+        )
+        cache.write_metric("m1")  # register for refresh
+        return cache, clock, counters
+
+    def test_failed_refresh_keeps_values_but_ages_them(self):
+        cache, clock, counters = self._cache_on_fake_clock()
+        good = DummyMetricsClient(
+            {"m1": {"node A": NodeMetric(value=Quantity("7"))}}
+        )
+        cache.update_all_metrics(good)
+        assert cache.metric_ages()["m1"] == 0
+        fresh_ok, _ = cache.telemetry_freshness()
+        assert fresh_ok
+        # the API goes away; passes keep running
+        bad = DummyMetricsClient({})
+        for _ in range(4):
+            clock.advance(1.0)
+            cache.update_all_metrics(bad)
+        # last-known-good value still served (write-nil rule)...
+        assert cache.read_metric("m1")["node A"].value.cmp_int64(7) == 0
+        # ...but the metric AGED: freshness decayed past the 3x bound
+        assert cache.metric_ages()["m1"] == pytest.approx(4.0)
+        fresh_ok, reason = cache.telemetry_freshness()
+        assert not fresh_ok and "m1" in reason
+
+    def test_refresh_errors_carry_reason_label(self):
+        from platform_aware_scheduling_tpu.kube.retry import CircuitOpenError
+        from platform_aware_scheduling_tpu.tas.cache import (
+            _refresh_error_reason,
+        )
+
+        cache, clock, counters = self._cache_on_fake_clock()
+
+        class Failing:
+            def __init__(self, exc):
+                self.exc = exc
+
+            def get_node_metric(self, name):
+                raise self.exc
+
+        cache.update_all_metrics(Failing(MetricsError("no metric m1 found")))
+        assert counters.get(
+            "pas_telemetry_refresh_errors_total",
+            labels={"reason": "no_data"},
+        ) == 1
+        cache.update_all_metrics(Failing(CircuitOpenError("metrics")))
+        assert counters.get(
+            "pas_telemetry_refresh_errors_total",
+            labels={"reason": "circuit_open"},
+        ) == 1
+        # unlabeled get() still sums across reasons (dashboards keep
+        # their totals)
+        assert counters.get("pas_telemetry_refresh_errors_total") == 2
+        # classifier edges stay bounded
+        from platform_aware_scheduling_tpu.kube.client import KubeError
+
+        assert _refresh_error_reason(KubeError("x", status=429)) == "throttled"
+        assert _refresh_error_reason(KubeError("x", status=503)) == "server_error"
+        assert _refresh_error_reason(TimeoutError()) == "network"
+        assert _refresh_error_reason(ValueError("weird")) == "fetch_error"
+        # the PRODUCTION path: CustomMetricsClient wraps everything in a
+        # bare MetricsError whose __cause__ carries the real error — the
+        # classifier must walk the chain, not collapse to fetch_error
+        def wrapped(cause):
+            try:
+                try:
+                    raise cause
+                except Exception as inner:
+                    raise MetricsError("unable to fetch metrics") from inner
+            except MetricsError as outer:
+                return outer
+
+        assert _refresh_error_reason(
+            wrapped(KubeError("x", status=503))
+        ) == "server_error"
+        assert _refresh_error_reason(
+            wrapped(CircuitOpenError("metrics"))
+        ) == "circuit_open"
+
+
 class TestMetricsClient:
     def test_wrap_metrics_default_window(self):
         info = wrap_metrics(

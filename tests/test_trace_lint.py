@@ -87,6 +87,20 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_fault_tolerance_families_declared(self):
+        """ISSUE 5: the retry/circuit/degraded families are part of the
+        declared inventory (docs/robustness.md)."""
+        expected = {
+            "pas_kube_retry_total": "counter",
+            "pas_kube_giveup_total": "counter",
+            "pas_circuit_state": "gauge",
+            "pas_circuit_transitions_total": "counter",
+            "pas_degraded": "gauge",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
 
 class TestLiveEmission:
     """Drive both front-ends, scrape /metrics, and hold every emitted
