@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving bench-rebalance bench-chaos test-serving test-obs test-rebalance test-faults trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving bench-rebalance bench-chaos bench-decisions test-serving test-obs test-rebalance test-faults test-decisions trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -58,6 +58,16 @@ test-faults:
 # scripted 10% metrics-API error rate vs a clean baseline
 bench-chaos:
 	python -m benchmarks.chaos_load
+
+# decision-provenance suite (docs/observability.md "Decision
+# provenance"): reason-code parity host<->device, concrete FailedNodes
+# reasons, ring bounds, /debug/decisions filtering, bind feedback
+test-decisions:
+	python -m pytest tests/test_decisions.py -q
+
+# decision-log on-vs-off serving p99 A/B + placement-quality scrape
+bench-decisions:
+	python -m benchmarks.http_load --decisions
 
 # metric-name convention gate (docs/observability.md): every emitted
 # metric is declared in trace.METRICS, pas_-prefixed snake_case, no

@@ -169,7 +169,7 @@ def _detail_path(round_override=None) -> str:
 
 def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
-    chaos=None,
+    chaos=None, decisions=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -229,6 +229,20 @@ def assemble_line(
             "label_only_converged": label_only.get("converged"),
             "label_only_residual_violations": label_only.get(
                 "residual_violations"
+            ),
+        }
+    if decisions is not None:
+        # full per-verb latency dicts + placement-quality scrape to disk;
+        # the line keeps only the overhead headline (the ISSUE 6
+        # acceptance bar: decision logging on vs off <= 5% serving p99)
+        detail["decisions"] = decisions
+        result["decisions"] = {
+            "num_nodes": decisions.get("num_nodes"),
+            "overhead_pct_prioritize_p99": decisions.get(
+                "overhead_pct_prioritize_p99"
+            ),
+            "overhead_pct_filter_p99": decisions.get(
+                "overhead_pct_filter_p99"
             ),
         }
     if chaos is not None:
@@ -415,6 +429,21 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"chaos bench failed: {exc}", file=sys.stderr)
 
+    # --- decision provenance: serving-p99 overhead of the decision log
+    # (on vs off) + placement-quality scrape (benchmarks/http_load.py;
+    # docs/observability.md "Decision provenance") ---
+    decisions_out = None
+    try:
+        decisions_out = http_load.decision_overhead(num_nodes=NUM_NODES)
+        print(
+            f"decisions: p99 overhead prioritize "
+            f"{decisions_out['overhead_pct_prioritize_p99']}% / filter "
+            f"{decisions_out['overhead_pct_filter_p99']}% (log on vs off)",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"decision bench failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -425,7 +454,8 @@ def main():
         print(f"config benches failed: {exc}", file=sys.stderr)
 
     result, detail = assemble_line(
-        headline, load, configs_out, gas, serving, rebalance, chaos
+        headline, load, configs_out, gas, serving, rebalance, chaos,
+        decisions_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

@@ -388,7 +388,11 @@ def _configs(concurrency_sweep) -> List[tuple]:
 
 
 def _serve_forever(
-    num_nodes: int, device: bool, builder=None, serving: str = "threaded"
+    num_nodes: int,
+    device: bool,
+    builder=None,
+    serving: str = "threaded",
+    decisions_enabled: bool = True,
 ) -> None:
     """Subprocess entry: start the service, print ``READY <port>``, block.
     The server gets its own process (and GIL) — in-process serving would
@@ -398,9 +402,12 @@ def _serve_forever(
 
     GC posture (applies to BOTH sides of the A/B): the same serving
     tuning the production mains apply (utils/gctuning.py)."""
-    from platform_aware_scheduling_tpu.utils import devicewatch
+    from platform_aware_scheduling_tpu.utils import decisions, devicewatch
     from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 
+    # decision provenance on/off — the decision_overhead A/B flips this
+    # per service subprocess (mirrors --decisionLog on the real mains)
+    decisions.DECISIONS.configure(enabled=decisions_enabled)
     # device visibility, same wiring as the production mains: the cost
     # capture must precede the warm pass's first kernel compiles
     devicewatch.install_cost_hooks()
@@ -419,6 +426,7 @@ def _spawn_service(
     device: bool,
     module: str = "benchmarks.http_load",
     serving: str = "threaded",
+    decisions_enabled: bool = True,
 ) -> tuple:
     """(process, port) for an isolated service subprocess running
     ``python -m <module> --serve`` (shared by the GAS A/B)."""
@@ -434,6 +442,7 @@ def _spawn_service(
             str(num_nodes),
             "1" if device else "0",
             serving,
+            "1" if decisions_enabled else "0",
         ],
         stdout=subprocess.PIPE,
         text=True,
@@ -755,6 +764,103 @@ def filter_floor_breakdown(num_nodes: int = 10_000, reps: int = 30) -> Dict:
     return out
 
 
+def decision_overhead(
+    num_nodes: int = 10_000,
+    requests: int = 240,
+    warmup: int = 5,
+    repeats: int = 2,
+) -> Dict:
+    """Decision-provenance A/B (ISSUE 6 acceptance): serving p99 with the
+    decision log ON vs OFF — same device service, same bodies, same
+    raw-socket client, prioritize AND filter at c=1 on the primary
+    NodeNames hit tier (where per-request cost is smallest and relative
+    overhead therefore largest).  Also scrapes the ON side's
+    placement-quality surface: pas_decision_* families after a bind
+    burst, plus a /debug/decisions summary — so BENCH_DETAIL shows the
+    feedback loop actually closing, not just costing nothing."""
+    from platform_aware_scheduling_tpu.utils import trace
+
+    names = node_names(num_nodes)
+    bodies = make_bodies(names, "nodenames")
+    out: Dict = {"num_nodes": num_nodes}
+    for label, enabled in (("on", True), ("off", False)):
+        proc, port = _spawn_service(
+            num_nodes, device=True, decisions_enabled=enabled
+        )
+        try:
+            side: Dict = {}
+            for verb in ("prioritize", "filter"):
+                best = None
+                for _rep in range(max(repeats, 1)):
+                    drive(
+                        port, bodies[:5], warmup, concurrency=1,
+                        path=_PATHS[verb],
+                    )
+                    measured = drive(
+                        port, bodies, requests, concurrency=1,
+                        path=_PATHS[verb],
+                    )
+                    best = (
+                        measured if best is None else _best_of(best, measured)
+                    )
+                side[verb] = best
+            if enabled:
+                # close the loop: bind every rotated pod onto its
+                # top-ranked node, then scrape the quality families
+                for i in range(POD_ROTATION):
+                    bind = json.dumps(
+                        {
+                            "PodName": f"bench-pod-{i}",
+                            "PodNamespace": "default",
+                            "PodUID": f"uid-{i}",
+                            "Node": names[0],
+                        }
+                    ).encode()
+                    drive(
+                        port, [bind], 1, concurrency=1,
+                        path="/scheduler/bind", min_payload=0,
+                        expect_status=404,
+                    )
+                quality: Dict = {}
+                status, payload = http_get(port, "/metrics")
+                if status == 200:
+                    families = trace.parse_prometheus_text(payload.decode())
+                    for family, data in families.items():
+                        if not family.startswith("pas_decision_"):
+                            continue
+                        quality[family] = {
+                            ",".join(
+                                f"{k}={v}" for k, v in sorted(labels.items())
+                            )
+                            or "_": value
+                            for _n, labels, value in data["samples"]
+                        }
+                status, payload = http_get(
+                    port, "/debug/decisions?limit=4"
+                )
+                if status == 200:
+                    snap = json.loads(payload)
+                    quality["debug_decisions"] = {
+                        "recorded_total": snap.get("recorded_total"),
+                        "open": snap.get("open"),
+                        "sample_verbs": [
+                            r.get("verb") for r in snap.get("records", [])
+                        ],
+                    }
+                side["placement_quality"] = quality
+            out[label] = side
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    for verb in ("prioritize", "filter"):
+        on_p99 = out["on"][verb]["p99_ms"]
+        off_p99 = out["off"][verb]["p99_ms"]
+        out[f"overhead_pct_{verb}_p99"] = round(
+            (on_p99 / off_p99 - 1.0) * 100.0, 1
+        )
+    return out
+
+
 if __name__ == "__main__":
     import sys
 
@@ -763,7 +869,13 @@ if __name__ == "__main__":
             int(sys.argv[2]),
             sys.argv[3] == "1",
             serving=sys.argv[4] if len(sys.argv) > 4 else "threaded",
+            decisions_enabled=(
+                sys.argv[5] == "1" if len(sys.argv) > 5 else True
+            ),
         )
+    elif len(sys.argv) > 1 and sys.argv[1] == "--decisions":
+        nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+        print(json.dumps(decision_overhead(num_nodes=nodes), indent=2))
     elif len(sys.argv) > 1 and sys.argv[1] == "--scaling":
         nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000
         print(json.dumps(serving_scaling(num_nodes=nodes), indent=2))

@@ -2,7 +2,8 @@
 cluster and exercise the whole observability surface end to end —
 /healthz, /readyz (must report ready, with its condition list), /metrics
 (must parse as valid Prometheus exposition with only declared families),
-/debug/traces, and a verb request so the histograms are non-empty.
+/debug/traces, the /debug index, /debug/decisions (must hold the verb's
+decision record), and a verb request so the histograms are non-empty.
 
 This is the one-command deployment sanity check (docs/observability.md):
 if it passes, probes, exposition, and the trace ring all work on this
@@ -60,6 +61,18 @@ def check_front_end(serving: str) -> str:
         status, payload = _get(port, "/debug/traces")
         assert status == 200, f"{serving}: /debug/traces -> {status}"
         json.loads(payload)
+        status, payload = _get(port, "/debug")
+        assert status == 200, f"{serving}: /debug -> {status}"
+        index = json.loads(payload)
+        paths = [e["path"] for e in index["endpoints"]]
+        assert "/debug/decisions" in paths, f"{serving}: index missing decisions"
+        status, payload = _get(port, "/debug/decisions")
+        assert status == 200, f"{serving}: /debug/decisions -> {status}"
+        snap = json.loads(payload)
+        assert snap["enabled"] is True
+        assert snap["recorded_total"] >= 1, (
+            f"{serving}: the prioritize above must have recorded a decision"
+        )
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "

@@ -62,6 +62,35 @@ def add_robustness_flags(
                             "suspended while degraded (not configurable)")
 
 
+def add_decision_flags(parser: argparse.ArgumentParser) -> None:
+    """Decision-provenance flag surface shared by both mains
+    (docs/observability.md "Decision provenance")."""
+    parser.add_argument("--decisionLog", default="on",
+                        choices=["off", "on"],
+                        help="per-decision explain records behind "
+                        "GET /debug/decisions: every Filter/Prioritize/"
+                        "rebalance decision keeps its per-node reasons "
+                        "and score breakdown, closed by pod-bind "
+                        "feedback into pas_decision_* placement-quality "
+                        "metrics.  Costs <=5%% serving p99 (pinned by "
+                        "the http_load decision A/B); off disables "
+                        "recording and 404s the endpoint")
+    parser.add_argument("--decisionLogSize", type=int, default=512,
+                        help="decision-log ring capacity; an open record "
+                        "overwritten before its bind feedback counts in "
+                        "pas_decision_evicted_open_total (size the ring "
+                        "above pending-pods x verbs)")
+
+
+def configure_decisions(args) -> None:
+    """Apply the shared decision flags to the process-wide DecisionLog."""
+    from platform_aware_scheduling_tpu.utils import decisions
+
+    decisions.DECISIONS.configure(
+        enabled=args.decisionLog == "on", capacity=args.decisionLogSize
+    )
+
+
 def build_fault_tolerance(args):
     """(RetryPolicy, CircuitBreakerRegistry) from the shared flags."""
     from platform_aware_scheduling_tpu.kube.retry import (

@@ -48,12 +48,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     # parity with cmd/tas.py via the one shared helper (cmd/common.py)
     common.add_profile_flag(parser)
     common.add_robustness_flags(parser, degraded=False)
+    common.add_decision_flags(parser)
     return parser
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_arg_parser().parse_args(argv)
     klog.set_verbosity(args.v)
+    common.configure_decisions(args)
 
     # fault-tolerant proxy in front of every API consumer — GAS has no
     # telemetry cache so no degraded-mode controller, but its informers

@@ -106,6 +106,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "actuator must not evict below")
     common.add_profile_flag(parser)
     common.add_robustness_flags(parser)
+    common.add_decision_flags(parser)
     return parser
 
 
@@ -234,6 +235,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_arg_parser().parse_args(argv)
     klog.set_verbosity(args.v)
     sync_period_s = parse_duration(args.syncPeriod)
+    # decision provenance on/off + ring size, before any verb can record
+    common.configure_decisions(args)
 
     # every remote call goes through the fault-tolerant proxy: retried
     # reads, breaker-gated writes, per-endpoint-group circuits

@@ -22,7 +22,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, TYPE_CHECKING
 
-from platform_aware_scheduling_tpu.utils import devicewatch, health, klog, trace
+from platform_aware_scheduling_tpu.utils import (
+    decisions,
+    devicewatch,
+    health,
+    klog,
+    trace,
+)
 
 if TYPE_CHECKING:  # pragma: no cover
     from platform_aware_scheduling_tpu.extender.types import Scheduler
@@ -64,6 +70,37 @@ class HTTPResponse:
     @classmethod
     def json(cls, body: bytes, status: int = 200) -> "HTTPResponse":
         return cls(status=status, headers={"Content-Type": "application/json"}, body=body)
+
+
+#: the debug/observability surface, served by BOTH front-ends (each entry
+#: also bypasses the async admission queue); ``GET /debug`` renders this
+#: as the index so an operator can discover the endpoints from curl alone
+DEBUG_ENDPOINTS = [
+    {"path": "/healthz", "description": "process liveness (200 = alive)"},
+    {"path": "/readyz", "description": "composite readiness: 503 + condition list until warm/fresh/synced"},
+    {"path": "/metrics", "description": "Prometheus exposition: verb histograms, path attribution, pas_* families"},
+    {"path": "/debug/traces", "description": "recent + slowest request traces; filters: ?verb=<verb>&min_ms=<float>"},
+    {"path": "/debug/decisions", "description": "scheduling decision provenance records; filters: ?pod=<name>&verb=<verb>&limit=<n> (404 when --decisionLog=off)"},
+    {"path": "/debug/rebalance", "description": "last rebalance plan + loop state (404 when --rebalance=off)"},
+    {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
+]
+
+
+def parse_query(path: str) -> Dict[str, str]:
+    """The ``?k=v&k2=v2`` tail of a request path as a dict with standard
+    percent-decoding (a client sending ``?pod=default%2Fmy-pod`` must
+    match the record keyed ``default/my-pod``); last occurrence of a
+    repeated key wins."""
+    from urllib.parse import unquote_plus
+
+    _, _, query = path.partition("?")
+    params: Dict[str, str] = {}
+    for pair in query.split("&"):
+        if not pair:
+            continue
+        key, _, value = pair.partition("=")
+        params[unquote_plus(key)] = unquote_plus(value)
+    return params
 
 
 def not_found_handler(request: HTTPRequest) -> HTTPResponse:
@@ -378,16 +415,67 @@ class Server:
                 headers={"Content-Type": "application/json"},
                 body=rebalancer.to_json(),
             )
-        if request.path == "/debug/traces":
+        if bare_path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of
             # recent + slowest completed request traces as JSON.  Always
-            # on — tracing has no off switch, matching its near-zero cost
+            # on — tracing has no off switch, matching its near-zero cost.
+            # ?verb= keeps spans of one verb; ?min_ms= keeps slow spans
             if request.method != "GET":
                 return HTTPResponse(status=405)
+            params = parse_query(request.path)
+            min_ms = None
+            if "min_ms" in params:
+                try:
+                    min_ms = float(params["min_ms"])
+                except ValueError:
+                    return HTTPResponse.json(
+                        b'{"error": "min_ms must be a number"}\n', status=400
+                    )
             return HTTPResponse(
                 status=200,
                 headers={"Content-Type": "application/json"},
-                body=trace.TRACES.to_json(),
+                body=trace.TRACES.to_json(
+                    verb=params.get("verb"), min_ms=min_ms
+                ),
+            )
+        if bare_path == "/debug/decisions":
+            # decision provenance (utils/decisions.py): recent scheduling
+            # decisions with per-node reasons + outcome feedback; 404
+            # while the log is disabled (--decisionLog=off), like an
+            # unwired /debug/rebalance
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            if not decisions.DECISIONS.enabled:
+                return HTTPResponse.json(
+                    b'{"error": "decision log disabled"}\n', status=404
+                )
+            params = parse_query(request.path)
+            try:
+                limit = int(params.get("limit", "64"))
+            except ValueError:
+                return HTTPResponse.json(
+                    b'{"error": "limit must be an integer"}\n', status=400
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=decisions.DECISIONS.to_json(
+                    pod=params.get("pod"),
+                    verb=params.get("verb"),
+                    limit=limit,
+                ),
+            )
+        if bare_path in ("/debug", "/debug/"):
+            # tiny index so the debug surface is discoverable from curl
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            import json
+
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=json.dumps({"endpoints": DEBUG_ENDPOINTS}).encode()
+                + b"\n",
             )
         if request.path == "/metrics" and self.metrics_provider is not None:
             # observability extension: outside the POST/JSON middleware

@@ -33,6 +33,7 @@ from platform_aware_scheduling_tpu.ops.binpack import (
     BinpackRequest,
     binpack_kernel,
 )
+from platform_aware_scheduling_tpu.utils import decisions
 
 import jax.numpy as jnp
 
@@ -259,7 +260,19 @@ class DeviceBinpacker:
         # so identity == version) and the pod's request signature
         self._fits_cache: List[list] = []
 
-    def batch_fit(self, pod: Pod, node_names: Sequence[str]) -> Optional[List[bool]]:
+    def batch_fit(
+        self,
+        pod: Pod,
+        node_names: Sequence[str],
+        with_reasons: bool = False,
+    ) -> Optional[List[bool]]:
+        """Per-node fit verdicts, or None when the pod has no per-card
+        demand (the host loop decides cheaply).  With ``with_reasons``
+        the return is ``(fits, codes)`` where codes carry the compact
+        decision taxonomy per node (utils/decisions.py): 0 fit,
+        gas_unknown_node / gas_no_gpus for the pre-failed lanes, and
+        gas_capacity when the binpack kernel said no — the classes the
+        host loop's typed exceptions produce identically."""
         requests = container_requests(pod)
         shares = [gas_logic.get_per_gpu_resource_request(req) for req in requests]
         max_gpus = max((k for _, k in shares), default=0)
@@ -269,8 +282,10 @@ class DeviceBinpacker:
             # the host loop decides cheaply — no point shipping tensors
             return None
         if self.mirror is not None:
-            return self._fit_mirror(requests, shares, resources, node_names)
-        return self._fit_staged(requests, shares, resources, node_names)
+            fits, codes = self._fit_mirror(requests, shares, resources, node_names)
+        else:
+            fits, codes = self._fit_staged(requests, shares, resources, node_names)
+        return (fits, codes) if with_reasons else fits
 
     # -- persistent-mirror path ------------------------------------------------
 
@@ -329,12 +344,19 @@ class DeviceBinpacker:
 
         fits_all = self._all_rows_fits(state, signature, compute)
         out = [False] * len(node_names)
+        codes = [decisions.CODE_GAS_CAPACITY] * len(node_names)
         for pos, name in enumerate(node_names):
             row = node_index.get(name)
-            if row is None or not known[row] or not has_gpus[row]:
+            if row is None or not known[row]:
+                codes[pos] = decisions.CODE_GAS_UNKNOWN_NODE
                 continue  # pre-failed
+            if not has_gpus[row]:
+                codes[pos] = decisions.CODE_GAS_NO_GPUS
+                continue
             out[pos] = bool(fits_all[row])
-        return out
+            if out[pos]:
+                codes[pos] = decisions.CODE_ELIGIBLE
+        return out, codes
 
     # -- per-request staging path (control) ------------------------------------
 
@@ -345,14 +367,17 @@ class DeviceBinpacker:
 
         staged = []
         out = [False] * len(node_names)
+        codes = [decisions.CODE_GAS_CAPACITY] * len(node_names)
         max_cards = 1
         for pos, name in enumerate(node_names):
             try:
                 node = self.cache.fetch_node(name)
             except Exception:
+                codes[pos] = decisions.CODE_GAS_UNKNOWN_NODE
                 continue
             gpus = gas_logic.get_node_gpu_list(node)
             if not gpus:
+                codes[pos] = decisions.CODE_GAS_NO_GPUS
                 continue
             capacity = gas_logic.get_per_gpu_resource_capacity(node, len(gpus))
             used = self.cache.get_node_resource_status(name)
@@ -360,7 +385,7 @@ class DeviceBinpacker:
             max_cards = max(max_cards, len(cards))
             staged.append((pos, cards, capacity, used, set(gpus)))
         if not staged:
-            return out
+            return out, codes
 
         n = len(staged)
         c_pad = _bucket(max_cards, MIN_CARDS)
@@ -399,4 +424,6 @@ class DeviceBinpacker:
         fits_np = np.asarray(result.fits)
         for row, (pos, *_rest) in enumerate(staged):
             out[pos] = bool(fits_np[row])
-        return out
+            if out[pos]:
+                codes[pos] = decisions.CODE_ELIGIBLE
+        return out, codes

@@ -55,7 +55,7 @@ from platform_aware_scheduling_tpu.gas.utils import (
 from platform_aware_scheduling_tpu.kube.client import ConflictError
 from platform_aware_scheduling_tpu.kube.retry import RetryPolicy
 from platform_aware_scheduling_tpu.kube.objects import Node, Pod
-from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils import decisions, klog, trace
 from platform_aware_scheduling_tpu.utils.quantity import Quantity
 from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
 
@@ -64,6 +64,24 @@ UPDATE_RETRY_COUNT = 5  # scheduler.go:28
 
 class WontFitError(Exception):
     """will not fit (scheduler.go:49)"""
+
+
+class NoGPUsError(WontFitError):
+    """Node has no GPUs (vanished or never labeled) — a distinct
+    provenance class from a genuine capacity miss, so the host loop and
+    the device binpack produce the same reason code for it."""
+
+
+def request_summary(pod: Pod) -> str:
+    """Compact "res=total, ..." rendering of the pod's GPU resource
+    request — the detail half of the gas capacity reason string,
+    computed identically on the device and host paths (both read only
+    the pod)."""
+    totals: Dict[str, int] = {}
+    for req in container_requests(pod):
+        for name, value in req.items():
+            totals[name] = totals.get(name, 0) + value
+    return ", ".join(f"{k}={v}" for k, v in sorted(totals.items()))
 
 
 class GASExtender:
@@ -188,22 +206,29 @@ class GASExtender:
             )
             klog.error(error)
             return FilterResult(error=error)
+        summary = request_summary(args.pod)
         with self._rwmutex:
             if self._device is not None:
                 try:
-                    fits = self._device.batch_fit(args.pod, args.node_names)
+                    res = self._device.batch_fit(
+                        args.pod, args.node_names, with_reasons=True
+                    )
                 except Exception as exc:
                     klog.error("device binpack failed, host fallback: %s", exc)
-                    fits = None
-                if fits is not None:
+                    res = None
+                if res is not None:
+                    fits, codes = res
                     span.set("path", "device")
                     trace.COUNTERS.inc("pas_gas_filter_device_total")
                     node_names = [n for n, ok in zip(args.node_names, fits) if ok]
                     failed = {
-                        n: "Not enough GPU-resources for deployment"
-                        for n, ok in zip(args.node_names, fits)
+                        n: decisions.gas_reason(code, summary)
+                        for n, ok, code in zip(args.node_names, fits, codes)
                         if not ok
                     }
+                    self._record_filter_decision(
+                        span, args.pod, args.node_names, failed, codes
+                    )
                     return FilterResult(
                         node_names=node_names, failed_nodes=failed, error=""
                     )
@@ -211,13 +236,58 @@ class GASExtender:
             trace.COUNTERS.inc("pas_gas_filter_host_total")
             node_names: List[str] = []
             failed: Dict[str, str] = {}
+            codes: List[int] = []
             for node_name in args.node_names:
+                code = decisions.CODE_ELIGIBLE
                 try:
                     self._run_scheduling_logic(args.pod, node_name)
                     node_names.append(node_name)
+                except NoGPUsError:
+                    code = decisions.CODE_GAS_NO_GPUS
+                except WontFitError:
+                    code = decisions.CODE_GAS_CAPACITY
+                except KeyError:
+                    # cache.fetch_node's miss signal — matches the device
+                    # path's not-interned / not-known lanes
+                    code = decisions.CODE_GAS_UNKNOWN_NODE
                 except Exception:
-                    failed[node_name] = "Not enough GPU-resources for deployment"
+                    # anything else (malformed capacity quantity, ...) is
+                    # its own class: 'unknown to cache' would point an
+                    # operator at a cache miss that never happened
+                    code = decisions.CODE_GAS_ERROR
+                if code != decisions.CODE_ELIGIBLE:
+                    failed[node_name] = decisions.gas_reason(code, summary)
+                codes.append(code)
+            self._record_filter_decision(
+                span, args.pod, args.node_names, failed, codes
+            )
             return FilterResult(node_names=node_names, failed_nodes=failed, error="")
+
+    def _record_filter_decision(
+        self, span, pod: Pod, node_names, failed: Dict[str, str], codes
+    ) -> None:
+        """One gas_filter decision record + exact per-reason-class
+        filtered-node counters (utils/decisions.py)."""
+        log = decisions.DECISIONS
+        if not log.enabled:
+            return
+        reason_counts: Dict[int, int] = {}
+        for code in codes:
+            if code != decisions.CODE_ELIGIBLE:
+                reason_counts[code] = reason_counts.get(code, 0) + 1
+        log.record_filter(
+            verb="gas_filter",
+            request_id=getattr(span, "trace_id", ""),
+            pod_namespace=pod.namespace,
+            pod_name=pod.name,
+            policy="gas",
+            path=str(span.attrs.get("path", "")),
+            candidates=len(node_names),
+            filtered=len(failed),
+            violating=failed,
+            violating_scope="request",
+            reason_counts=reason_counts,
+        )
 
     # -- scheduling core (scheduler.go:277-338) ---------------------------------
 
@@ -229,7 +299,7 @@ class GASExtender:
         gpus = get_node_gpu_list(node)
         if not gpus:
             klog.warning("Node %s GPUs have vanished", node_name)
-            raise WontFitError("will not fit")
+            raise NoGPUsError("will not fit")
         per_gpu_capacity = get_per_gpu_resource_capacity(node, len(gpus))
         used = self.cache.get_node_resource_status(node_name)
         gpu_set = set(gpus)
@@ -304,6 +374,11 @@ class GASExtender:
                 self._annotate_pod_bind(annotation, pod)
                 self.kube_client.bind_pod(
                     args.pod_namespace, args.pod_name, args.pod_uid, args.node
+                )
+                # outcome feedback: the successful bind closes this pod's
+                # open gas_filter decision records (utils/decisions.py)
+                decisions.DECISIONS.observe_bind(
+                    args.pod_namespace, args.pod_name, args.node
                 )
                 return BindingResult()
             except Exception as exc:

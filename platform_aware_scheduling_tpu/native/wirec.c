@@ -1385,10 +1385,22 @@ error:
 /* filter_encode                                                       */
 
 /* Build the NodeNames-mode FilterResult response straight from the
- * parsed body + name table + a per-row violation bitmask:
+ * parsed body + name table + a per-row violation bitmask, optionally a
+ * per-row reason table:
  *
  *   {"Nodes": null, "NodeNames": [...passing...],
- *    "FailedNodes": {"<name>": "Node violates", ...}, "Error": ""}\n
+ *    "FailedNodes": {"<name>": "<reason>", ...}, "Error": ""}\n
+ *
+ * Returns (bytes, n_failed): the failed-entry count rides along so the
+ * decision log's per-request counters stay exact without re-parsing.
+ *
+ * ``reasons`` (optional 4th arg) is a sequence indexed by table row
+ * whose entries are pre-JSON-encoded reason strings as bytes (quotes
+ * and escapes included — built host-side with json.dumps once per
+ * state, utils/decisions.py) or None; a violating row without one gets
+ * the reference literal "Node violates".  Splicing pre-encoded bytes
+ * keeps byte parity with the exact Python path's json.dumps by
+ * construction.
  *
  * Byte-identical to FilterResult.to_json() over the exact Python path's
  * result for the same request (json.dumps separators/ensure_ascii):
@@ -1398,8 +1410,9 @@ error:
  * one FailedNodes entry at first-occurrence position (dict semantics);
  * names absent from the table never violate (they pass through). */
 static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
-    PyObject *parsed_obj, *table_obj, *mask_obj;
-    if (!PyArg_ParseTuple(args, "OOO", &parsed_obj, &table_obj, &mask_obj))
+    PyObject *parsed_obj, *table_obj, *mask_obj, *reasons_obj = Py_None;
+    if (!PyArg_ParseTuple(args, "OOO|O", &parsed_obj, &table_obj, &mask_obj,
+                          &reasons_obj))
         return NULL;
     if (!PyObject_TypeCheck(parsed_obj, &ParsedArgs_Type)) {
         PyErr_SetString(PyExc_TypeError, "expected ParsedArgs");
@@ -1433,6 +1446,11 @@ static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
     PyObject **enc_obj = NULL;     /* owned refs backing enc_ptr */
     Py_ssize_t n_enc = 0;
     PyObject *json_mod = NULL, *res = NULL;
+    PyObject *reasons_fast = NULL; /* borrowed-item view of reasons_obj */
+    const char **reason_ptr = NULL; /* per-row reason bytes (borrowed) */
+    Py_ssize_t *reason_len = NULL;
+    Py_ssize_t n_failed = 0;
+    size_t reason_bytes = 0;
     Buf out_buf = {NULL, 0, 0};
     Buf *out = &out_buf;
     int oom = 0;
@@ -1493,9 +1511,32 @@ static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
         }
     }
 
+    if (reasons_obj != Py_None) {
+        /* resolve per-row reason bytes under the GIL; the fast-sequence
+         * ref keeps every bytes item alive through the GIL-free encode */
+        reasons_fast = PySequence_Fast(
+            reasons_obj, "reasons must be a sequence");
+        if (!reasons_fast) goto done;
+        Py_ssize_t rsize = PySequence_Fast_GET_SIZE(reasons_fast);
+        reason_ptr = PyMem_Calloc((size_t)t->n_rows + 1, sizeof(char *));
+        reason_len = PyMem_Calloc((size_t)t->n_rows + 1, sizeof(Py_ssize_t));
+        if (!reason_ptr || !reason_len) { PyErr_NoMemory(); goto done; }
+        for (Py_ssize_t k = 0; k < num; k++) {
+            Py_ssize_t row = rows[k];
+            if (row < 0 || row >= rsize || !vmask[row] || reason_ptr[row])
+                continue;
+            PyObject *item = PySequence_Fast_GET_ITEM(reasons_fast, row);
+            if (item == Py_None || !PyBytes_Check(item)) continue;
+            reason_ptr[row] = PyBytes_AS_STRING(item);
+            reason_len[row] = PyBytes_GET_SIZE(item);
+            reason_bytes += (size_t)reason_len[row];
+        }
+    }
+
     Py_BEGIN_ALLOW_THREADS
-    /* "name", -> len+4 each; failed entry adds ': "Node violates"' (18) */
-    out_buf = pool_get(96 + span_bytes + (size_t)num * 24);
+    /* "name", -> len+4 each; failed entry adds ': "Node violates"' (18)
+     * or ': ' + its pre-encoded reason bytes (accounted in reason_bytes) */
+    out_buf = pool_get(96 + span_bytes + (size_t)num * 24 + reason_bytes);
     if (!out_buf.data) oom = 1;
     if (!oom && buf_put(out, "{\"Nodes\": null, \"NodeNames\": [", 30) < 0)
         oom = 1;
@@ -1521,6 +1562,7 @@ static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
         Py_ssize_t row = rows[k];
         if (row < 0 || !vmask[row] || seen[row]) continue;
         seen[row] = 1;
+        n_failed++;
         if (!first && buf_put(out, ", ", 2) < 0) { oom = 1; break; }
         first = 0;
         if (raw_ok[k]) {
@@ -1532,13 +1574,26 @@ static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
         } else {
             if (buf_put(out, enc_ptr[k], (size_t)enc_len[k]) < 0) oom = 1;
         }
-        if (!oom && buf_put(out, ": \"Node violates\"", 17) < 0) oom = 1;
+        if (!oom) {
+            if (reason_ptr && reason_ptr[row]) {
+                if (buf_put(out, ": ", 2) < 0 ||
+                    buf_put(out, reason_ptr[row],
+                            (size_t)reason_len[row]) < 0)
+                    oom = 1;
+            } else if (buf_put(out, ": \"Node violates\"", 17) < 0) {
+                oom = 1;
+            }
+        }
     }
     if (!oom && buf_put(out, "}, \"Error\": \"\"}\n", 16) < 0) oom = 1;
     Py_END_ALLOW_THREADS
 
     if (oom) PyErr_NoMemory();
-    else res = PyBytes_FromStringAndSize(out->data, (Py_ssize_t)out->len);
+    else {
+        PyObject *bytes =
+            PyBytes_FromStringAndSize(out->data, (Py_ssize_t)out->len);
+        if (bytes) res = Py_BuildValue("(Nn)", bytes, n_failed);
+    }
 
 done:
     pool_put(&out_buf);
@@ -1548,6 +1603,9 @@ done:
     PyMem_Free(enc_ptr);
     PyMem_Free(enc_len);
     PyMem_Free(enc_obj);
+    PyMem_Free(reason_ptr);
+    PyMem_Free(reason_len);
+    Py_XDECREF(reasons_fast);
     Py_XDECREF(json_mod);
     PyMem_Free(rows);
     PyMem_Free(raw_ok);
@@ -1567,8 +1625,9 @@ static PyMethodDef wirec_methods[] = {
      "Assemble the Prioritize response bytes from a parsed body, a name "
      "table, and the global rank order (optional planned row promotion)."},
     {"filter_encode", wirec_filter_encode, METH_VARARGS,
-     "Assemble the NodeNames-mode FilterResult response bytes from a "
-     "parsed body, a name table, and a per-row violation bitmask."},
+     "Assemble the NodeNames-mode FilterResult response from a parsed "
+     "body, a name table, a per-row violation bitmask, and optional "
+     "per-row pre-encoded reason bytes; returns (bytes, n_failed)."},
     {NULL},
 };
 

@@ -74,3 +74,20 @@ def violated_nodes(
     deschedule/strategy.go:31-49; OR semantics per
     telemetry-aware-scheduling/README.md:133)."""
     return jnp.any(evaluate_rules(metric_values, metric_present, rules), axis=0)
+
+
+def first_violated_rule(
+    metric_values: i64.I64,
+    metric_present: jax.Array,
+    rules: RuleSet,
+) -> jax.Array:
+    """Per-node index of the FIRST matching rule ``[N]`` (int32; -1 when
+    the node violates nothing) — the device half of decision provenance:
+    the verdict's compact reason code, decoded host-side into the policy
+    rule it names (utils/decisions.py).  "First" is rule-list order,
+    matching the host path's lowest-index-wins recording
+    (tas/strategies/dontschedule.violated_details)."""
+    matched = evaluate_rules(metric_values, metric_present, rules)  # [R, N]
+    # argmax over bool returns the first True index (0 when none match)
+    first = jnp.argmax(matched, axis=0).astype(jnp.int32)
+    return jnp.where(jnp.any(matched, axis=0), first, jnp.int32(-1))

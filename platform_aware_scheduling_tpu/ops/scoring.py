@@ -26,6 +26,7 @@ from platform_aware_scheduling_tpu.ops.rules import (
     OP_GREATER_THAN,
     OP_LESS_THAN,
     RuleSet,
+    first_violated_rule,
     violated_nodes,
 )
 from platform_aware_scheduling_tpu.utils import trace
@@ -116,6 +117,31 @@ def _filter_kernel(
     return candidate_mask & ~violating
 
 
+class FilterExplainResult(NamedTuple):
+    passing: jax.Array  # bool [N] — candidate & not violating
+    first_rule: jax.Array  # int32 [N] — first matching rule index, -1 clean
+
+
+@jax.jit
+def _filter_explain_kernel(
+    metric_values: i64.I64,  # [M, N]
+    metric_present: jax.Array,  # bool [M, N]
+    rules: RuleSet,
+    candidate_mask: jax.Array,  # bool [N]
+) -> FilterExplainResult:
+    """The Filter verb WITH provenance: the same fused violation pass as
+    ``_filter_kernel`` plus the per-node first-matching-rule index vector
+    — the integer reason code the decision log decodes host-side
+    (utils/decisions.py).  One extra argmax over the already-computed
+    ``[R, N]`` match mask; the verdict bits are identical to
+    ``_filter_kernel`` by construction (both reduce the same
+    ``evaluate_rules`` output)."""
+    first = first_violated_rule(metric_values, metric_present, rules)
+    return FilterExplainResult(
+        passing=candidate_mask & (first < 0), first_rule=first
+    )
+
+
 @jax.jit
 def _batch_prioritize_kernel(
     metric_values: i64.I64,  # [M, N]
@@ -141,6 +167,9 @@ def _batch_prioritize_kernel(
 # the batch kernel can't be miscounted as callers' retraces.
 prioritize_kernel = trace.watch_jit("prioritize_kernel", _prioritize_kernel)
 filter_kernel = trace.watch_jit("filter_kernel", _filter_kernel)
+filter_explain_kernel = trace.watch_jit(
+    "filter_explain_kernel", _filter_explain_kernel
+)
 batch_prioritize_kernel = trace.watch_jit(
     "batch_prioritize_kernel", _batch_prioritize_kernel
 )

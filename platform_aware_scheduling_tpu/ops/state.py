@@ -113,6 +113,7 @@ class DeviceView:
         version: int,
         row_versions: Tuple[int, ...] = (),
         intern_version: int = 0,
+        values_milli: Optional[np.ndarray] = None,
     ):
         self.values = values
         self.present = present
@@ -121,6 +122,12 @@ class DeviceView:
         self.version = version
         self.row_versions = row_versions
         self.intern_version = intern_version
+        # host-readable copy of the milli-unit matrix, for decision
+        # provenance: decoding a device rule-index vector into "metric
+        # cpu=93 > threshold 80" needs the observed values WITHOUT a
+        # device readback (utils/decisions.py).  None in synthetic views
+        # built without it — reasons then omit the observed value.
+        self.values_milli = values_milli
 
     def row_version(self, row: int) -> int:
         return self.row_versions[row] if row < len(self.row_versions) else 0
@@ -426,13 +433,15 @@ class TensorStateMirror:
 
     def policies_snapshot(
         self,
-    ) -> Tuple[List[CompiledPolicy], DeviceView, Dict[str, bool]]:
-        """Atomic (all compiled policies, view, host-only metric map) under
+    ) -> Tuple[Dict[Tuple[str, str], CompiledPolicy], DeviceView, Dict[str, bool]]:
+        """Atomic ({(ns, name): policy}, view, host-only metric map) under
         one lock acquisition — for the fastpath warmer, which must see a
-        policy set consistent with the view it precomputes against."""
+        policy set consistent with the view it precomputes against.  Keys
+        ride along so the warmer can pre-render the per-policy violation
+        REASONS (the strings carry the policy name)."""
         with self._lock:
             return (
-                list(self._policies.values()),
+                dict(self._policies),
                 self._view_locked(),
                 dict(self._host_only_metrics),
             )
@@ -462,5 +471,6 @@ class TensorStateMirror:
                 self._row_versions.get(r, 0) for r in range(rows)
             ),
             intern_version=self._intern_version,
+            values_milli=self._values.copy(),
         )
         return self._view

@@ -47,7 +47,7 @@ from platform_aware_scheduling_tpu.tas.planner import (
     DEFAULT_NODE_CAPACITY,
     TAS_POLICY_LABEL,
 )
-from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils import decisions, klog, trace
 from platform_aware_scheduling_tpu.utils.quantity import Quantity
 
 DESCHEDULE_STRATEGY = "deschedule"
@@ -145,6 +145,7 @@ class Rebalancer:
                 }
                 with self._lock:
                     self._last_plan = record
+                decisions.DECISIONS.record_rebalance(dict(record))
                 klog.v(2).info_s(
                     f"rebalance cycle suspended: {reason}",
                     component="rebalance",
@@ -209,6 +210,20 @@ class Rebalancer:
         with self._lock:
             self._last_plan = record
         if plan.moves:
+            # decision provenance: the cycle itself becomes a record, and
+            # each planned pod's open Filter/Prioritize records gain the
+            # evict/skip outcome as an event (utils/decisions.py)
+            decisions.DECISIONS.record_rebalance(dict(record))
+            for move in actuation.executed:
+                decisions.DECISIONS.observe_rebalance(
+                    move.namespace, move.name, "evicted",
+                    f"{move.from_node} -> {move.to_node}",
+                )
+            for reason, skipped in actuation.skipped.items():
+                for move in skipped:
+                    decisions.DECISIONS.observe_rebalance(
+                        move.namespace, move.name, f"evict_skipped:{reason}"
+                    )
             klog.v(2).info_s(
                 f"rebalance cycle {cycle_no}: {len(plan.moves)} moves "
                 f"planned, {len(actuation.executed)} executed, "

@@ -293,7 +293,8 @@ class AsyncServer:
                 )
                 bare_path = path.partition("?")[0]
                 if bare_path in (
-                    "/metrics", "/debug/traces", "/debug/rebalance",
+                    "/metrics", "/debug", "/debug/", "/debug/traces",
+                    "/debug/decisions", "/debug/rebalance",
                     "/healthz", "/readyz",
                 ):
                     # observability endpoints bypass the admission queue:

@@ -35,13 +35,18 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from platform_aware_scheduling_tpu.ops.rules import OP_IDS
 from platform_aware_scheduling_tpu.ops.scoring import (
     batch_prioritize_kernel,
-    filter_kernel,
+    filter_explain_kernel,
     prioritize_kernel,
 )
 from platform_aware_scheduling_tpu.ops.state import CompiledPolicy, DeviceView
-from platform_aware_scheduling_tpu.utils import trace
+from platform_aware_scheduling_tpu.utils import decisions, trace
+
+# op id -> operator name, for decoding device rule indexes into the
+# shared reason strings (decisions.rule_reason keeps host parity)
+_OP_NAMES = {op_id: name for name, op_id in OP_IDS.items()}
 
 # rank -> b'<score>}' suffix bytes; grown on demand (scores are ordinal
 # 10 - rank and go negative past rank 10, telemetryscheduler.go:145)
@@ -130,9 +135,18 @@ class PrioritizeFastPath:
         self._table: Optional[_ViewTable] = None
         # (row_content_version, metric_row, op) -> int64 np global order
         self._rank: Dict[Tuple[int, int, int], np.ndarray] = {}
-        # (row-version tuple, rows, ruleset tensors) -> frozenset of
-        # violating row indices
-        self._violations: Dict[Tuple, frozenset] = {}
+        # (row-version tuple, rows, ruleset tensors) -> (frozenset of
+        # violating row indices, {row: first matching rule index}) — the
+        # rule map is the device's compact reason code per violating node
+        # (ops/scoring.filter_explain_kernel), decoded into reason
+        # strings once per state by violation_reasons()
+        self._violations: Dict[Tuple, Tuple[frozenset, Dict[int, int]]] = {}
+        # decoded provenance per (violation-set identity, policy name):
+        # [violations, policy_name, {name: reason str}, {name: rule idx},
+        #  encoded-reason-bytes-per-row list or None (built lazily for
+        #  the native filter_encode)] — MRU, shared by every request at
+        # one state so record creation stays O(1)
+        self._viol_reasons: List[list] = []
         # response-reuse cache: the kube-scheduler prioritizes every
         # pending pod against the same filter result, so consecutive
         # requests carry byte-identical candidate lists; entries are keyed
@@ -142,8 +156,13 @@ class PrioritizeFastPath:
         # false positives (no hashing trust).  List of
         # [ranked, table, planned_row, span_bytes, response], MRU first.
         self._responses: List[list] = []
-        # same idea for Filter: [violation_set, use_nn, span_bytes, body]
+        # same idea for Filter: [violation_set, use_nn, span_bytes, body,
+        # n_failed] — the failed-entry count rides along so decision
+        # records on cache hits stay O(1)
         self._filter_responses: List[list] = []
+        # [ranked, table, top-K (name, score) head] — the shared
+        # prioritize score breakdown decision records reference
+        self._explain_heads: List[list] = []
         # violation frozenset -> uint8-per-row bitmask bytes for the
         # native filter_encode; keyed by OBJECT identity (sets are
         # identity-stable per state) with the set itself held in the
@@ -363,23 +382,6 @@ class PrioritizeFastPath:
 
     # -- filter ----------------------------------------------------------------
 
-    def violating_names(
-        self, compiled: CompiledPolicy, view: DeviceView
-    ) -> Optional[Dict[str, None]]:
-        """The dontschedule violation set over all nodes, cached per rule
-        rows' content versions (request-independent, SURVEY §3.3); None
-        when the policy has no device-evaluable dontschedule rules."""
-        cached = self.violation_set(compiled, view)
-        if cached is None:
-            return None
-        # resolve rows back to names through the view (rows past the interned
-        # range are padding and never violate real nodes)
-        return {
-            view.node_names[i]: None
-            for i in cached
-            if i < len(view.node_names)
-        }
-
     def violation_set(
         self, compiled: CompiledPolicy, view: DeviceView
     ) -> Optional[frozenset]:
@@ -387,7 +389,114 @@ class PrioritizeFastPath:
         state — the Filter response cache keys on the OBJECT identity, so
         a state change (new frozenset) can never serve stale bytes."""
         result = self._violation_set_counted(compiled, view)
-        return result if result is None else result[0]
+        return result if result is None else result[0][0]
+
+    def violation_rule_map(
+        self, compiled: CompiledPolicy, view: DeviceView
+    ) -> Optional[Dict[int, int]]:
+        """{violating row: first matching rule index} at this state — the
+        device's raw reason codes (decoded by violation_reasons)."""
+        result = self._violation_set_counted(compiled, view)
+        return result if result is None else result[0][1]
+
+    def violation_reasons(
+        self, compiled: CompiledPolicy, view: DeviceView, policy_name: str
+    ):
+        """Decision provenance for one policy at the current state:
+        ``(violations frozenset, {node name: reason string},
+        {node name: rule index})`` — or None when the policy has no
+        device-evaluable dontschedule rules.
+
+        The maps are built ONCE per (violation set, policy) and shared by
+        reference across every request and decision record at that state;
+        the strings are byte-identical to the host path's
+        (dontschedule.violated_details) because both format the same
+        milli integers through decisions.rule_reason."""
+        counted = self._violation_set_counted(compiled, view)
+        if counted is None:
+            return None
+        violations, rule_map = counted[0]
+        entry = self._reason_entry(compiled, view, policy_name, violations, rule_map)
+        return violations, entry[2], entry[3]
+
+    def _reason_entry(
+        self,
+        compiled: CompiledPolicy,
+        view: DeviceView,
+        policy_name: str,
+        violations: frozenset,
+        rule_map: Dict[int, int],
+    ) -> list:
+        with self._lock:
+            for idx, entry in enumerate(self._viol_reasons):
+                if entry[0] is violations and entry[1] == policy_name:
+                    if idx:
+                        self._viol_reasons.insert(
+                            0, self._viol_reasons.pop(idx)
+                        )
+                    return entry
+        rules = compiled.dontschedule
+        reasons: Dict[str, str] = {}
+        indexes: Dict[str, int] = {}
+        n_names = len(view.node_names)
+        for row in sorted(rule_map):
+            if row >= n_names:
+                continue  # padding lanes never violate real nodes
+            ridx = rule_map[row]
+            metric = (
+                rules.metric_names[ridx]
+                if ridx < len(rules.metric_names)
+                else ""
+            )
+            operator = _OP_NAMES.get(int(rules.op_ids[ridx]), "?")
+            target_str = decisions.fmt_milli(int(rules.targets[ridx]))
+            if view.values_milli is not None:
+                value_str = decisions.fmt_milli(
+                    int(view.values_milli[int(rules.metric_rows[ridx]), row])
+                )
+            else:
+                value_str = "?"
+            name = view.node_names[row]
+            reasons[name] = decisions.rule_reason(
+                policy_name, metric, operator, value_str, target_str
+            )
+            indexes[name] = ridx
+        entry = [violations, policy_name, reasons, indexes, None]
+        with self._lock:
+            for existing in self._viol_reasons:
+                if existing[0] is violations and existing[1] == policy_name:
+                    return existing  # a concurrent builder won
+            self._viol_reasons.insert(0, entry)
+            del self._viol_reasons[self.RESPONSE_CACHE_SIZE :]
+        return entry
+
+    def reason_table(
+        self,
+        compiled: CompiledPolicy,
+        view: DeviceView,
+        policy_name: str,
+        violations: frozenset,
+        rule_map: Dict[int, int],
+        n_rows: int,
+    ) -> list:
+        """Per-row pre-JSON-encoded reason bytes (aligned with the
+        violation bitmask) for the native ``_wirec.filter_encode`` — the
+        C encoder splices entry bytes verbatim, so parity with the exact
+        path's json.dumps holds by construction.  Built lazily once per
+        (violation set, policy) and cached on the reason entry."""
+        entry = self._reason_entry(
+            compiled, view, policy_name, violations, rule_map
+        )
+        table = entry[4]
+        if table is None or len(table) < n_rows:
+            table = [None] * n_rows
+            index = view.node_index
+            for name, reason in entry[2].items():
+                row = index.get(name)
+                if row is not None and row < n_rows:
+                    table[row] = json.dumps(reason).encode()
+            entry[4] = table
+        return table
 
     def warm_violations(
         self, compiled: CompiledPolicy, view: DeviceView
@@ -402,7 +511,10 @@ class PrioritizeFastPath:
     def _violation_set_counted(
         self, compiled: CompiledPolicy, view: DeviceView
     ):
-        """(violation frozenset, computed-now?) or None (no device rules)."""
+        """((violation frozenset, {row: rule index}), computed-now?) or
+        None (no device rules).  One fused device pass produces both the
+        verdict and the per-node first-matching-rule index — the compact
+        provenance vector decoded host-side by violation_reasons()."""
         rules = compiled.dontschedule
         if rules is None:
             return None
@@ -422,14 +534,18 @@ class PrioritizeFastPath:
         device_rules = compiled.device_rules("dontschedule")
         if device_rules is None:
             return None
-        passing = filter_kernel(
+        res = filter_explain_kernel(
             view.values,
             view.present,
             device_rules,
             jnp.ones(view.node_capacity, dtype=bool),
         )
-        bad = ~np.asarray(passing)
-        cached = frozenset(int(i) for i in np.nonzero(bad)[0])
+        first_rule = np.asarray(res.first_rule)
+        rows = np.nonzero(first_rule >= 0)[0]
+        cached = (
+            frozenset(int(i) for i in rows),
+            {int(i): int(first_rule[i]) for i in rows},
+        )
         with self._lock:
             # a concurrent computer may have won: keep ITS set so the
             # identity-keyed response caches see one object per state
@@ -462,24 +578,43 @@ class PrioritizeFastPath:
         return mask_bytes
 
     def filter_parsed(
-        self, wirec, view: DeviceView, parsed, violations: frozenset
-    ) -> bytes:
+        self,
+        wirec,
+        view: DeviceView,
+        parsed,
+        violations: frozenset,
+        compiled: Optional[CompiledPolicy] = None,
+        policy_name: str = "",
+    ) -> Tuple[bytes, int]:
         """Native NodeNames-mode Filter response: candidate row lookup,
         violation partition, and byte assembly all happen in
         ``_wirec.filter_encode`` over the parsed body's zero-copy name
         slices — the Filter analog of :meth:`prioritize_parsed` (byte
-        parity with the exact path pinned by tests/test_wirec.py)."""
+        parity with the exact path pinned by tests/test_wirec.py).
+
+        Returns ``(body, failed count)``.  With ``compiled`` given, the
+        FailedNodes values carry the concrete per-rule reason strings
+        (pre-encoded once per state via :meth:`reason_table`); without it
+        the reference literal "Node violates" is emitted."""
         table = self._table_for(view)
-        mask = self._violation_mask(violations, len(table.node_names))
-        return wirec.filter_encode(parsed, table.native(wirec), mask)
+        n_rows = len(table.node_names)
+        mask = self._violation_mask(violations, n_rows)
+        reasons = None
+        if compiled is not None:
+            rule_map = self.violation_rule_map(compiled, view)
+            if rule_map is not None:
+                reasons = self.reason_table(
+                    compiled, view, policy_name, violations, rule_map, n_rows
+                )
+        return wirec.filter_encode(parsed, table.native(wirec), mask, reasons)
 
     # -- filter response reuse -------------------------------------------------
 
     def filter_lookup(
         self, violations: frozenset, use_node_names: bool, parsed
-    ) -> Optional[bytes]:
-        """Cached Filter response bytes for this exact candidate span under
-        this exact violation set, or None."""
+    ) -> Optional[Tuple[bytes, int]]:
+        """Cached (response bytes, failed count) for this exact candidate
+        span under this exact violation set, or None."""
         with self._lock:
             responses = self._filter_responses
             for idx, entry in enumerate(responses):
@@ -490,11 +625,16 @@ class PrioritizeFastPath:
                 ):
                     if idx:
                         responses.insert(0, responses.pop(idx))
-                    return entry[3]
+                    return entry[3], entry[4]
         return None
 
     def filter_store(
-        self, violations: frozenset, use_node_names: bool, parsed, body: bytes
+        self,
+        violations: frozenset,
+        use_node_names: bool,
+        parsed,
+        body: bytes,
+        n_failed: int = 0,
     ) -> None:
         span = (
             parsed.node_names_span() if use_node_names else parsed.nodes_span()
@@ -503,6 +643,42 @@ class PrioritizeFastPath:
             return
         with self._lock:
             self._filter_responses.insert(
-                0, [violations, use_node_names, span, body]
+                0, [violations, use_node_names, span, body, n_failed]
             )
             del self._filter_responses[self.RESPONSE_CACHE_SIZE :]
+
+    # -- decision provenance ---------------------------------------------------
+
+    def explain_prioritize(
+        self, compiled: CompiledPolicy, view: DeviceView, k: int = 10
+    ):
+        """(score head, ranked, node_index) for one policy at the current
+        state: the top-``k`` ``(node, ordinal score)`` pairs of the
+        GLOBAL ranking (shared by reference across every decision record
+        at this state — O(1) per request after the first) plus the raw
+        ranking + interning table for exact chosen-rank lookup at bind
+        time (utils/decisions.DecisionRecord.chosen_rank)."""
+        table = self._table_for(view)
+        ranked = self._ranking(
+            view,
+            compiled.scheduleonmetric_row,
+            compiled.scheduleonmetric_op,
+        )
+        with self._lock:
+            for idx, entry in enumerate(self._explain_heads):
+                if entry[0] is ranked and entry[1] is table:
+                    if idx:
+                        self._explain_heads.insert(
+                            0, self._explain_heads.pop(idx)
+                        )
+                    return entry[2], ranked, table.node_index
+        names = table.node_names
+        head = [
+            (names[r], 10 - i)
+            for i, r in enumerate(ranked[:k].tolist())
+            if r < len(names)
+        ]
+        with self._lock:
+            self._explain_heads.insert(0, [ranked, table, head])
+            del self._explain_heads[self.RESPONSE_CACHE_SIZE :]
+        return head, ranked, table.node_index

@@ -8,14 +8,14 @@ OR-semantics across rules: a node violating ANY rule is in the violation set
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
     TASPolicyRule,
     TASPolicyStrategy,
 )
 from platform_aware_scheduling_tpu.tas.strategies import core
-from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils import decisions, klog, trace
 
 STRATEGY_TYPE = "dontschedule"
 
@@ -32,24 +32,51 @@ class Strategy:
     def violated(self, cache) -> Dict[str, None]:
         """Nodes whose current metric values violate any rule
         (strategy.go:25-44).  Unreadable metrics are skipped."""
+        return {name: None for name in self.violated_details(cache)}
+
+    def violated_details(self, cache) -> Dict[str, Tuple[int, str]]:
+        """Violation provenance: ``{node: (first matching rule index,
+        reason string)}``.  "First" is rule-list order (lowest index
+        wins), matching the device path's argmax-over-rules exactly
+        (ops/rules.first_violated_rule); the reason string formats the
+        SAME milli integers the device mirror stores, so host and native
+        Filter responses carry byte-identical FailedNodes values
+        (pinned by tests/test_decisions.py)."""
         trace.COUNTERS.inc(
             "pas_strategy_evaluations_total", labels={"strategy": STRATEGY_TYPE}
         )
-        violating: Dict[str, None] = {}
-        for rule in self.rules:
+        violating: Dict[str, Tuple[int, str]] = {}
+        for rule_index, rule in enumerate(self.rules):
             try:
                 node_metrics = cache.read_metric(rule.metricname)
             except Exception as exc:
                 klog.v(2).info_s(str(exc), component="controller")
                 continue
             for node_name, node_metric in node_metrics.items():
+                if node_name in violating:
+                    continue  # an earlier rule already claimed this node
                 if core.evaluate_rule(node_metric.value, rule):
                     klog.v(2).info_s(
                         f"{node_name} violating {self.policy_name}: "
                         f"{rule.metricname} {rule.operator} {rule.target}",
                         component="controller",
                     )
-                    violating[node_name] = None
+                    milli, exact = node_metric.value.milli_value_exact()
+                    value_str = (
+                        decisions.fmt_milli(milli)
+                        if exact
+                        else node_metric.value.as_dec()
+                    )
+                    violating[node_name] = (
+                        rule_index,
+                        decisions.rule_reason(
+                            self.policy_name,
+                            rule.metricname,
+                            rule.operator,
+                            value_str,
+                            str(rule.target),
+                        ),
+                    )
         if violating:
             trace.COUNTERS.inc(
                 "pas_strategy_violations_total",

@@ -10,8 +10,13 @@ telemetryscheduler.go.  Wire behavior is reproduced quirk-for-quirk
     handler STILL runs and writes ``[]`` (no return after WriteHeader,
     telemetryscheduler.go:50-53);
   * a nil filter result is 404 with body ``null`` (:170-175);
-  * FailedNodes messages are the literal "Node violates" (the reference's
-    one-element strings.Join never uses its separator, :206);
+  * FailedNodes messages carry the CONCRETE violation reason ("policy P:
+    metric cpu=93 > threshold 80" — docs/observability.md "Decision
+    provenance") where the reference emitted the opaque literal
+    "Node violates" (:206); native and host paths produce byte-identical
+    strings (tests/test_decisions.py), a deliberate wire improvement
+    within the scheduler's contract (FailedNodes values are
+    free-form diagnostics);
   * in the legacy Nodes branch FilterResult.NodeNames is built by
     splitting "n1 n2 " on spaces and so carries a trailing empty string
     (:212) — harmless there because the scheduler ignores NodeNames; the
@@ -59,7 +64,7 @@ from platform_aware_scheduling_tpu.native import get_wirec
 from platform_aware_scheduling_tpu.tas.fastpath import PrioritizeFastPath
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
 from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
-from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils import decisions, klog, trace
 from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
 
 import jax.numpy as jnp
@@ -139,13 +144,15 @@ class MetricsExtender:
 
             pairs = {
                 (compiled.scheduleonmetric_row, compiled.scheduleonmetric_op)
-                for compiled in policies
+                for compiled in policies.values()
                 if self._prioritize_device_eligible(compiled, host_only)
             }
             fastpath.precompute(view, pairs, wirec=get_wirec())
-            for compiled in policies:
+            for (_ns, name), compiled in policies.items():
                 if self._filter_device_eligible(compiled, host_only):
-                    fastpath.violating_names(compiled, view)
+                    # one call warms the violation set AND its decoded
+                    # provenance (reason strings keyed by policy name)
+                    fastpath.violation_reasons(compiled, view, name)
             self._warmed = True
         except Exception as exc:  # warming must never break the writer
             klog.error("fastpath warm failed: %s", exc)
@@ -319,11 +326,16 @@ class MetricsExtender:
         decoded = self._decode_prioritize_args(request, span)
         if isinstance(decoded, HTTPResponse):
             return decoded
-        _args, names, status = decoded
+        args, names, status = decoded
         with span.stage("encode"):
             body = encode_host_priority_list(
                 [HostPriority(host=name, score=0) for name in names]
             )
+        self._record_prioritize(
+            span, args.pod.namespace, args.pod.name,
+            args.pod.get_labels().get(TAS_POLICY_LABEL, ""),
+            "neutral", None, len(names),
+        )
         return HTTPResponse.json(body, status=status)
 
     def filter(self, request: HTTPRequest) -> HTTPResponse:
@@ -372,7 +384,29 @@ class MetricsExtender:
             if probe is not None:
                 parsed, violations, use_node_names = probe
                 self.fastpath.filter_store(
-                    violations, use_node_names, parsed, body
+                    violations, use_node_names, parsed, body,
+                    len(result.failed_nodes),
+                )
+            if decisions.DECISIONS.enabled:
+                path = span.attrs.get("filter_cache", "exact")
+                reason_code = decisions.CODE_RULE_VIOLATION
+                if degraded_action == degraded_mode.ACTION_FAIL_CLOSED:
+                    path = "fail_closed"
+                    reason_code = decisions.CODE_FAIL_CLOSED
+                elif degraded_action == degraded_mode.ACTION_FAIL_OPEN:
+                    path = "fail_open"
+                candidates = self._candidate_names(args)
+                decisions.DECISIONS.record_filter(
+                    request_id=span.trace_id,
+                    pod_namespace=args.pod.namespace,
+                    pod_name=args.pod.name,
+                    policy=args.pod.get_labels().get(TAS_POLICY_LABEL, ""),
+                    path=path,
+                    candidates=len(candidates),
+                    filtered=len(result.failed_nodes),
+                    violating=dict(result.failed_nodes),
+                    violating_scope="request",
+                    reason_code=reason_code,
                 )
             return HTTPResponse.json(body)
         finally:
@@ -420,15 +454,29 @@ class MetricsExtender:
             compiled, view = self._device_policy(policy)
             if compiled is None or not self._device_filter_ok(compiled):
                 return None
-            violations = self.fastpath.violation_set(compiled, view)
-            if violations is None:
+            # one call resolves the violation set AND its decoded per-node
+            # provenance (the shared reason map the wire FailedNodes and
+            # the decision records both reference)
+            explained = self.fastpath.violation_reasons(
+                compiled, view, policy.name
+            )
+            if explained is None:
                 return None
-            body = self.fastpath.filter_lookup(
+            violations, reasons, _indexes = explained
+            candidates = (
+                parsed.num_node_names if use_node_names else parsed.num_nodes
+            )
+            cached = self.fastpath.filter_lookup(
                 violations, use_node_names, parsed
             )
-            if body is not None:
+            if cached is not None:
+                body, n_failed = cached
                 span.set("filter_cache", "hit")
                 trace.COUNTERS.inc("pas_filter_cache_hit_total")
+                self._record_device_filter(
+                    span, parsed, policy_name, "cache_hit",
+                    candidates, n_failed, reasons,
+                )
                 return HTTPResponse.json(body)
             if use_node_names and hasattr(wirec, "filter_encode"):
                 # span-cache miss, NodeNames mode: build the response
@@ -438,14 +486,18 @@ class MetricsExtender:
                 # The miss counts ONLY once the encode succeeded — a
                 # raise here lands in the outer except -> None -> the
                 # caller counts it a bypass, never miss+bypass
-                body = self.fastpath.filter_parsed(
-                    wirec, view, parsed, violations
+                body, n_failed = self.fastpath.filter_parsed(
+                    wirec, view, parsed, violations, compiled, policy.name
                 )
                 self.fastpath.filter_store(
-                    violations, use_node_names, parsed, body
+                    violations, use_node_names, parsed, body, n_failed
                 )
                 span.set("filter_cache", "miss")
                 trace.COUNTERS.inc("pas_filter_cache_miss_total")
+                self._record_device_filter(
+                    span, parsed, policy_name, "native",
+                    candidates, n_failed, reasons,
+                )
                 return HTTPResponse.json(body)
             # cacheable but missed: the exact path builds (and stores) the
             # response via the returned token — still a miss
@@ -461,8 +513,45 @@ class MetricsExtender:
             klog.error("filter cache probe failed, exact path: %s", exc)
             return None
 
+    def _record_device_filter(
+        self, span, parsed, policy_name, path, candidates, n_failed, reasons
+    ) -> None:
+        """Decision record for the device Filter paths: O(1) — per-node
+        detail is the SHARED per-state reason map, counts come from the
+        native encoder / the response-cache entry."""
+        if not decisions.DECISIONS.enabled:
+            return
+        decisions.DECISIONS.record_filter(
+            request_id=span.trace_id,
+            pod_namespace=parsed.pod_namespace or "",
+            pod_name=parsed.pod_name or "",
+            policy=policy_name,
+            path=path,
+            candidates=int(candidates),
+            filtered=int(n_failed),
+            violating=reasons,
+            violating_scope="policy_state",
+        )
+
     def bind(self, request: HTTPRequest) -> HTTPResponse:
-        # TAS does not implement Bind (telemetryscheduler.go:179-181)
+        # TAS does not implement Bind (telemetryscheduler.go:179-181) —
+        # the 404 wire behavior is untouched, but the body (the real
+        # kube-scheduler POSTs BindingArgs regardless) is the decision
+        # log's outcome feedback: which node the pod actually landed on
+        # closes the pod's open Filter/Prioritize records
+        if decisions.DECISIONS.enabled and request.body:
+            try:
+                from platform_aware_scheduling_tpu.extender.types import (
+                    BindingArgs,
+                )
+
+                args = BindingArgs.from_json(request.body)
+                if args.pod_name and args.node:
+                    decisions.DECISIONS.observe_bind(
+                        args.pod_namespace, args.pod_name, args.node
+                    )
+            except Exception:
+                pass  # feedback is best-effort; the verb stays a 404
         return HTTPResponse(status=404)
 
     # -- native fast path ------------------------------------------------------
@@ -532,6 +621,9 @@ class MetricsExtender:
             self.planner.planned_node(pod) if self.planner is not None else None
         )
         compiled, view = self._device_policy(policy)
+        candidates = (
+            parsed.num_node_names if use_node_names else parsed.num_nodes
+        )
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
                 body = self.fastpath.prioritize_parsed(
@@ -540,6 +632,11 @@ class MetricsExtender:
                 )
                 span.set("path", "native")
                 trace.COUNTERS.inc("pas_prioritize_native_total")
+                self._record_prioritize(
+                    span, namespace, parsed.pod_name or "", policy_name,
+                    "native", rule, int(candidates), planned,
+                    compiled=compiled, view=view,
+                )
                 return HTTPResponse.json(body, status)
             except Exception as exc:
                 trace.COUNTERS.inc("pas_prioritize_host_fallback_total")
@@ -556,7 +653,59 @@ class MetricsExtender:
         # partition counter only once the answer actually exists — an
         # exception above falls to the exact path, which counts itself
         trace.COUNTERS.inc("pas_prioritize_native_host_total")
+        self._record_prioritize(
+            span, namespace, parsed.pod_name or "", policy_name,
+            "native_host", rule, int(candidates), planned, result=result,
+        )
         return HTTPResponse.json(body, status)
+
+    def _record_prioritize(
+        self,
+        span,
+        namespace: str,
+        pod_name: str,
+        policy_name: str,
+        path: str,
+        rule: Optional[TASPolicyRule],
+        candidates: int,
+        planned: Optional[str] = None,
+        compiled: Optional[CompiledPolicy] = None,
+        view: Optional[DeviceView] = None,
+        result: Optional[List[HostPriority]] = None,
+    ) -> None:
+        """One Prioritize decision record.  Device-path records reference
+        the SHARED per-state score head + ranking (O(1) per request);
+        host-path records copy the already-materialized top of their own
+        result list.  Never raises into the verb."""
+        log = decisions.DECISIONS
+        if not log.enabled:
+            return
+        try:
+            head: List = []
+            ranked = None
+            node_index = None
+            if compiled is not None and view is not None:
+                head, ranked, node_index = self.fastpath.explain_prioritize(
+                    compiled, view
+                )
+            elif result:
+                head = [(hp.host, hp.score) for hp in result[:10]]
+            log.record_prioritize(
+                request_id=span.trace_id,
+                pod_namespace=namespace,
+                pod_name=pod_name,
+                policy=policy_name,
+                path=path,
+                candidates=candidates,
+                metric=rule.metricname if rule is not None else "",
+                operator=rule.operator if rule is not None else "",
+                score_head=head,
+                planned=planned,
+                ranked=ranked,
+                node_index=node_index,
+            )
+        except Exception as exc:  # provenance must never fail the verb
+            klog.error("prioritize decision record failed: %r", exc)
 
     # -- decode ---------------------------------------------------------------
 
@@ -619,6 +768,11 @@ class MetricsExtender:
                     compiled, view, names, planned, span=span
                 )
                 span.set("path", "device")
+                self._record_prioritize(
+                    span, args.pod.namespace, args.pod.name, policy.name,
+                    "device", rule, len(names), planned,
+                    compiled=compiled, view=view,
+                )
                 return body
             except Exception as exc:  # device trouble must never fail the verb
                 trace.COUNTERS.inc("pas_prioritize_host_fallback_total")
@@ -629,7 +783,12 @@ class MetricsExtender:
                 args.pod, self._prioritize_host(rule, names)
             )
         with span.stage("encode"):
-            return encode_host_priority_list(result)
+            body = encode_host_priority_list(result)
+        self._record_prioritize(
+            span, args.pod.namespace, args.pod.name, policy.name,
+            "host", rule, len(names), result=result,
+        )
+        return body
 
     def _apply_plan(
         self, pod: Pod, result: List[HostPriority]
@@ -695,14 +854,16 @@ class MetricsExtender:
             )
             return None
         if degraded == degraded_mode.ACTION_FAIL_OPEN:
-            violating: Dict[str, None] = {}
+            violating: Dict[str, str] = {}
         elif degraded == degraded_mode.ACTION_FAIL_CLOSED:
             names = (
                 [node.name for node in args.nodes]
                 if args.nodes
                 else list(args.node_names or [])
             )
-            violating = {name: None for name in names}
+            violating = {
+                name: decisions.REASON_FAIL_CLOSED for name in names
+            }
         else:
             violating = self._violating_nodes(policy, strategy)
         if not args.nodes:
@@ -715,7 +876,7 @@ class MetricsExtender:
         available = ""
         for node in args.nodes:
             if node.name in violating:
-                failed[node.name] = "Node violates"
+                failed[node.name] = violating[node.name]
             else:
                 filtered.append(node)
                 available += node.name + " "
@@ -730,7 +891,7 @@ class MetricsExtender:
         )
 
     def _filter_node_names(
-        self, policy: TASPolicy, names: List[str], violating: Dict[str, None]
+        self, policy: TASPolicy, names: List[str], violating: Dict[str, str]
     ) -> FilterResult:
         """nodeCacheCapable Filter: answer with NodeNames only (the
         kube-scheduler reads NodeNames from a nodeCacheCapable extender;
@@ -745,7 +906,7 @@ class MetricsExtender:
         node_names: List[str] = []
         for name in names:
             if name in violating:
-                failed[name] = "Node violates"
+                failed[name] = violating[name]
             else:
                 node_names.append(name)
         if node_names:
@@ -760,16 +921,25 @@ class MetricsExtender:
 
     def _violating_nodes(
         self, policy: TASPolicy, strategy: dontschedule.Strategy
-    ) -> Dict[str, None]:
+    ) -> Dict[str, str]:
+        """{violating node: concrete reason string}.  The device path's
+        strings decode the kernel's rule-index vector; the host path's
+        come from violated_details — byte-identical wherever both can
+        run (tests/test_decisions.py pins the parity)."""
         compiled, view = self._device_policy(policy)
         if compiled is not None and self._device_filter_ok(compiled):
             try:
-                violating = self.fastpath.violating_names(compiled, view)
-                if violating is not None:
-                    return violating
+                explained = self.fastpath.violation_reasons(
+                    compiled, view, policy.name
+                )
+                if explained is not None:
+                    return explained[1]
             except Exception as exc:
                 klog.error("device filter failed, host fallback: %s", exc)
-        return strategy.violated(self.cache)
+        return {
+            name: detail[1]
+            for name, detail in strategy.violated_details(self.cache).items()
+        }
 
     # -- shared helpers --------------------------------------------------------
 

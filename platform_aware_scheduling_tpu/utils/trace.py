@@ -144,6 +144,16 @@ declare("pas_kube_giveup_total", "counter", "API calls abandoned after exhaustin
 declare("pas_circuit_state", "gauge", "Circuit-breaker state per endpoint group: 0 closed, 1 half-open, 2 open (label: group).")
 declare("pas_circuit_transitions_total", "counter", "Circuit-breaker state transitions (labels: group, to).")
 declare("pas_degraded", "gauge", "1 while the named subsystem runs degraded: telemetry (stale/unrefreshable), kube_api / metrics_api (circuit not closed), evictions (suspended) (label: subsystem).")
+# decision provenance (utils/decisions.py: per-decision explain records,
+# placement-quality feedback, /debug/decisions; docs/observability.md
+# "Decision provenance")
+declare("pas_decision_records_total", "counter", "Scheduling decisions recorded into the decision log (label: verb in filter/prioritize/gas_filter/rebalance).")
+declare("pas_decision_filtered_nodes_total", "counter", "Nodes filtered out of scheduling decisions, by reason class (label: reason in rule_violation/fail_closed/gas_unknown_node/gas_no_gpus/gas_capacity/gas_error).")
+declare("pas_decision_open", "gauge", "Decision records currently awaiting outcome feedback (pod bind / rebalance).")
+declare("pas_decision_closed_total", "counter", "Decision records closed by a pod-bind observation.")
+declare("pas_decision_violated_at_bind_total", "counter", "Pods bound onto a node the Filter decision had marked violating — the placement-quality red flag.")
+declare("pas_decision_chosen_rank_total", "counter", "Bind observations by the chosen node's rank in the Prioritize ordering (label: rank in 1/2/3/4_8/9_16/17_plus/unknown).")
+declare("pas_decision_evicted_open_total", "counter", "Open decision records overwritten by the ring before any outcome feedback arrived (ring too small for the bind latency).")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where
@@ -373,19 +383,49 @@ class TraceBuffer:
         with self._lock:
             return len(self._recent)
 
-    def snapshot(self) -> Dict:
+    def snapshot(
+        self,
+        verb: Optional[str] = None,
+        min_ms: Optional[float] = None,
+    ) -> Dict:
+        """Both lists, optionally filtered: ``verb`` keeps spans whose
+        ``verb`` attribute matches, ``min_ms`` keeps spans at least that
+        slow — the /debug/traces ``?verb=`` / ``?min_ms=`` query params."""
         with self._lock:
             recent = list(self._recent)
             slow = list(self._slow)
-        return {
+
+        def keep(span: Span) -> bool:
+            if verb is not None and span.attrs.get("verb") != verb:
+                return False
+            if min_ms is not None and (span.duration_s or 0.0) * 1e3 < min_ms:
+                return False
+            return True
+
+        if verb is not None or min_ms is not None:
+            recent = [s for s in recent if keep(s)]
+            slow = [s for s in slow if keep(s)]
+        out = {
             "capacity": self.capacity,
             "slow_capacity": self.slow_capacity,
             "recent": [s.to_dict() for s in recent],
             "slowest": [s.to_dict() for s in slow],
         }
+        if verb is not None:
+            out["verb"] = verb
+        if min_ms is not None:
+            out["min_ms"] = min_ms
+        return out
 
-    def to_json(self) -> bytes:
-        return json.dumps(self.snapshot()).encode() + b"\n"
+    def to_json(
+        self,
+        verb: Optional[str] = None,
+        min_ms: Optional[float] = None,
+    ) -> bytes:
+        return (
+            json.dumps(self.snapshot(verb=verb, min_ms=min_ms)).encode()
+            + b"\n"
+        )
 
 
 #: the process-wide buffer both front-ends record into

@@ -1,0 +1,579 @@
+"""Decision provenance (utils/decisions.py, ISSUE 6): reason-code parity
+host↔device, concrete wire FailedNodes reasons identical on both
+internal paths, ring bounds/eviction, /debug/decisions + /debug + the
+/debug/traces filters on both front-ends, bind feedback closing records,
+and the rebalance event linkage."""
+
+import json
+
+import pytest
+
+from benchmarks.http_load import build_extender, make_bodies
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    Server,
+)
+from platform_aware_scheduling_tpu.native import get_wirec
+from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+from platform_aware_scheduling_tpu.tas.strategies import dontschedule
+from platform_aware_scheduling_tpu.tas.telemetryscheduler import MetricsExtender
+from platform_aware_scheduling_tpu.testing.builders import make_policy, rule
+from platform_aware_scheduling_tpu.utils import decisions, trace
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+from wirehelpers import post_bytes, raw_request, start_async
+
+
+@pytest.fixture(autouse=True)
+def fresh_log():
+    """Each test gets a clean, enabled process-wide log and restores the
+    default configuration afterwards."""
+    decisions.DECISIONS.configure(enabled=True, capacity=512)
+    yield decisions.DECISIONS
+    decisions.DECISIONS.configure(enabled=True, capacity=512)
+
+
+VALUES = {"n1": 100, "n2": 50, "n3": 10, "n4": 70}
+
+
+def build(values=None, rules_spec=None, node_cache_capable=True):
+    values = values or VALUES
+    cache = AutoUpdatingCache()
+    mirror = TensorStateMirror()
+    mirror.attach(cache)
+    cache.write_policy(
+        "default",
+        "pol",
+        TASPolicy.from_obj(
+            make_policy(
+                "pol",
+                strategies={
+                    "scheduleonmetric": [rule("m", "GreaterThan", 0)],
+                    "dontschedule": rules_spec
+                    or [rule("m", "GreaterThan", 75)],
+                },
+            )
+        ),
+    )
+    cache.write_metric(
+        "m", {n: NodeMetric(value=Quantity(str(v))) for n, v in values.items()}
+    )
+    ext = MetricsExtender(
+        cache, mirror=mirror, node_cache_capable=node_cache_capable
+    )
+    return cache, ext
+
+
+def req(path, body, method="POST"):
+    return HTTPRequest(
+        method=method,
+        path=path,
+        headers={"Content-Type": "application/json"},
+        body=body,
+    )
+
+
+def nn_body(names, pod="p", policy="pol"):
+    meta = {"name": pod, "namespace": "default"}
+    if policy:
+        meta["labels"] = {"telemetry-policy": policy}
+    return json.dumps({"Pod": {"metadata": meta}, "NodeNames": names}).encode()
+
+
+def bind_body(pod="p", node="n2"):
+    return json.dumps(
+        {
+            "PodName": pod,
+            "PodNamespace": "default",
+            "PodUID": "uid-1",
+            "Node": node,
+        }
+    ).encode()
+
+
+class TestReasonFormatting:
+    def test_fmt_milli(self):
+        assert decisions.fmt_milli(93000) == "93"
+        assert decisions.fmt_milli(500) == "0.5"
+        assert decisions.fmt_milli(-2500) == "-2.5"
+        assert decisions.fmt_milli(0) == "0"
+        assert decisions.fmt_milli(1001) == "1.001"
+        assert decisions.fmt_milli(1100) == "1.1"
+
+    def test_rule_reason_matches_issue_shape(self):
+        assert (
+            decisions.rule_reason("X", "cpu", "GreaterThan", "93", "80")
+            == "policy X: metric cpu=93 > threshold 80"
+        )
+        assert "<" in decisions.rule_reason("X", "m", "LessThan", "1", "2")
+        assert "==" in decisions.rule_reason("X", "m", "Equals", "1", "1")
+
+
+class TestReasonCodeParity:
+    """The tentpole invariant: the device kernel's rule-index vector,
+    decoded host-side, must equal the host strategy's first-matching-rule
+    recording — indexes AND strings, byte for byte."""
+
+    def _device_reasons(self, ext):
+        policy = ext.cache.read_policy("default", "pol")
+        compiled, view = ext._device_policy(policy)
+        explained = ext.fastpath.violation_reasons(compiled, view, "pol")
+        assert explained is not None
+        return explained
+
+    def _host_reasons(self, ext):
+        policy = ext.cache.read_policy("default", "pol")
+        strategy = dontschedule.Strategy.from_policy_strategy(
+            policy.strategies["dontschedule"]
+        )
+        return strategy.violated_details(ext.cache)
+
+    def test_single_rule_parity(self):
+        _, ext = build()
+        _violations, dev_reasons, dev_indexes = self._device_reasons(ext)
+        host = self._host_reasons(ext)
+        assert dev_reasons == {n: d[1] for n, d in host.items()}
+        assert dev_indexes == {n: d[0] for n, d in host.items()}
+        assert dev_reasons == {
+            "n1": "policy pol: metric m=100 > threshold 75"
+        }
+
+    def test_multi_rule_first_match_wins_identically(self):
+        # n1=100 matches BOTH rules -> index 0 on both paths; n3=10
+        # matches only rule 1
+        _, ext = build(
+            rules_spec=[
+                rule("m", "GreaterThan", 75),
+                rule("m", "LessThan", 20),
+            ]
+        )
+        _violations, dev_reasons, dev_indexes = self._device_reasons(ext)
+        host = self._host_reasons(ext)
+        assert dev_indexes == {"n1": 0, "n3": 1}
+        assert dev_indexes == {n: d[0] for n, d in host.items()}
+        assert dev_reasons == {n: d[1] for n, d in host.items()}
+        assert dev_reasons["n3"] == "policy pol: metric m=10 < threshold 20"
+
+    def test_fractional_values_format_identically(self):
+        _, ext = build(values={"n1": "1500m", "n2": "250m"}, rules_spec=[
+            rule("m", "GreaterThan", 1),
+        ])
+        _v, dev_reasons, _i = self._device_reasons(ext)
+        host = self._host_reasons(ext)
+        assert dev_reasons == {n: d[1] for n, d in host.items()}
+        assert dev_reasons == {
+            "n1": "policy pol: metric m=1.5 > threshold 1"
+        }
+
+
+class TestWireReasons:
+    """Satellite 1: every filtered node in a Filter response carries the
+    concrete reason, identical on native and host paths."""
+
+    def test_failed_nodes_values_native_vs_host(self, monkeypatch):
+        _, ext = build()
+        body = nn_body(["n1", "n2", "n3", "n4"])
+        native = ext.filter(req("/scheduler/filter", body))
+        monkeypatch.setenv("PAS_TPU_NO_NATIVE", "1")
+        python = ext.filter(req("/scheduler/filter", body))
+        monkeypatch.delenv("PAS_TPU_NO_NATIVE")
+        assert native.body == python.body
+        out = json.loads(native.body)
+        assert out["FailedNodes"] == {
+            "n1": "policy pol: metric m=100 > threshold 75"
+        }
+
+    def test_nodes_mode_carries_reasons_too(self):
+        _, ext = build()
+        body = json.dumps(
+            {
+                "Pod": {
+                    "metadata": {
+                        "name": "p",
+                        "namespace": "default",
+                        "labels": {"telemetry-policy": "pol"},
+                    }
+                },
+                "Nodes": {
+                    "items": [
+                        {"metadata": {"name": n}} for n in ("n1", "n2")
+                    ]
+                },
+            }
+        ).encode()
+        out = json.loads(ext.filter(req("/scheduler/filter", body)).body)
+        assert out["FailedNodes"] == {
+            "n1": "policy pol: metric m=100 > threshold 75"
+        }
+
+
+class TestRecords:
+    def test_filter_and_prioritize_record(self):
+        _, ext = build()
+        ext.prioritize(req("/scheduler/prioritize", nn_body(list(VALUES))))
+        ext.filter(req("/scheduler/filter", nn_body(list(VALUES))))
+        snap = decisions.DECISIONS.snapshot()
+        assert snap["recorded_total"] == 2
+        verbs = {r["verb"] for r in snap["records"]}
+        assert verbs == {"prioritize", "filter"}
+        fil = [r for r in snap["records"] if r["verb"] == "filter"][0]
+        assert fil["pod"] == "default/p"
+        assert fil["policy"] == "pol"
+        assert fil["candidates"] == 4
+        assert fil["filtered"] == 1
+        assert fil["violating"] == {
+            "n1": "policy pol: metric m=100 > threshold 75"
+        }
+        pri = [r for r in snap["records"] if r["verb"] == "prioritize"][0]
+        assert pri["metric"] == "m"
+        assert pri["operator"] == "GreaterThan"
+        # score head: global ranking desc — n1(100) first
+        assert pri["score_head"][0] == {"node": "n1", "score": 10}
+
+    def test_cache_hit_still_records(self):
+        _, ext = build()
+        body = nn_body(list(VALUES))
+        ext.filter(req("/scheduler/filter", body))
+        ext.filter(req("/scheduler/filter", nn_body(list(VALUES), pod="q")))
+        snap = decisions.DECISIONS.snapshot(verb="filter")
+        assert snap["returned"] == 2
+        paths = sorted(r["path"] for r in snap["records"])
+        assert "cache_hit" in paths
+        hit = [r for r in snap["records"] if r["path"] == "cache_hit"][0]
+        assert hit["filtered"] == 1  # count rode the response-cache entry
+
+    def test_disabled_log_records_nothing(self):
+        decisions.DECISIONS.configure(enabled=False)
+        _, ext = build()
+        ext.filter(req("/scheduler/filter", nn_body(list(VALUES))))
+        assert len(decisions.DECISIONS) == 0
+
+    def test_ring_bounds_and_open_eviction(self):
+        decisions.DECISIONS.configure(enabled=True, capacity=4)
+        before = trace.COUNTERS.get("pas_decision_evicted_open_total")
+        _, ext = build()
+        for i in range(7):
+            ext.filter(
+                req("/scheduler/filter", nn_body(list(VALUES), pod=f"p{i}"))
+            )
+        assert len(decisions.DECISIONS) == 4
+        snap = decisions.DECISIONS.snapshot(limit=100)
+        assert snap["returned"] == 4
+        assert snap["open"] == 4
+        # three open records were overwritten before any feedback
+        assert (
+            trace.COUNTERS.get("pas_decision_evicted_open_total")
+            == before + 3
+        )
+
+    def test_request_scope_violating_retention_bounded(self):
+        """A fail-closed Filter at cluster scale must not pin a fresh
+        full-size dict per ring slot: request-scope maps are truncated at
+        retention time (shared policy_state maps stay full — one object
+        per state)."""
+        big = {f"n{i}": "degraded fail-closed" for i in range(1000)}
+        decisions.DECISIONS.record_filter(
+            pod_namespace="default",
+            pod_name="big",
+            policy="pol",
+            path="fail_closed",
+            candidates=1000,
+            filtered=1000,
+            violating=big,
+            violating_scope="request",
+            reason_code=decisions.CODE_FAIL_CLOSED,
+        )
+        shared = dict(big)
+        decisions.DECISIONS.record_filter(
+            pod_namespace="default",
+            pod_name="shared",
+            policy="pol",
+            path="native",
+            candidates=1000,
+            filtered=1000,
+            violating=shared,
+            violating_scope="policy_state",
+        )
+        snap = decisions.DECISIONS.snapshot(pod="big")
+        record = snap["records"][0]
+        assert record["violating_truncated"] is True
+        assert record["violating_total"] == 1000
+        assert len(record["violating"]) == decisions.DETAIL_NODE_CAP
+        raw = decisions.DECISIONS.snapshot(pod="shared")["records"][0]
+        assert raw["violating_total"] == 1000
+        # the shared map itself was NOT copied or truncated
+        assert len(shared) == 1000
+
+    def test_snapshot_filters(self):
+        _, ext = build()
+        for pod in ("a", "b"):
+            ext.prioritize(
+                req("/scheduler/prioritize", nn_body(list(VALUES), pod=pod))
+            )
+            ext.filter(
+                req("/scheduler/filter", nn_body(list(VALUES), pod=pod))
+            )
+        snap = decisions.DECISIONS.snapshot(pod="a")
+        assert {r["pod"] for r in snap["records"]} == {"default/a"}
+        snap = decisions.DECISIONS.snapshot(verb="prioritize", limit=1)
+        assert snap["returned"] == 1
+        assert snap["records"][0]["verb"] == "prioritize"
+
+
+class TestBindFeedback:
+    def test_bind_closes_records_with_rank(self):
+        _, ext = build()
+        ext.prioritize(req("/scheduler/prioritize", nn_body(list(VALUES))))
+        ext.filter(req("/scheduler/filter", nn_body(list(VALUES))))
+        closed_before = trace.COUNTERS.get("pas_decision_closed_total")
+        resp = ext.bind(req("/scheduler/bind", bind_body(node="n4")))
+        assert resp.status == 404  # reference wire behavior untouched
+        snap = decisions.DECISIONS.snapshot(pod="p")
+        assert all(not r["open"] for r in snap["records"])
+        pri = [r for r in snap["records"] if r["verb"] == "prioritize"][0]
+        # ranking desc: n1(100) n4(70) n2(50) n3(10) -> n4 is rank 2
+        assert pri["outcome"]["bound_node"] == "n4"
+        assert pri["outcome"]["rank"] == 2
+        assert (
+            trace.COUNTERS.get("pas_decision_closed_total")
+            == closed_before + 2
+        )
+        assert trace.COUNTERS.get(
+            "pas_decision_chosen_rank_total", labels={"rank": "2"}
+        ) >= 1
+        assert decisions.DECISIONS.snapshot()["open"] == 0
+
+    def test_bind_onto_violating_node_counts(self):
+        _, ext = build()
+        ext.filter(req("/scheduler/filter", nn_body(list(VALUES))))
+        before = trace.COUNTERS.get("pas_decision_violated_at_bind_total")
+        ext.bind(req("/scheduler/bind", bind_body(node="n1")))
+        assert (
+            trace.COUNTERS.get("pas_decision_violated_at_bind_total")
+            == before + 1
+        )
+        record = decisions.DECISIONS.snapshot(pod="p")["records"][0]
+        assert record["outcome"]["violated_at_bind"] is True
+        assert "m=100" in record["outcome"]["violation_reason"]
+
+    def test_bind_unknown_pod_is_noop(self):
+        _, ext = build()
+        resp = ext.bind(req("/scheduler/bind", bind_body(pod="ghost")))
+        assert resp.status == 404
+
+
+class TestRebalanceFeedback:
+    def test_events_attach_to_open_records(self):
+        log = decisions.DECISIONS
+        log.record_filter(
+            request_id="r1",
+            pod_namespace="default",
+            pod_name="mover",
+            policy="pol",
+            path="native",
+            candidates=3,
+            filtered=0,
+        )
+        log.observe_rebalance("default", "mover", "evicted", "n1 -> n2")
+        record = log.snapshot(pod="mover")["records"][0]
+        assert record["open"] is True  # eviction does not close; rebind will
+        assert record["events"][0]["action"] == "evicted"
+        assert record["events"][0]["detail"] == "n1 -> n2"
+
+    def test_rebalance_cycle_record(self):
+        log = decisions.DECISIONS
+        log.record_rebalance({"cycle": 3, "mode": "active", "moves": []})
+        snap = log.snapshot(verb="rebalance")
+        record = snap["records"][0]
+        assert record["detail"]["cycle"] == 3
+        assert record["path"] == "active"
+        # cycle summaries are born closed: nothing can ever feed them
+        # back, so they must not inflate the open gauge or, on ring
+        # eviction, the ring-too-small counter
+        assert record["open"] is False
+        assert snap["open"] == 0
+
+
+class TestDebugEndpoints:
+    """/debug/decisions 200/404/405 + query filtering, the /debug index,
+    and the /debug/traces filters — threaded route (the async front-end
+    routes these through the same Server.route; cross-socket coverage in
+    TestFrontEndParity)."""
+
+    def _server(self):
+        _, ext = build()
+        return ext, Server(ext, metrics_provider=ext.metrics_text)
+
+    def test_decisions_endpoint_statuses(self):
+        ext, server = self._server()
+        resp = server.route(req("/debug/decisions", b"", method="GET"))
+        assert resp.status == 200
+        assert json.loads(resp.body)["enabled"] is True
+        resp = server.route(req("/debug/decisions", b"", method="POST"))
+        assert resp.status == 405
+        decisions.DECISIONS.configure(enabled=False)
+        resp = server.route(req("/debug/decisions", b"", method="GET"))
+        assert resp.status == 404
+        resp = server.route(
+            req("/debug/decisions?limit=zap", b"", method="GET")
+        )
+        assert resp.status == 404  # disabled wins over bad params
+
+    def test_decisions_query_filtering(self):
+        ext, server = self._server()
+        for pod in ("a", "b"):
+            ext.prioritize(
+                req("/scheduler/prioritize", nn_body(list(VALUES), pod=pod))
+            )
+            ext.filter(
+                req("/scheduler/filter", nn_body(list(VALUES), pod=pod))
+            )
+        out = json.loads(
+            server.route(
+                req("/debug/decisions?pod=a&verb=filter", b"", method="GET")
+            ).body
+        )
+        assert out["returned"] == 1
+        assert out["records"][0]["pod"] == "default/a"
+        assert out["records"][0]["verb"] == "filter"
+        out = json.loads(
+            server.route(
+                req("/debug/decisions?limit=1", b"", method="GET")
+            ).body
+        )
+        assert out["returned"] == 1
+        # percent-encoded pod keys decode (standard clients encode '/')
+        out = json.loads(
+            server.route(
+                req(
+                    "/debug/decisions?pod=default%2Fa", b"", method="GET"
+                )
+            ).body
+        )
+        assert out["returned"] == 2
+        assert {r["pod"] for r in out["records"]} == {"default/a"}
+        resp = server.route(
+            req("/debug/decisions?limit=zap", b"", method="GET")
+        )
+        assert resp.status == 400
+
+    def test_debug_index(self):
+        _, server = self._server()
+        resp = server.route(req("/debug", b"", method="GET"))
+        assert resp.status == 200
+        paths = [e["path"] for e in json.loads(resp.body)["endpoints"]]
+        for expected in (
+            "/debug/traces",
+            "/debug/decisions",
+            "/debug/rebalance",
+            "/debug/profile",
+            "/healthz",
+            "/readyz",
+            "/metrics",
+        ):
+            assert expected in paths
+        assert server.route(req("/debug", b"", method="POST")).status == 405
+
+    def test_traces_filters(self):
+        ext, server = self._server()
+        ext.prioritize(req("/scheduler/prioritize", nn_body(list(VALUES))))
+        ext.filter(req("/scheduler/filter", nn_body(list(VALUES))))
+        # route()-driven verbs attach no spans; seed the ring directly
+        for verb, ms in (("prioritize", 5.0), ("filter", 0.01)):
+            span = trace.Span(f"POST /scheduler/{verb}")
+            span.set("verb", verb)
+            span.duration_s = ms / 1e3
+            trace.TRACES.add(span)
+        all_out = json.loads(
+            server.route(req("/debug/traces", b"", method="GET")).body
+        )
+        out = json.loads(
+            server.route(
+                req("/debug/traces?verb=prioritize", b"", method="GET")
+            ).body
+        )
+        assert out["verb"] == "prioritize"
+        assert all(
+            e["attrs"].get("verb") == "prioritize" for e in out["recent"]
+        )
+        out = json.loads(
+            server.route(
+                req("/debug/traces?min_ms=1", b"", method="GET")
+            ).body
+        )
+        assert all(e["duration_ms"] >= 1 for e in out["recent"])
+        assert len(all_out["recent"]) >= len(out["recent"])
+        resp = server.route(
+            req("/debug/traces?min_ms=zap", b"", method="GET")
+        )
+        assert resp.status == 400
+
+
+@pytest.mark.skipif(get_wirec() is None, reason="no C toolchain")
+class TestFrontEndParity:
+    """Satellite 3: record parity threaded↔async over real sockets —
+    the same request stream produces the same decision records through
+    both front-ends."""
+
+    FIELDS = (
+        "verb",
+        "pod",
+        "policy",
+        "candidates",
+        "eligible",
+        "filtered",
+        "violating",
+    )
+
+    def _drive_threaded(self):
+        _, ext = build()
+        server = Server(ext, metrics_provider=ext.metrics_text)
+        server.start_server(port="0", unsafe=True, host="127.0.0.1", block=False)
+        try:
+            assert server.wait_ready(10)
+            return self._drive(server.port)
+        finally:
+            server.shutdown()
+
+    def _drive_async(self):
+        _, ext = build()
+        server = start_async(ext)
+        try:
+            return self._drive(server.port)
+        finally:
+            server.shutdown()
+
+    def _drive(self, port):
+        for path in ("/scheduler/prioritize", "/scheduler/filter"):
+            status, _, _ = raw_request(
+                port, post_bytes(path, nn_body(list(VALUES)))
+            )
+            assert status == 200
+        status, _, payload = raw_request(
+            port,
+            (
+                b"GET /debug/decisions?limit=10 HTTP/1.1\r\nHost: t\r\n"
+                b"Connection: close\r\n\r\n"
+            ),
+        )
+        assert status == 200
+        return json.loads(payload)
+
+    def test_records_identical_across_front_ends(self):
+        threaded = self._drive_threaded()
+        decisions.DECISIONS.configure()  # reset between front-ends
+        asynced = self._drive_async()
+        assert threaded["recorded_total"] == asynced["recorded_total"] == 2
+
+        def strip(records):
+            return [
+                {k: r.get(k) for k in self.FIELDS} for r in records
+            ]
+
+        assert strip(threaded["records"]) == strip(asynced["records"])
+        # every record carries the (echoed) X-Request-ID of its request
+        assert all(r["request_id"] for r in threaded["records"])
+        assert all(r["request_id"] for r in asynced["records"])

@@ -196,7 +196,7 @@ class TestPrecomputeWiring:
 
         counts = {"prioritize": 0, "filter": 0}
         real_prioritize = fp_mod.prioritize_kernel
-        real_filter = fp_mod.filter_kernel
+        real_filter = fp_mod.filter_explain_kernel
 
         def count_prioritize(*a, **k):
             counts["prioritize"] += 1
@@ -207,7 +207,7 @@ class TestPrecomputeWiring:
             return real_filter(*a, **k)
 
         monkeypatch.setattr(fp_mod, "prioritize_kernel", count_prioritize)
-        monkeypatch.setattr(fp_mod, "filter_kernel", count_filter)
+        monkeypatch.setattr(fp_mod, "filter_explain_kernel", count_filter)
         return counts
 
     def _write_metrics(self, cache, values):

@@ -158,7 +158,8 @@ class TestFilter:
         out = json.loads(resp.body)
         assert out["NodeNames"] == ["empty-node"]
         assert out["FailedNodes"] == {
-            "small-node": "Not enough GPU-resources for deployment"
+            "small-node": "gas: no card fits request "
+            "(gpu.intel.com/i915=1, gpu.intel.com/millicores=500)"
         }
 
     def test_missing_node_names_is_error_404(self, setup):
@@ -189,7 +190,10 @@ class TestFilter:
             "Pod": gpu_pod("p", millicores="300").raw, "NodeNames": ["n1"],
         }))
         out = json.loads(resp.body)
-        assert out["FailedNodes"] == {"n1": "Not enough GPU-resources for deployment"}
+        assert out["FailedNodes"] == {
+            "n1": "gas: no card fits request "
+            "(gpu.intel.com/i915=1, gpu.intel.com/millicores=300)"
+        }
         resp = ext.filter(post({
             "Pod": gpu_pod("p2", millicores="200").raw, "NodeNames": ["n1"],
         }))

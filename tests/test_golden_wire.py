@@ -234,7 +234,9 @@ class TestGoldenResponses:
         ]
         filt = json.loads(fixture("filter_nodenames_response.golden"))
         assert filt["NodeNames"] == ["gw-a", "gw-c", "gw-d"]
-        assert filt["FailedNodes"] == {"gw-b": "Node violates"}
+        assert filt["FailedNodes"] == {
+            "gw-b": "policy golden-pol: metric golden_metric=90 > threshold 80"
+        }
         legacy = json.loads(fixture("filter_nodes_response.golden"))
         # the Nodes branch echoes full node objects and keeps the
         # reference's trailing-"" NodeNames split quirk
@@ -242,7 +244,9 @@ class TestGoldenResponses:
             "gw-a", "gw-c", "gw-d",
         ]
         assert legacy["NodeNames"] == ["gw-a", "gw-c", "gw-d", ""]
-        assert legacy["FailedNodes"] == {"gw-b": "Node violates"}
+        assert legacy["FailedNodes"] == {
+            "gw-b": "policy golden-pol: metric golden_metric=90 > threshold 80"
+        }
 
 
 def update_goldens():

@@ -130,7 +130,9 @@ class TestNodeNamesMode:
         # confined to the legacy Nodes branch).
         assert result["Nodes"] is None
         assert result["NodeNames"] == ["n2", "n3"]
-        assert result["FailedNodes"] == {"n1": "Node violates"}
+        assert result["FailedNodes"] == {
+            "n1": "policy pol: metric m=100 > threshold 75"
+        }
 
     def test_filter_node_names_all_violating_is_empty_list(self):
         _, ext = build(dontschedule_target=5)  # every node violates
@@ -151,14 +153,16 @@ class TestNodeNamesMode:
 
         monkeypatch.setattr(
             ext.fastpath,
-            "violation_set",
+            "violation_reasons",
             lambda *a, **k: (_ for _ in ()).throw(XlaRuntimeError("oom")),
         )
         resp = ext.filter(req("/scheduler/filter", nn_body(["n1", "n2", "n3"])))
         assert resp.status == 200
         result = json.loads(resp.body)
         assert result["NodeNames"] == ["n2", "n3"]
-        assert result["FailedNodes"] == {"n1": "Node violates"}
+        assert result["FailedNodes"] == {
+            "n1": "policy pol: metric m=100 > threshold 75"
+        }
 
     def test_nodes_takes_precedence_over_nodenames(self, monkeypatch):
         _, ext = build()
@@ -221,7 +225,9 @@ class TestResponseReuseCache:
         names = ["n1", "n2", "n3"]
         body = nn_body(names)
         first = ext.filter(req("/scheduler/filter", body))
-        assert json.loads(first.body)["FailedNodes"] == {"n1": "Node violates"}
+        assert json.loads(first.body)["FailedNodes"] == {
+            "n1": "policy pol: metric m=100 > threshold 75"
+        }
         assert len(ext.fastpath._filter_responses) == 1
         # second request (different pod) hits the cache byte-for-byte
         second = ext.filter(req("/scheduler/filter", nn_body(names, pod="q")))
@@ -241,7 +247,9 @@ class TestResponseReuseCache:
             },
         )
         third = ext.filter(req("/scheduler/filter", body))
-        assert json.loads(third.body)["FailedNodes"] == {"n2": "Node violates"}
+        assert json.loads(third.body)["FailedNodes"] == {
+            "n2": "policy pol: metric m=999 > threshold 75"
+        }
 
     def test_filter_nodes_mode_cache_parity(self, monkeypatch):
         cache, ext = build()

@@ -168,7 +168,9 @@ class TestFilter:
         out = json.loads(resp.body)
         assert [n["metadata"]["name"] for n in out["Nodes"]["items"]] == ["nodeA"]
         assert out["NodeNames"] == ["nodeA", ""]  # reference trailing-split quirk
-        assert out["FailedNodes"] == {"nodeB": "Node violates"}
+        assert out["FailedNodes"] == {
+            "nodeB": "policy policy1: metric metric1=50 > threshold 40"
+        }
         assert out["Error"] == ""
 
     def test_no_policy_404_null(self, extender):

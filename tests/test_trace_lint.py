@@ -87,6 +87,23 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_decision_families_declared(self):
+        """ISSUE 6: the decision-provenance placement-quality families
+        are part of the declared inventory (docs/observability.md
+        "Decision provenance")."""
+        expected = {
+            "pas_decision_records_total": "counter",
+            "pas_decision_filtered_nodes_total": "counter",
+            "pas_decision_open": "gauge",
+            "pas_decision_closed_total": "counter",
+            "pas_decision_violated_at_bind_total": "counter",
+            "pas_decision_chosen_rank_total": "counter",
+            "pas_decision_evicted_open_total": "counter",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
     def test_fault_tolerance_families_declared(self):
         """ISSUE 5: the retry/circuit/degraded families are part of the
         declared inventory (docs/robustness.md)."""
