@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-configs bench-serving bench-rebalance bench-chaos bench-decisions test-serving test-obs test-rebalance test-faults test-decisions trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions test-serving test-obs test-rebalance test-faults test-decisions test-gang trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -68,6 +68,17 @@ test-decisions:
 # decision-log on-vs-off serving p99 A/B + placement-quality scrape
 bench-decisions:
 	python -m benchmarks.http_load --decisions
+
+# gang & topology-aware scheduling suite (docs/gang.md): topology-kernel
+# device<->host parity, reservation lifecycle + TTL, the all-or-nothing
+# invariant over real sockets on both front-ends, gang-atomic eviction
+test-gang:
+	python -m pytest tests/test_gang.py tests/test_binpack_edges.py -q
+
+# gang A/B alone: competing gangs (gang-on admits both, gang-off
+# deadlocks half-placed) + 10k-node reservation throughput
+bench-gang:
+	python -m benchmarks.gang_load
 
 # metric-name convention gate (docs/observability.md): every emitted
 # metric is declared in trace.METRICS, pas_-prefixed snake_case, no

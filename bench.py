@@ -169,7 +169,7 @@ def _detail_path(round_override=None) -> str:
 
 def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
-    chaos=None, decisions=None,
+    chaos=None, decisions=None, gang=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -243,6 +243,25 @@ def assemble_line(
             ),
             "overhead_pct_filter_p99": decisions.get(
                 "overhead_pct_filter_p99"
+            ),
+        }
+    if gang is not None:
+        # full per-mode admission records to disk; the line keeps the
+        # all-or-nothing headline (gang-on admits both competing gangs,
+        # gang-off deadlocks half-placed — docs/gang.md) + the 10k-node
+        # reservation-solve latency
+        detail["gang"] = gang
+        on = gang.get("gang_on") or {}
+        off = gang.get("gang_off") or {}
+        throughput = gang.get("throughput") or {}
+        result["gang"] = {
+            "gangs_admitted_on": on.get("gangs_admitted_as_valid_slice"),
+            "deadlock_on": on.get("deadlock"),
+            "gangs_admitted_off": off.get("gangs_admitted_as_valid_slice"),
+            "deadlock_off": off.get("deadlock"),
+            "reserve_ms_10k_nodes": throughput.get("reserve_ms"),
+            "admissions_per_s_10k_nodes": throughput.get(
+                "admissions_per_s"
             ),
         }
     if chaos is not None:
@@ -444,6 +463,25 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"decision bench failed: {exc}", file=sys.stderr)
 
+    # --- gang scheduling: competing-gang deadlock A/B + 10k-node
+    # reservation throughput (benchmarks/gang_load.py; docs/gang.md) ---
+    gang = None
+    try:
+        from benchmarks import gang_load
+
+        gang = gang_load.run()
+        on, off = gang["gang_on"], gang["gang_off"]
+        print(
+            f"gang: on admitted {on['gangs_admitted_as_valid_slice']}/2 "
+            f"gangs (deadlock={on['deadlock']}) vs off "
+            f"{off['gangs_admitted_as_valid_slice']}/2 "
+            f"(deadlock={off['deadlock']}); reserve "
+            f"{gang['throughput']['reserve_ms']} ms at 10k nodes",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"gang bench failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -455,7 +493,7 @@ def main():
 
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
-        decisions_out,
+        decisions_out, gang,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

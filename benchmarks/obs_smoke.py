@@ -39,6 +39,11 @@ def check_front_end(serving: str) -> str:
 
     server, names = build_service(32, device=True, serving=serving)
     try:
+        # wire a gang tracker so /debug/gangs exercises its 200 path
+        # (the endpoint 404s when --gang=off, like /debug/rebalance)
+        from platform_aware_scheduling_tpu.gang import GangTracker
+
+        server.scheduler.gangs = GangTracker(nodes_provider=lambda: [])
         port = server.port
         status, _ = _get(port, "/healthz")
         assert status == 200, f"{serving}: /healthz -> {status}"
@@ -73,6 +78,11 @@ def check_front_end(serving: str) -> str:
         assert snap["recorded_total"] >= 1, (
             f"{serving}: the prioritize above must have recorded a decision"
         )
+        assert "/debug/gangs" in paths, f"{serving}: index missing gangs"
+        status, payload = _get(port, "/debug/gangs")
+        assert status == 200, f"{serving}: /debug/gangs -> {status}"
+        gangs = json.loads(payload)
+        assert gangs["enabled"] is True
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "

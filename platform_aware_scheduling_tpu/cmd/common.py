@@ -82,6 +82,45 @@ def add_decision_flags(parser: argparse.ArgumentParser) -> None:
                         "above pending-pods x verbs)")
 
 
+def add_gang_flags(parser: argparse.ArgumentParser) -> None:
+    """Gang & topology-aware scheduling flag surface (docs/gang.md).
+    One helper so a future GAS adoption cannot drift from TAS."""
+    parser.add_argument("--gang", default="off", choices=["off", "on"],
+                        help="all-or-nothing co-scheduling of multi-host "
+                        "TPU slices: pods labeled pas-workload-group + "
+                        "pas-gang-size (+ pas-gang-topology, e.g. 4x4) "
+                        "atomically reserve a contiguous mesh slice at "
+                        "Filter time, or fail every candidate.  Bypasses "
+                        "the Filter response cache and the native "
+                        "Prioritize scanner while on (the gang verdict is "
+                        "pod-label-dependent state those caches cannot "
+                        "key)")
+    parser.add_argument("--gangReservationTTL", default="30s",
+                        help="how long a gang's slice reservation holds "
+                        "without bind progress before it is reclaimed "
+                        "(Go duration); each member Filter refreshes it")
+    parser.add_argument("--gangMeshRefresh", default="30s",
+                        help="max age of the cached node mesh-coordinate "
+                        "map (pas-tpu-coord labels) before the gang "
+                        "tracker relists nodes (Go duration)")
+
+
+def build_gang_tracker(args, kube_client):
+    """The GangTracker for --gang=on (None when off), over the kube
+    client's node list as the mesh-coordinate source."""
+    if getattr(args, "gang", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.gang import GangTracker
+    from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+    return GangTracker(
+        nodes_provider=kube_client.list_nodes,
+        pods_provider=kube_client.list_pods,
+        ttl_s=parse_duration(args.gangReservationTTL),
+        mesh_max_age_s=parse_duration(args.gangMeshRefresh),
+    )
+
+
 def configure_decisions(args) -> None:
     """Apply the shared decision flags to the process-wide DecisionLog."""
     from platform_aware_scheduling_tpu.utils import decisions

@@ -107,6 +107,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_profile_flag(parser)
     common.add_robustness_flags(parser)
     common.add_decision_flags(parser)
+    common.add_gang_flags(parser)
     return parser
 
 
@@ -122,6 +123,7 @@ def assemble(
     rebalance_options: Optional[dict] = None,
     breakers=None,
     degraded_mode: Optional[str] = None,
+    gang_tracker=None,
 ):
     """Wire cache + mirror + extender + controller + enforcer (the body of
     ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
@@ -131,7 +133,12 @@ def assemble(
     DegradedModeController (tas/degraded.py) is built over the cache's
     freshness signal and the circuit states and attached to the
     extender, the enforcer, and the rebalancer — degraded Filter/
-    Prioritize policy plus the unconditional eviction suspension."""
+    Prioritize policy plus the unconditional eviction suspension.
+
+    ``gang_tracker``: the --gang=on GangTracker
+    (common.build_gang_tracker); attached to the extender so Filter/
+    Prioritize/Bind consult gang reservations and the front-ends serve
+    GET /debug/gangs (docs/gang.md)."""
     cache = AutoUpdatingCache()
     mirror: Optional[TensorStateMirror] = None
     if enable_device_path:
@@ -148,6 +155,8 @@ def assemble(
         planner=planner,
         node_cache_capable=node_cache_capable,
     )
+    if gang_tracker is not None:
+        extender.gangs = gang_tracker
 
     enforcer = core.MetricEnforcer(kube_client, mirror=mirror)
     enforcer.register_strategy_type(deschedule.Strategy())
@@ -184,6 +193,10 @@ def assemble(
         rebalancer.degraded = degraded
         rebalancer.attach(enforcer)
         extender.rebalancer = rebalancer
+        # gang-atomic eviction completes the loop: a whole-gang eviction
+        # releases the gang's slice reservation (docs/gang.md)
+        if gang_tracker is not None:
+            rebalancer.actuator.gang_tracker = gang_tracker
 
     controller = TelemetryPolicyController(kube_client, cache, enforcer)
 
@@ -260,6 +273,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         node_cache_capable=args.nodeCacheCapable,
         breakers=breakers,
         degraded_mode=args.degradedMode,
+        gang_tracker=common.build_gang_tracker(args, kube_client),
         rebalance_mode=args.rebalance,
         rebalance_options={
             "hysteresis_cycles": args.rebalanceHysteresis,

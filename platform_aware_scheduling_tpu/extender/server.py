@@ -82,6 +82,7 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/traces", "description": "recent + slowest request traces; filters: ?verb=<verb>&min_ms=<float>"},
     {"path": "/debug/decisions", "description": "scheduling decision provenance records; filters: ?pod=<name>&verb=<verb>&limit=<n> (404 when --decisionLog=off)"},
     {"path": "/debug/rebalance", "description": "last rebalance plan + loop state (404 when --rebalance=off)"},
+    {"path": "/debug/gangs", "description": "gang reservations + lifecycle state (404 when --gang=off)"},
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
 ]
 
@@ -414,6 +415,22 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=rebalancer.to_json(),
+            )
+        if bare_path == "/debug/gangs":
+            # gang reservations + lifecycle state (gang/group.py); 404
+            # when no tracker is wired (--gang=off or GAS)
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            gangs = getattr(self.scheduler, "gangs", None)
+            if gangs is None:
+                return HTTPResponse.json(
+                    b'{"error": "gang scheduling not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=gangs.to_json(),
             )
         if bare_path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of

@@ -1,0 +1,11 @@
+"""Gang & topology-aware scheduling: atomic multi-host TPU slice
+placement with TTL reservations (docs/gang.md)."""
+
+from platform_aware_scheduling_tpu.gang.group import (  # noqa: F401
+    GangSpec,
+    GangTracker,
+    STATE_BOUND,
+    STATE_FORMING,
+    STATE_RELEASED,
+    STATE_RESERVED,
+)

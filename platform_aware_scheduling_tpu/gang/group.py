@@ -1,0 +1,656 @@
+"""All-or-nothing gang co-scheduling with topology-constrained
+reservations (docs/gang.md).
+
+The stock Filter/Prioritize path admits pods one at a time — the
+node-level version of the "sum fits but no single unit does" problem
+PAPER.md's GAS solves per card.  Two multi-host jobs that each need a
+contiguous ICI sub-slice of a shared mesh then deadlock half-placed:
+each holds scattered nodes the other needs, and neither ever completes
+a valid topology.
+
+The :class:`GangTracker` makes co-scheduling atomic:
+
+  * a pod carrying ``pas-workload-group`` + ``pas-gang-size`` (and
+    optionally ``pas-gang-topology: "HxW"``) labels is a **gang
+    member** (utils/labels.py);
+  * the FIRST member's Filter runs the topology-feasibility kernel
+    (ops/topology.py) over the mesh's free cells and — all-or-nothing —
+    either **reserves a whole feasible slice** (best anchor = fewest
+    stranded free neighbors) or fails every candidate with a concrete
+    ``gang ...: no feasible HxW slice`` reason;
+  * while the reservation holds, members pass Filter ONLY on reserved
+    nodes, other gangs' pods fail reserved nodes with
+    ``gang: node reserved by gang ...``, and each member Filter
+    refreshes the reservation TTL;
+  * Bind observations promote members to bound; when every member has
+    bound the gang is **admitted** (``pas_gang_admitted_total``, time
+    to full gang recorded);
+  * a reservation whose TTL lapses before the gang fully binds is
+    **reclaimed** (``pas_gang_reservation_expirations_total``) and the
+    gang re-forms — so an abandoned half-gang can never pin mesh nodes
+    forever, and no member of an incomplete gang binds after expiry.
+
+Lifecycle: ``forming -> reserved -> bound -> released``.  All state
+transitions happen under one short lock; the feasibility solve runs on
+device (host mirror as fallback/control — byte-identical wire behavior,
+pinned by tests/test_gang.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from platform_aware_scheduling_tpu.extender.types import HostPriority
+from platform_aware_scheduling_tpu.kube.objects import Pod
+from platform_aware_scheduling_tpu.ops import topology
+from platform_aware_scheduling_tpu.utils import decisions, klog, trace
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
+from platform_aware_scheduling_tpu.utils.tracing import (
+    LatencyRecorder,
+    histograms_text,
+)
+
+STATE_FORMING = "forming"
+STATE_RESERVED = "reserved"
+STATE_BOUND = "bound"
+STATE_RELEASED = "released"
+
+DEFAULT_TTL_S = 30.0
+DEFAULT_MESH_MAX_AGE_S = 30.0
+
+#: process-wide time-to-full-gang histogram (its own family —
+#: pas_gang_time_to_full_seconds, label: topology), registered once into
+#: the shared /metrics page via trace.EXTRA_PROVIDERS
+FULL_GANG_LATENCY = LatencyRecorder()
+
+
+def _gang_histogram_text() -> str:
+    return histograms_text(
+        [FULL_GANG_LATENCY],
+        metric="pas_gang_time_to_full_seconds",
+        help_texts=trace.help_texts(),
+        label_name="topology",
+    )
+
+
+trace.EXTRA_PROVIDERS.append(_gang_histogram_text)
+
+
+class GangSpec:
+    """A pod's parsed gang demand."""
+
+    __slots__ = ("gang_id", "size", "topology")
+
+    def __init__(self, gang_id: str, size: int, topo: Optional[tuple]):
+        self.gang_id = gang_id
+        self.size = size
+        self.topology = topo  # (rows, cols) or None (any k nodes)
+
+    @property
+    def topology_label(self) -> str:
+        if self.topology is None:
+            return "any"
+        return f"{self.topology[0]}x{self.topology[1]}"
+
+    @classmethod
+    def from_pod(cls, pod: Pod) -> Optional["GangSpec"]:
+        """None unless the pod carries a well-formed gang demand.  The
+        validation lives in ONE place — utils/labels.gang_id_for (group
+        + size labels, size >= 1, topology cell count == size) — so the
+        scheduler and the gang-aware rebalance actuator can never
+        disagree about membership.  A malformed demand fails open to
+        non-gang semantics (logged) — a typo must not wedge scheduling."""
+        pod_labels = pod.get_labels()
+        gang_id = shared_labels.gang_id_for(pod.namespace, pod_labels)
+        if gang_id is None:
+            if (
+                pod_labels.get(shared_labels.GROUP_LABEL)
+                and shared_labels.GANG_SIZE_LABEL in pod_labels
+            ):
+                klog.v(2).info_s(
+                    f"malformed gang labels on pod {pod.namespace}/"
+                    f"{pod.name}; treating pod as non-gang",
+                    component="gang",
+                )
+            return None
+        size = int(pod_labels[shared_labels.GANG_SIZE_LABEL])
+        topo = None
+        raw_topo = pod_labels.get(shared_labels.GANG_TOPOLOGY_LABEL)
+        if raw_topo:
+            topo = shared_labels.parse_topology(raw_topo)
+        return cls(gang_id, size, topo)
+
+
+class _Gang:
+    """One tracked gang's mutable state (all access under the tracker's
+    lock)."""
+
+    __slots__ = (
+        "gang_id",
+        "spec",
+        "state",
+        "members",
+        "bound",
+        "reserved_nodes",
+        "anchor",
+        "created_at",
+        "last_seen",
+        "expires_at",
+    )
+
+    def __init__(self, spec: GangSpec, now: float):
+        self.gang_id = spec.gang_id
+        self.spec = spec
+        self.state = STATE_FORMING
+        self.members: Set[str] = set()  # pod keys seen at Filter time
+        self.bound: Dict[str, str] = {}  # pod key -> node
+        self.reserved_nodes: List[str] = []  # row-major slice order
+        self.anchor: Optional[Tuple[int, int, int, int]] = None  # i, j, h, w
+        self.created_at = now
+        self.last_seen = now
+        self.expires_at: Optional[float] = None
+
+    def to_dict(self, now: float) -> Dict:
+        out = {
+            "gang": self.gang_id,
+            "state": self.state,
+            "size": self.spec.size,
+            "topology": self.spec.topology_label,
+            "members_seen": len(self.members),
+            "bound": len(self.bound),
+            "reserved_nodes": list(self.reserved_nodes),
+        }
+        if self.anchor is not None:
+            i, j, h, w = self.anchor
+            out["anchor"] = {"row": i, "col": j, "rows": h, "cols": w}
+        if self.state == STATE_RESERVED and self.expires_at is not None:
+            out["ttl_remaining_s"] = round(max(0.0, self.expires_at - now), 3)
+        return out
+
+
+class GangTracker:
+    """The gang ledger the TAS verbs consult: reservations, member
+    lifecycle, and the Filter/Prioritize overlays.
+
+    ``nodes_provider`` supplies the cluster node list (kube
+    ``list_nodes`` in production, the fake in tests) from which the mesh
+    coordinate map is built and refreshed (``mesh_max_age_s``);
+    ``clock`` is injectable so TTL behavior tests advance time instead
+    of sleeping."""
+
+    def __init__(
+        self,
+        nodes_provider: Callable[[], list],
+        ttl_s: float = DEFAULT_TTL_S,
+        mesh_max_age_s: float = DEFAULT_MESH_MAX_AGE_S,
+        use_device: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+        pods_provider: Optional[Callable[[], list]] = None,
+    ):
+        self.nodes_provider = nodes_provider
+        # optional live-pod source (kube list_pods): bound gangs whose
+        # members have ALL disappeared (job finished, pods deleted) are
+        # released by the periodic dead-gang sweep, so a completed job's
+        # slice cannot stay reserved until process restart
+        self.pods_provider = pods_provider
+        self.ttl_s = float(ttl_s)
+        self.mesh_max_age_s = float(mesh_max_age_s)
+        self.use_device = use_device
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._gangs: Dict[str, _Gang] = {}
+        self._member_gang: Dict[str, str] = {}  # pod key -> gang id
+        self._mesh: Optional[topology.MeshView] = None
+        self._mesh_at: float = -float("inf")
+        self._swept_at: float = -float("inf")
+        self._sweeping = False
+
+    # -- mesh ------------------------------------------------------------------
+
+    def _mesh_view(self, now: float) -> Optional[topology.MeshView]:
+        """The (cached) coordinate map; a provider failure keeps serving
+        the stale mesh rather than wedging the verb (same last-known-good
+        stance as the telemetry cache)."""
+        with self._lock:
+            mesh = self._mesh
+            fresh = (now - self._mesh_at) <= self.mesh_max_age_s
+        if mesh is not None and fresh:
+            return mesh
+        try:
+            nodes = self.nodes_provider()
+        except Exception as exc:
+            klog.error("gang mesh refresh failed: %s", exc)
+            return mesh
+        new = topology.MeshView(nodes)
+        with self._lock:
+            self._mesh = new
+            self._mesh_at = now
+        return new
+
+    def _sweep_dead_gangs(self, now: float, wait: bool = False) -> None:
+        """Release bound gangs whose members have ALL stopped running
+        (job finished / pods deleted) — at most one pod list per
+        ``mesh_max_age_s``.  Without this, a completed job's slice would
+        stay reserved forever (the actuator's whole-gang release covers
+        evictions, not completions).
+
+        The cluster pod LIST never runs on a verb's thread: a Filter
+        that trips the interval hands the scan to a one-shot daemon
+        thread (``wait=False``); :meth:`prune` runs it inline
+        (``wait=True``) so tests and maintenance calls are
+        deterministic."""
+        if self.pods_provider is None:
+            return
+        with self._lock:
+            if self._sweeping or (now - self._swept_at) <= (
+                self.mesh_max_age_s
+            ):
+                return
+            self._swept_at = now
+            bound_gangs = {
+                gang.gang_id: set(gang.bound)
+                for gang in self._gangs.values()
+                if gang.state == STATE_BOUND
+            }
+            if not bound_gangs:
+                return
+            self._sweeping = True
+
+        def scan() -> None:
+            try:
+                pods = self.pods_provider()
+                # a pod that Succeeded/Failed or is terminating no longer
+                # RUNS on its slice — counting it as live would hold a
+                # completed Job's reservation until its pods are GCed
+                # (same liveness rule as the actuator's group floor)
+                live = {
+                    f"{pod.namespace}/{pod.name}"
+                    for pod in pods
+                    if pod.phase not in ("Succeeded", "Failed")
+                    and pod.deletion_timestamp is None
+                }
+                for gang_id, members in bound_gangs.items():
+                    if members and not (members & live):
+                        klog.v(1).info_s(
+                            f"gang {gang_id}: every bound member gone; "
+                            f"releasing its slice",
+                            component="gang",
+                        )
+                        self.release(gang_id)
+            except Exception as exc:
+                klog.error("gang dead-sweep pod list failed: %s", exc)
+            finally:
+                with self._lock:
+                    self._sweeping = False
+
+        if wait:
+            scan()
+        else:
+            threading.Thread(target=scan, daemon=True).start()
+
+    # -- reservation bookkeeping (all under the lock) --------------------------
+
+    def _reserved_map_locked(
+        self, exclude: Optional[str] = None
+    ) -> Dict[str, str]:
+        """{node: holding gang id} across every live reservation
+        (bound gangs keep holding their slice until released)."""
+        held: Dict[str, str] = {}
+        for gang in self._gangs.values():
+            if gang.gang_id == exclude:
+                continue
+            if gang.state in (STATE_RESERVED, STATE_BOUND):
+                for node in gang.reserved_nodes:
+                    held[node] = gang.gang_id
+        return held
+
+    def _prune_locked(self, now: float) -> int:
+        """Reclaim expired reservations (gang re-forms) and drop gangs
+        abandoned in forming for 10x the TTL.  Returns the number of
+        expirations (counted by the caller outside the lock)."""
+        expired = 0
+        for gang in self._gangs.values():
+            if (
+                gang.state == STATE_RESERVED
+                and gang.expires_at is not None
+                and gang.expires_at <= now
+            ):
+                gang.state = STATE_FORMING
+                gang.reserved_nodes = []
+                gang.anchor = None
+                gang.expires_at = None
+                # binds on the abandoned slice do not carry over: the
+                # re-formed gang may reserve a DIFFERENT slice, and
+                # admission must mean k binds on the CURRENT one — never
+                # a gang straddling two slices
+                gang.bound = {}
+                expired += 1
+        idle_bound = 10.0 * self.ttl_s
+        for gang_id in [
+            gid
+            for gid, gang in self._gangs.items()
+            if gang.state == STATE_FORMING
+            and (now - gang.last_seen) > idle_bound
+        ]:
+            self._drop_locked(gang_id)
+        return expired
+
+    def _drop_locked(self, gang_id: str) -> None:
+        dropped = self._gangs.pop(gang_id, None)
+        if dropped is not None:
+            # released = removed from tracking; the terminal state is
+            # stamped on the object so any held reference reads true
+            dropped.state = STATE_RELEASED
+            dropped.reserved_nodes = []
+        for key in [
+            k for k, gid in self._member_gang.items() if gid == gang_id
+        ]:
+            del self._member_gang[key]
+
+    def _publish_gauges_locked(self) -> Tuple[float, float]:
+        active = sum(
+            1
+            for gang in self._gangs.values()
+            if gang.state in (STATE_FORMING, STATE_RESERVED)
+        )
+        held = sum(
+            len(gang.reserved_nodes)
+            for gang in self._gangs.values()
+            if gang.state in (STATE_RESERVED, STATE_BOUND)
+        )
+        return float(active), float(held)
+
+    def _set_gauges(self, gauges: Tuple[float, float]) -> None:
+        trace.COUNTERS.set_gauge("pas_gang_active", gauges[0])
+        trace.COUNTERS.set_gauge("pas_gang_reserved_nodes", gauges[1])
+
+    # -- reservation solve -----------------------------------------------------
+
+    def _try_reserve_locked(
+        self,
+        gang: _Gang,
+        candidates: List[str],
+        mesh: Optional[topology.MeshView],
+        now: float,
+    ) -> Optional[str]:
+        """Attempt the all-or-nothing reservation for a forming gang over
+        this request's candidates.  Returns None on success (the gang
+        holds a slice) or the bounded rejection-reason label."""
+        # gang.bound is always empty here: both paths into FORMING (new
+        # gang, TTL expiry) clear it — abandoned-slice binds never leak
+        # into a new solve (the straddling fix)
+        held = self._reserved_map_locked(exclude=gang.gang_id)
+        free = [name for name in candidates if name not in held]
+        spec = gang.spec
+        if spec.topology is None:
+            # size-only gang: any k nodes, chosen in sorted-name order
+            # for determinism (no adjacency constraint, no mesh needed)
+            chosen = sorted(set(free))[: spec.size]
+            if len(chosen) < spec.size:
+                return "infeasible"
+            gang.reserved_nodes = chosen
+            gang.anchor = None
+        else:
+            if mesh is None or len(mesh) == 0:
+                return "no_mesh"
+            free_mask = mesh.free_mask(free)
+            h, w = spec.topology
+            best = None  # (score, orientation index, i, j, h, w)
+            for idx, (hh, ww) in enumerate(
+                [(h, w)] if h == w else [(h, w), (w, h)]
+            ):
+                feas = topology.topology_feasibility(
+                    free_mask, hh, ww, use_device=self.use_device
+                )
+                anchor = topology.best_anchor(feas)
+                if anchor is None:
+                    continue
+                i, j, score = anchor
+                key = (score, idx, i, j)
+                if best is None or key < best[0]:
+                    best = (key, i, j, hh, ww)
+            if best is None:
+                return "infeasible"
+            _, i, j, hh, ww = best
+            names = mesh.names_for(topology.slice_cells(i, j, hh, ww))
+            if names is None:  # a hole raced into the window
+                return "infeasible"
+            gang.reserved_nodes = names
+            gang.anchor = (i, j, hh, ww)
+        gang.state = STATE_RESERVED
+        gang.expires_at = now + self.ttl_s
+        return None
+
+    # -- verb overlays ---------------------------------------------------------
+
+    def filter_overlay(
+        self, pod: Pod, candidates: List[str]
+    ) -> Tuple[Dict[str, str], Dict[str, int]]:
+        """The gang verdict for one Filter request: ``(failed, codes)``
+        merged over the telemetry violation map by the caller
+        (tas/telemetryscheduler._filter_nodes).
+
+        Non-gang pod: candidates held by gang reservations fail with a
+        concrete ``gang: node reserved by gang <id>`` reason
+        (CODE_GANG_RESERVED).  Gang member: only the gang's reserved
+        slice passes; with no reservable slice EVERY candidate fails
+        (CODE_GANG_INFEASIBLE) — the all-or-nothing invariant."""
+        now = self._clock()
+        spec = GangSpec.from_pod(pod)
+        self._sweep_dead_gangs(now)
+        mesh = None
+        if spec is not None and spec.topology is not None:
+            mesh = self._mesh_view(now)
+        expired = 0
+        reservations_created = 0
+        rejected_reason = None
+        failed: Dict[str, str] = {}
+        codes: Dict[str, int] = {}
+        with self._lock:
+            expired = self._prune_locked(now)
+            if spec is None:
+                held = self._reserved_map_locked()
+                for name in candidates:
+                    holder = held.get(name)
+                    if holder is not None:
+                        failed[name] = f"gang: node reserved by gang {holder}"
+                        codes[name] = decisions.CODE_GANG_RESERVED
+                gauges = self._publish_gauges_locked()
+            else:
+                gang = self._gangs.get(spec.gang_id)
+                if gang is None:
+                    gang = _Gang(spec, now)
+                    self._gangs[spec.gang_id] = gang
+                gang.last_seen = now
+                gang.members.add(f"{pod.namespace}/{pod.name}")
+                self._member_gang[f"{pod.namespace}/{pod.name}"] = (
+                    spec.gang_id
+                )
+                if gang.state == STATE_FORMING:
+                    rejected_reason = self._try_reserve_locked(
+                        gang, candidates, mesh, now
+                    )
+                    if rejected_reason is None:
+                        reservations_created = 1
+                if gang.state in (STATE_RESERVED, STATE_BOUND):
+                    if gang.state == STATE_RESERVED:
+                        # an actively scheduling gang keeps its hold
+                        gang.expires_at = now + self.ttl_s
+                    allowed = set(gang.reserved_nodes)
+                    held = self._reserved_map_locked(exclude=spec.gang_id)
+                    topo = spec.topology_label
+                    for name in candidates:
+                        if name in allowed:
+                            continue
+                        holder = held.get(name)
+                        if holder is not None:
+                            failed[name] = (
+                                f"gang: node reserved by gang {holder}"
+                            )
+                            codes[name] = decisions.CODE_GANG_RESERVED
+                        else:
+                            failed[name] = (
+                                f"gang {spec.gang_id}: node outside "
+                                f"reserved {topo} slice"
+                            )
+                            codes[name] = decisions.CODE_GANG_INFEASIBLE
+                else:
+                    reason = (
+                        "no mesh coordinates available"
+                        if rejected_reason == "no_mesh"
+                        else f"no feasible {spec.topology_label} slice"
+                    )
+                    for name in candidates:
+                        failed[name] = f"gang {spec.gang_id}: {reason}"
+                        codes[name] = decisions.CODE_GANG_INFEASIBLE
+                gauges = self._publish_gauges_locked()
+        if expired:
+            trace.COUNTERS.inc(
+                "pas_gang_reservation_expirations_total", expired
+            )
+        if reservations_created:
+            trace.COUNTERS.inc("pas_gang_reservations_total")
+        if rejected_reason is not None:
+            trace.COUNTERS.inc(
+                "pas_gang_rejected_total", labels={"reason": rejected_reason}
+            )
+        self._set_gauges(gauges)
+        return failed, codes
+
+    def prioritize_overlay(
+        self, pod: Pod, candidates: List[str]
+    ) -> Optional[List[HostPriority]]:
+        """Gang-member Prioritize: the reserved slice's nodes in
+        row-major slice order (the topology kernel already chose the
+        anchor stranding the fewest free neighbors), ordinal scores like
+        the host path.  None for non-gang pods (the normal ranking
+        serves); an unreservable gang gets an empty list — no node is a
+        good home for a gang that cannot fully place."""
+        spec = GangSpec.from_pod(pod)
+        if spec is None:
+            return None
+        # Filter normally runs first and holds the reservation; this
+        # degenerates to a lookup.  A Prioritize-first arrival drives the
+        # same reservation path so the verbs cannot disagree.
+        self.filter_overlay(pod, candidates)
+        with self._lock:
+            gang = self._gangs.get(spec.gang_id)
+            reserved = (
+                list(gang.reserved_nodes)
+                if gang is not None
+                and gang.state in (STATE_RESERVED, STATE_BOUND)
+                else []
+            )
+        in_request = set(candidates)
+        ordered = [name for name in reserved if name in in_request]
+        return [
+            HostPriority(host=name, score=10 - i)
+            for i, name in enumerate(ordered)
+        ]
+
+    # -- outcome feedback ------------------------------------------------------
+
+    def observe_bind(self, namespace: str, name: str, node: str) -> None:
+        """A member landed: promote it within its gang; the gang is
+        admitted when every member has bound onto the reserved slice."""
+        key = f"{namespace}/{name}"
+        admitted: Optional[_Gang] = None
+        now = self._clock()
+        with self._lock:
+            gang_id = self._member_gang.get(key)
+            if gang_id is None:
+                return
+            gang = self._gangs.get(gang_id)
+            if gang is None or gang.state not in (
+                STATE_RESERVED,
+                STATE_BOUND,
+            ):
+                return
+            if node not in gang.reserved_nodes:
+                klog.v(2).info_s(
+                    f"gang {gang_id}: member {key} bound OFF-slice to "
+                    f"{node}",
+                    component="gang",
+                )
+                return
+            gang.bound[key] = node
+            if (
+                gang.state == STATE_RESERVED
+                and len(gang.bound) >= gang.spec.size
+            ):
+                gang.state = STATE_BOUND
+                gang.expires_at = None
+                admitted = gang
+            gauges = self._publish_gauges_locked()
+        if admitted is not None:
+            trace.COUNTERS.inc("pas_gang_admitted_total")
+            FULL_GANG_LATENCY.observe(
+                admitted.spec.topology_label, max(0.0, now - admitted.created_at)
+            )
+            klog.v(1).info_s(
+                f"gang {admitted.gang_id} fully bound "
+                f"({admitted.spec.size} pods, "
+                f"{admitted.spec.topology_label})",
+                component="gang",
+            )
+        self._set_gauges(gauges)
+
+    def release(self, gang_id: str) -> bool:
+        """Drop a gang and free its slice (job finished or evicted whole
+        by the gang-aware actuator)."""
+        with self._lock:
+            existed = gang_id in self._gangs
+            self._drop_locked(gang_id)
+            gauges = self._publish_gauges_locked()
+        self._set_gauges(gauges)
+        return existed
+
+    # -- introspection ---------------------------------------------------------
+
+    def reserved_nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return self._reserved_map_locked()
+
+    def gang_state(self, gang_id: str) -> Optional[str]:
+        with self._lock:
+            gang = self._gangs.get(gang_id)
+            return gang.state if gang is not None else None
+
+    def prune(self) -> int:
+        now = self._clock()
+        self._sweep_dead_gangs(now, wait=True)
+        with self._lock:
+            expired = self._prune_locked(now)
+            gauges = self._publish_gauges_locked()
+        if expired:
+            trace.COUNTERS.inc(
+                "pas_gang_reservation_expirations_total", expired
+            )
+        self._set_gauges(gauges)
+        return expired
+
+    def snapshot(self) -> Dict:
+        now = self._clock()
+        with self._lock:
+            gangs = sorted(
+                self._gangs.values(), key=lambda g: (g.created_at, g.gang_id)
+            )
+            out = {
+                "enabled": True,
+                "ttl_s": self.ttl_s,
+                "mesh": {
+                    "rows": self._mesh.rows if self._mesh else 0,
+                    "cols": self._mesh.cols if self._mesh else 0,
+                    "nodes": len(self._mesh) if self._mesh else 0,
+                },
+                "gangs": [gang.to_dict(now) for gang in gangs],
+                "reserved_nodes": len(self._reserved_map_locked()),
+            }
+        return out
+
+    def to_json(self) -> bytes:
+        import json
+
+        return json.dumps(self.snapshot()).encode() + b"\n"

@@ -1,0 +1,277 @@
+"""Topology-feasibility kernel: contiguous sub-mesh placement on device.
+
+A multi-host TPU training job needs ``k`` nodes forming a valid ICI
+topology — a contiguous ``h x w`` sub-mesh of the cluster's ``M x N``
+node mesh — placed atomically or not at all (docs/gang.md).  The
+question a gang reservation must answer is: *given the free mask over
+the mesh, where can an ``h x w`` slice go, and which anchor strands the
+fewest free neighbors?*
+
+One fused pass evaluates EVERY candidate anchor position at once, the
+same all-candidates-in-one-program shape as ``ops/binpack.py`` (which
+scans all cards of all nodes per request) and the masked-selection
+idiom of its first-fit (invalid lanes pushed past a big-order sentinel
+rather than branched around):
+
+  * 2-D integral images (two ``cumsum``s) turn "is the whole ``h x w``
+    window free" into four gathers per anchor — ``anchor_ok`` for all
+    anchors in O(M*N);
+  * the same trick over a one-cell halo counts the free cells a placed
+    window would leave stranded on its perimeter — ``anchor_score``
+    (lower = tighter packing, fewer fragments), ``INFEASIBLE``
+    (a binpack-style big-order mask value) where the window does not
+    fit;
+  * a windowed min (``lax.reduce_window``) folds anchor scores onto the
+    nodes they would cover — ``node_score`` ranks every node by the
+    quality of the best slice it could complete, which is exactly what
+    Prioritize needs, and ``node_score < INFEASIBLE`` is the per-node
+    feasibility verdict Filter needs.
+
+Counts are bounded by ``M * N`` mesh cells, so exact int32 suffices —
+unlike binpack's i64 capacities there is nothing to overflow, and the
+host mirror (:func:`topology_feasibility_host`, numpy, used for
+device<->host parity exactly like the dontschedule/GAS dual paths) is
+byte-comparable by construction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
+
+#: big-order sentinel for "no feasible window here" (the masking idiom of
+#: ops/binpack.py's first-fit: invalid lanes sort past every real score)
+INFEASIBLE = 2**30
+
+
+class TopologyFeasibility(NamedTuple):
+    """Host-side (numpy) result — identical from either execution path."""
+
+    anchor_ok: np.ndarray  # bool [M, N]: h x w window at (i, j) is free
+    anchor_score: np.ndarray  # int32 [M, N]: stranded-perimeter count; INFEASIBLE when not ok
+    node_ok: np.ndarray  # bool [M, N]: node is coverable by >= 1 feasible window
+    node_score: np.ndarray  # int32 [M, N]: best (lowest) covering-window score
+
+
+def _window_sums(integral: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    """All ``h x w`` window sums from a padded integral image
+    (``integral[a, b] = sum grid[:a, :b]``)."""
+    return (
+        integral[h:, w:]
+        - integral[:-h, w:]
+        - integral[h:, :-w]
+        + integral[:-h, :-w]
+    )
+
+
+@partial(jax.jit, static_argnames=("h", "w"))
+def _topology_kernel(free: jnp.ndarray, h: int, w: int):
+    """(anchor_ok, anchor_score, node_score) over a bool [M, N] free mask
+    for an ``h x w`` window — one fused pass for every anchor."""
+    m, n = free.shape
+    fi = free.astype(jnp.int32)
+    integral = jnp.zeros((m + 1, n + 1), jnp.int32)
+    integral = integral.at[1:, 1:].set(
+        jnp.cumsum(jnp.cumsum(fi, axis=0), axis=1)
+    )
+    window = _window_sums(integral, h, w)  # [m-h+1, n-w+1]
+    ok_valid = window == h * w
+    # stranded-fragment score: free cells in the one-cell halo ring around
+    # the window that placing it would leave behind (fewest = best anchor)
+    halo_grid = jnp.zeros((m + 2, n + 2), jnp.int32).at[1:-1, 1:-1].set(fi)
+    halo_integral = jnp.zeros((m + 3, n + 3), jnp.int32)
+    halo_integral = halo_integral.at[1:, 1:].set(
+        jnp.cumsum(jnp.cumsum(halo_grid, axis=0), axis=1)
+    )
+    halo = _window_sums(halo_integral, h + 2, w + 2)  # same anchor grid
+    ring = halo - window
+    score_valid = jnp.where(ok_valid, ring, jnp.int32(INFEASIBLE))
+    anchor_ok = jnp.zeros((m, n), bool)
+    anchor_score = jnp.full((m, n), INFEASIBLE, jnp.int32)
+    anchor_ok = anchor_ok.at[: m - h + 1, : n - w + 1].set(ok_valid)
+    anchor_score = anchor_score.at[: m - h + 1, : n - w + 1].set(score_valid)
+    # fold anchor scores onto covered nodes: node (x, y) is covered by
+    # anchors (x-h+1..x, y-w+1..y), a windowed min with top/left padding
+    node_score = jax.lax.reduce_window(
+        anchor_score,
+        jnp.int32(INFEASIBLE),
+        jax.lax.min,
+        window_dimensions=(h, w),
+        window_strides=(1, 1),
+        padding=((h - 1, 0), (w - 1, 0)),
+    )
+    return anchor_ok, anchor_score, node_score
+
+
+def topology_feasibility_device(
+    free: np.ndarray, h: int, w: int
+) -> TopologyFeasibility:
+    """Device path: the jitted kernel over the free mask."""
+    m, n = free.shape
+    if h > m or w > n:  # static shape guard: the window cannot fit at all
+        return _all_infeasible(m, n)
+    anchor_ok, anchor_score, node_score = _topology_kernel(
+        jnp.asarray(free, dtype=bool), int(h), int(w)
+    )
+    node_score_np = np.asarray(node_score)
+    return TopologyFeasibility(
+        anchor_ok=np.asarray(anchor_ok),
+        anchor_score=np.asarray(anchor_score),
+        node_ok=node_score_np < INFEASIBLE,
+        node_score=node_score_np,
+    )
+
+
+def _all_infeasible(m: int, n: int) -> TopologyFeasibility:
+    return TopologyFeasibility(
+        anchor_ok=np.zeros((m, n), bool),
+        anchor_score=np.full((m, n), INFEASIBLE, np.int32),
+        node_ok=np.zeros((m, n), bool),
+        node_score=np.full((m, n), INFEASIBLE, np.int32),
+    )
+
+
+def topology_feasibility_host(
+    free: np.ndarray, h: int, w: int
+) -> TopologyFeasibility:
+    """Exact host mirror of the device kernel (numpy, same integral-image
+    arithmetic) — the parity control and the no-device fallback, mirroring
+    the dontschedule/GAS dual-path structure."""
+    free = np.asarray(free, dtype=bool)
+    m, n = free.shape
+    if h > m or w > n:
+        return _all_infeasible(m, n)
+    fi = free.astype(np.int32)
+    integral = np.zeros((m + 1, n + 1), np.int32)
+    integral[1:, 1:] = np.cumsum(np.cumsum(fi, axis=0), axis=1)
+    window = (
+        integral[h:, w:]
+        - integral[:-h, w:]
+        - integral[h:, :-w]
+        + integral[:-h, :-w]
+    )
+    ok_valid = window == h * w
+    halo_grid = np.zeros((m + 2, n + 2), np.int32)
+    halo_grid[1:-1, 1:-1] = fi
+    halo_integral = np.zeros((m + 3, n + 3), np.int32)
+    halo_integral[1:, 1:] = np.cumsum(np.cumsum(halo_grid, axis=0), axis=1)
+    h2, w2 = h + 2, w + 2
+    halo = (
+        halo_integral[h2:, w2:]
+        - halo_integral[:-h2, w2:]
+        - halo_integral[h2:, :-w2]
+        + halo_integral[:-h2, :-w2]
+    )
+    ring = halo - window
+    anchor_ok = np.zeros((m, n), bool)
+    anchor_score = np.full((m, n), INFEASIBLE, np.int32)
+    anchor_ok[: m - h + 1, : n - w + 1] = ok_valid
+    anchor_score[: m - h + 1, : n - w + 1] = np.where(
+        ok_valid, ring, np.int32(INFEASIBLE)
+    )
+    # windowed min via the h*w shift union (h, w are small static ints)
+    node_score = np.full((m, n), INFEASIBLE, np.int32)
+    for a in range(h):
+        for b in range(w):
+            # anchor (x-a, y-b) covers node (x, y)
+            shifted = np.full((m, n), INFEASIBLE, np.int32)
+            shifted[a:, b:] = anchor_score[: m - a, : n - b]
+            node_score = np.minimum(node_score, shifted)
+    return TopologyFeasibility(
+        anchor_ok=anchor_ok,
+        anchor_score=anchor_score,
+        node_ok=node_score < INFEASIBLE,
+        node_score=node_score,
+    )
+
+
+def topology_feasibility(
+    free: np.ndarray, h: int, w: int, use_device: bool = True
+) -> TopologyFeasibility:
+    """The dual-path entry: device kernel by default, exact host mirror
+    as the control/fallback (device trouble must never fail a verb —
+    the same invariant the TAS fastpath keeps)."""
+    if use_device:
+        try:
+            return topology_feasibility_device(free, h, w)
+        except Exception:
+            pass
+    return topology_feasibility_host(free, h, w)
+
+
+def best_anchor(feas: TopologyFeasibility) -> Optional[Tuple[int, int, int]]:
+    """The deterministic best anchor ``(row, col, score)``: lowest
+    stranded-fragment score, row-major smallest position on ties; None
+    when no window fits."""
+    flat = int(np.argmin(feas.anchor_score))
+    n = feas.anchor_score.shape[1]
+    i, j = divmod(flat, n)
+    score = int(feas.anchor_score[i, j])
+    if score >= INFEASIBLE:
+        return None
+    return i, j, score
+
+
+def slice_cells(i: int, j: int, h: int, w: int) -> List[Tuple[int, int]]:
+    """The window's cells in deterministic row-major order."""
+    return [(i + a, j + b) for a in range(h) for b in range(w)]
+
+
+class MeshView:
+    """Node-name <-> mesh-coordinate mapping built from ``pas-tpu-coord``
+    node labels (testing/fake_kube synthesizes them for hermetic
+    meshes).  Nodes without a parseable coordinate sit outside the mesh
+    and can never join a topology-constrained gang slice."""
+
+    def __init__(self, nodes):
+        coord_of: Dict[str, Tuple[int, int]] = {}
+        name_at: Dict[Tuple[int, int], str] = {}
+        max_row = -1
+        max_col = -1
+        for node in nodes:
+            coord = shared_labels.parse_coord(node.get_labels())
+            if coord is None:
+                continue
+            # first writer wins on a duplicate coordinate (deterministic
+            # given the provider's stable node order)
+            if coord in name_at:
+                continue
+            coord_of[node.name] = coord
+            name_at[coord] = node.name
+            max_row = max(max_row, coord[0])
+            max_col = max(max_col, coord[1])
+        self.coord_of = coord_of
+        self.name_at = name_at
+        self.rows = max_row + 1
+        self.cols = max_col + 1
+
+    def __len__(self) -> int:
+        return len(self.coord_of)
+
+    def free_mask(self, free_names) -> np.ndarray:
+        """bool [rows, cols]: cell is free iff its node is in
+        ``free_names`` (holes — coordinates with no node — stay False)."""
+        mask = np.zeros((self.rows, self.cols), dtype=bool)
+        for name in free_names:
+            coord = self.coord_of.get(name)
+            if coord is not None:
+                mask[coord] = True
+        return mask
+
+    def names_for(self, cells) -> Optional[List[str]]:
+        """The node names at ``cells`` (row-major); None when any cell is
+        a hole."""
+        names = []
+        for cell in cells:
+            name = self.name_at.get(cell)
+            if name is None:
+                return None
+            names.append(name)
+        return names

@@ -18,6 +18,13 @@ subresource is called, in order:
 A 409 from the API server (a real PodDisruptionBudget) is recorded as a
 skipped move with reason ``pdb`` and never retried within the cycle.
 Every outcome increments ``pas_rebalance_moves_{executed,skipped}_total``.
+
+Gang atomicity (docs/gang.md): a pod that is a gang member (carries
+``pas-workload-group`` + ``pas-gang-size``) is never evicted as a
+subset — a plan naming only part of a gang skips those moves with
+reason ``gang_partial``; a plan naming the WHOLE gang gates the gang as
+one unit (any member in cooldown, a group floor breach, or missing rate
+tokens skips the entire gang) and then evicts its members together.
 """
 
 from __future__ import annotations
@@ -28,9 +35,10 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from platform_aware_scheduling_tpu.kube.client import KubeError
-from platform_aware_scheduling_tpu.kube.objects import Pod
+from platform_aware_scheduling_tpu.kube.objects import Pod, object_key
 from platform_aware_scheduling_tpu.rebalance.replan import Move
 from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
 
 MODE_OFF = "off"
 MODE_DRY_RUN = "dry-run"
@@ -41,7 +49,9 @@ DEFAULT_RATE_PER_S = 0.5
 DEFAULT_BURST = 3
 DEFAULT_COOLDOWN_S = 300.0
 DEFAULT_MIN_AVAILABLE = 1
-GROUP_LABEL = "pas-workload-group"
+#: back-compat alias — the definition moved to utils/labels.py so
+#: gang/, rebalance/, and the decision records share one constant
+GROUP_LABEL = shared_labels.GROUP_LABEL
 
 
 class TokenBucket:
@@ -61,6 +71,12 @@ class TokenBucket:
         self._lock = threading.Lock()
 
     def try_take(self) -> bool:
+        return self.try_take_n(1)
+
+    def try_take_n(self, n: int) -> bool:
+        """Take ``n`` tokens atomically or none at all — the gang-atomic
+        eviction gate (a gang larger than ``burst`` can never pass; the
+        operator sizes the burst to the largest gang they will evict)."""
         with self._lock:
             now = self._clock()
             self._tokens = min(
@@ -68,8 +84,8 @@ class TokenBucket:
                 self._tokens + (now - self._last) * self.rate_per_s,
             )
             self._last = now
-            if self._tokens >= 1.0:
-                self._tokens -= 1.0
+            if self._tokens >= float(n):
+                self._tokens -= float(n)
                 return True
             return False
 
@@ -123,6 +139,10 @@ class SafeActuator:
         self._bucket = TokenBucket(rate_per_s, burst, clock)
         self._lock = threading.Lock()
         self._last_evicted: Dict[str, float] = {}  # pod key -> stamp
+        # optional gang.GangTracker (set by assembly when --gang=on): a
+        # fully-evicted gang's slice reservation is released so the mesh
+        # nodes return to the pool instead of being held by a dead gang
+        self.gang_tracker = None
 
     # -- gates -----------------------------------------------------------------
 
@@ -146,9 +166,16 @@ class SafeActuator:
         """Apply the plan.  ``pods_by_key`` maps move.pod_key to the live
         Pod object; ``all_pods`` is the cluster pod list used for group
         min-available accounting (group members evicted earlier in this
-        same call count against the floor)."""
+        same call count against the floor) AND for gang-membership
+        completeness — without it a gang's planned moves are taken as
+        the full membership (nothing to verify against).
+
+        Gang members are never evicted as a subset: partial-gang moves
+        skip with reason ``gang_partial``; whole-gang moves gate and
+        evict atomically (module doc)."""
         result = ActuationResult()
         group_running: Dict[str, int] = {}
+        gang_members: Dict[str, set] = {}  # gang id -> live member keys
         if all_pods is not None:
             for pod in all_pods:
                 # terminating pods (deletionTimestamp set) are already on
@@ -161,7 +188,46 @@ class SafeActuator:
                     continue
                 group = workload_group(pod)
                 group_running[group] = group_running.get(group, 0) + 1
+                gang = shared_labels.gang_id_for(
+                    pod.namespace, pod.get_labels()
+                )
+                if gang is not None:
+                    # membership is compared via object_key on the Pod
+                    # objects THEMSELVES — Move.pod_key's format
+                    # (object_key in production, free-form in tests) is
+                    # never assumed
+                    gang_members.setdefault(gang, set()).add(
+                        object_key(pod)
+                    )
+        singles: List[Move] = []
+        gang_moves: Dict[str, List[Move]] = {}
         for move in moves:
+            pod = pods_by_key.get(move.pod_key)
+            gang = (
+                shared_labels.gang_id_for(pod.namespace, pod.get_labels())
+                if pod is not None
+                else None
+            )
+            if gang is not None:
+                gang_moves.setdefault(gang, []).append(move)
+            else:
+                singles.append(move)
+        for gang, gmoves in gang_moves.items():
+            planned = {
+                object_key(pods_by_key[m.pod_key])
+                for m in gmoves
+                if m.pod_key in pods_by_key
+            }
+            members = gang_members.get(gang, planned)
+            if planned != members:
+                # evicting a subset would leave a half-dead gang holding
+                # its slice: whole gangs or nothing
+                for move in gmoves:
+                    result.skip("gang_partial", move)
+                continue
+            self._actuate_gang(gang, gmoves, pods_by_key, group_running,
+                               all_pods, result)
+        for move in singles:
             pod = pods_by_key.get(move.pod_key)
             if pod is None:
                 result.skip("error", move)
@@ -180,25 +246,10 @@ class SafeActuator:
             if self.mode != MODE_ACTIVE:
                 result.skip("dry_run", move)
                 continue
-            try:
-                self.kube_client.evict_pod(pod.namespace, pod.name)
-            except KubeError as exc:
-                reason = "pdb" if exc.status == 409 else "error"
-                klog.v(2).info_s(
-                    f"eviction of {move.pod_key} refused ({reason}): {exc}",
-                    component="rebalance",
-                )
-                result.skip(reason, move)
+            if not self._evict(move, pod, result):
                 continue
-            self._stamp(move.pod_key)
             if group in group_running:
                 group_running[group] -= 1
-            result.executed.append(move)
-            klog.v(2).info_s(
-                f"evicted {move.pod_key}: {move.from_node} -> "
-                f"{move.to_node} (gain {move.gain})",
-                component="rebalance",
-            )
         if result.executed:
             trace.COUNTERS.inc(
                 "pas_rebalance_moves_executed_total", len(result.executed)
@@ -210,3 +261,86 @@ class SafeActuator:
                 labels={"reason": reason},
             )
         return result
+
+    def _evict(self, move: Move, pod: Pod, result: ActuationResult) -> bool:
+        """One eviction through the subresource; False records the skip
+        (409 -> ``pdb``, anything else -> ``error``)."""
+        try:
+            self.kube_client.evict_pod(pod.namespace, pod.name)
+        except KubeError as exc:
+            reason = "pdb" if exc.status == 409 else "error"
+            klog.v(2).info_s(
+                f"eviction of {move.pod_key} refused ({reason}): {exc}",
+                component="rebalance",
+            )
+            result.skip(reason, move)
+            return False
+        self._stamp(move.pod_key)
+        result.executed.append(move)
+        klog.v(2).info_s(
+            f"evicted {move.pod_key}: {move.from_node} -> "
+            f"{move.to_node} (gain {move.gain})",
+            component="rebalance",
+        )
+        return True
+
+    def _actuate_gang(
+        self,
+        gang: str,
+        gmoves: List[Move],
+        pods_by_key: Dict[str, Pod],
+        group_running: Dict[str, int],
+        all_pods: Optional[List[Pod]],
+        result: ActuationResult,
+    ) -> None:
+        """Whole-gang atomic actuation: every gate is evaluated for the
+        gang as one unit BEFORE any eviction, so a mid-gang gate trip can
+        never strand a half-evicted gang.  (An API-server refusal on one
+        member mid-flight is recorded per pod — the server, not this
+        actuator, broke atomicity there.)"""
+        pods = []
+        for move in gmoves:
+            pod = pods_by_key.get(move.pod_key)
+            if pod is None:
+                for m in gmoves:
+                    result.skip("error", m)
+                return
+            pods.append(pod)
+        if any(self._in_cooldown(m.pod_key) for m in gmoves):
+            for m in gmoves:
+                result.skip("cooldown", m)
+            return
+        if all_pods is not None:
+            floor_breach: Dict[str, int] = {}
+            for pod in pods:
+                group = workload_group(pod)
+                floor_breach[group] = floor_breach.get(group, 0) + 1
+            for group, n in floor_breach.items():
+                if group_running.get(group, 0) - n < self.min_available:
+                    for m in gmoves:
+                        result.skip("min_available", m)
+                    return
+        if not self._bucket.try_take_n(len(gmoves)):
+            for m in gmoves:
+                result.skip("rate_limit", m)
+            return
+        if self.mode != MODE_ACTIVE:
+            for m in gmoves:
+                result.skip("dry_run", m)
+            return
+        klog.v(2).info_s(
+            f"evicting gang {gang} atomically ({len(gmoves)} pods)",
+            component="rebalance",
+        )
+        evicted = 0
+        for move, pod in zip(gmoves, pods):
+            if self._evict(move, pod, result):
+                evicted += 1
+                group = workload_group(pod)
+                if group in group_running:
+                    group_running[group] -= 1
+        if evicted == len(gmoves) and self.gang_tracker is not None:
+            # the whole gang is gone: free its slice reservation (a
+            # partially-refused gang keeps its hold; the tracker's
+            # dead-gang sweep reclaims it once every member disappears)
+            self.gang_tracker.release(gang)

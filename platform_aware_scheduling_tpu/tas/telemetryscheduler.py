@@ -103,6 +103,14 @@ class MetricsExtender:
         # --rebalance != off; the front-ends serve its last plan on
         # GET /debug/rebalance (404 while this is None)
         self.rebalancer = None
+        # opt-in gang.GangTracker, set by assembly when --gang=on: gang
+        # members Filter/Prioritize against their reserved slice, other
+        # pods fail gang-held nodes, Bind promotes reservations, and the
+        # front-ends serve GET /debug/gangs (404 while this is None).
+        # While set, the Filter response cache and the native Prioritize
+        # scanner are bypassed — the gang verdict is pod-label-dependent
+        # state the span-keyed caches cannot key (docs/gang.md)
+        self.gangs = None
         # opt-in tas.degraded.DegradedModeController, set by assembly:
         # when telemetry goes stale or a circuit opens, Filter fails
         # open/closed per --degradedMode and Prioritize degrades to
@@ -357,7 +365,10 @@ class MetricsExtender:
                     degraded_action = action
                     span.set("degraded", reason)
             probe = None
-            if degraded_action is None:
+            if degraded_action is None and self.gangs is None:
+                # gang mode bypasses the response cache entirely: the
+                # verdict depends on pod gang labels + live reservation
+                # state, which the span-keyed cache cannot key
                 with span.stage("cache_probe"):
                     probe = self._filter_cache_probe(request)
             # hit/miss attribution happens inside the probe, at its
@@ -374,8 +385,11 @@ class MetricsExtender:
                 args = self._decode(request)
             if args is None:
                 return HTTPResponse()
+            gang_codes: Dict[str, int] = {}
             with span.stage("kernel"):
-                result = self._filter_nodes(args, degraded=degraded_action)
+                result = self._filter_nodes(
+                    args, degraded=degraded_action, gang_codes=gang_codes
+                )
             if result is None:
                 klog.v(2).info_s("No filtered nodes returned", component="extender")
                 return HTTPResponse.json(b"null\n", status=404)
@@ -396,6 +410,15 @@ class MetricsExtender:
                 elif degraded_action == degraded_mode.ACTION_FAIL_OPEN:
                     path = "fail_open"
                 candidates = self._candidate_names(args)
+                reason_counts = None
+                if gang_codes:
+                    # a gang overlay mixes reason classes in one request:
+                    # count each failed node under its own code so the
+                    # per-reason counters stay exact
+                    reason_counts = {}
+                    for name in result.failed_nodes:
+                        code = gang_codes.get(name, reason_code)
+                        reason_counts[code] = reason_counts.get(code, 0) + 1
                 decisions.DECISIONS.record_filter(
                     request_id=span.trace_id,
                     pod_namespace=args.pod.namespace,
@@ -407,6 +430,7 @@ class MetricsExtender:
                     violating=dict(result.failed_nodes),
                     violating_scope="request",
                     reason_code=reason_code,
+                    reason_counts=reason_counts,
                 )
             return HTTPResponse.json(body)
         finally:
@@ -536,10 +560,13 @@ class MetricsExtender:
     def bind(self, request: HTTPRequest) -> HTTPResponse:
         # TAS does not implement Bind (telemetryscheduler.go:179-181) —
         # the 404 wire behavior is untouched, but the body (the real
-        # kube-scheduler POSTs BindingArgs regardless) is the decision
-        # log's outcome feedback: which node the pod actually landed on
-        # closes the pod's open Filter/Prioritize records
-        if decisions.DECISIONS.enabled and request.body:
+        # kube-scheduler POSTs BindingArgs regardless) is outcome
+        # feedback: which node the pod actually landed on closes the
+        # pod's open decision records AND promotes its gang reservation
+        # toward fully-bound (gang/group.py observe_bind)
+        if (
+            decisions.DECISIONS.enabled or self.gangs is not None
+        ) and request.body:
             try:
                 from platform_aware_scheduling_tpu.extender.types import (
                     BindingArgs,
@@ -547,9 +574,14 @@ class MetricsExtender:
 
                 args = BindingArgs.from_json(request.body)
                 if args.pod_name and args.node:
-                    decisions.DECISIONS.observe_bind(
-                        args.pod_namespace, args.pod_name, args.node
-                    )
+                    if decisions.DECISIONS.enabled:
+                        decisions.DECISIONS.observe_bind(
+                            args.pod_namespace, args.pod_name, args.node
+                        )
+                    if self.gangs is not None:
+                        self.gangs.observe_bind(
+                            args.pod_namespace, args.pod_name, args.node
+                        )
             except Exception:
                 pass  # feedback is best-effort; the verb stays a 404
         return HTTPResponse(status=404)
@@ -571,6 +603,12 @@ class MetricsExtender:
         materialized str cannot UTF-8-encode for the name-table lookup.
         Either way the request must fall back to the exact path, never
         drop the connection (round-2 advisor finding)."""
+        if self.gangs is not None:
+            # the parsed wire view exposes no pod gang labels, so the
+            # native scanner cannot tell a gang member apart — with gang
+            # tracking on, Prioritize serves through the exact path,
+            # whose overlay can (docs/gang.md)
+            return None
         if self.fastpath is None:
             return None
         wirec = get_wirec()
@@ -744,6 +782,34 @@ class MetricsExtender:
     ) -> bytes:
         """prioritizeNodes (telemetryscheduler.go:81-100) down to response
         bytes: any failure degrades to an empty priority list."""
+        if self.gangs is not None:
+            try:
+                # a Prioritize-FIRST arrival drives the same reservation
+                # path Filter would, so it must solve over the same
+                # telemetry-clean candidate set — otherwise it could
+                # reserve a slice containing a violating node that
+                # Filter will then never pass (the livelock the Filter
+                # path explicitly excludes)
+                gang_result = self.gangs.prioritize_overlay(
+                    args.pod, self._telemetry_clean(args.pod, names)
+                )
+            except Exception as exc:  # overlay fails open to the ranking
+                klog.error("gang prioritize overlay failed open: %s", exc)
+                gang_result = None
+            if gang_result is not None:
+                # gang member: the reserved slice in row-major order (the
+                # anchor already minimizes stranded fragments); empty
+                # when the gang cannot fully place — no node is a good
+                # home for an unplaceable gang
+                span.set("path", "gang")
+                with span.stage("encode"):
+                    body = encode_host_priority_list(gang_result)
+                self._record_prioritize(
+                    span, args.pod.namespace, args.pod.name,
+                    args.pod.get_labels().get(TAS_POLICY_LABEL, ""),
+                    "gang", None, len(names), result=gang_result,
+                )
+                return body
         try:
             policy = self._policy_from_pod(args.pod)
         except Exception as exc:
@@ -790,6 +856,23 @@ class MetricsExtender:
         )
         return body
 
+    def _telemetry_clean(self, pod: Pod, names: List[str]) -> List[str]:
+        """``names`` minus the pod policy's current dontschedule
+        violation set — the candidate pool a gang reservation may solve
+        over.  Best-effort: with no policy/strategy resolvable, the full
+        list stands (Filter's own resolution owns the error paths)."""
+        try:
+            policy = self._policy_from_pod(pod)
+            strategy = self._dontschedule_strategy(policy)
+            if strategy is None:
+                return names
+            violating = self._violating_nodes(policy, strategy)
+        except Exception:
+            return names
+        if not violating:
+            return names
+        return [name for name in names if name not in violating]
+
     def _apply_plan(
         self, pod: Pod, result: List[HostPriority]
     ) -> List[HostPriority]:
@@ -833,12 +916,21 @@ class MetricsExtender:
     # -- filter logic ----------------------------------------------------------
 
     def _filter_nodes(
-        self, args: Args, degraded: Optional[str] = None
+        self,
+        args: Args,
+        degraded: Optional[str] = None,
+        gang_codes: Optional[Dict[str, int]] = None,
     ) -> Optional[FilterResult]:
         """filterNodes (telemetryscheduler.go:184-225).  ``degraded``
         overrides ONLY the telemetry-dependent violation set: fail_open
         -> no node violates, fail_closed -> every candidate violates;
-        policy resolution (informer-fed, not telemetry) stays exact."""
+        policy resolution (informer-fed, not telemetry) stays exact.
+
+        With a gang tracker wired, its overlay merges OVER the telemetry
+        verdict: gang members pass only their reserved slice, other pods
+        fail gang-held nodes (docs/gang.md); ``gang_codes`` (when given)
+        is filled with {node: decision reason code} for the overlay's
+        failures so the caller's decision record counts them exactly."""
         try:
             policy = self._policy_from_pod(args.pod)
         except Exception as exc:
@@ -866,6 +958,33 @@ class MetricsExtender:
             }
         else:
             violating = self._violating_nodes(policy, strategy)
+        if self.gangs is not None:
+            try:
+                # the overlay sees only telemetry-CLEAN candidates: a
+                # violating node must not enter the reservation solve's
+                # free mask, or a gang could deterministically reserve a
+                # slice it can never fully bind (livelock) while a clean
+                # slice elsewhere goes unused.  Violating nodes keep
+                # their telemetry reason in the merge below.
+                clean = [
+                    name
+                    for name in self._candidate_names(args)
+                    if name not in violating
+                ]
+                gang_failed, codes = self.gangs.filter_overlay(
+                    args.pod, clean
+                )
+            except Exception as exc:
+                # the overlay fails OPEN: gang trouble must never take
+                # down plain telemetry filtering
+                klog.error("gang filter overlay failed open: %s", exc)
+                gang_failed, codes = {}, {}
+            if gang_failed:
+                # the gang verdict wins a collision: "reserved by gang X"
+                # is the actionable reason for an operator
+                violating = {**violating, **gang_failed}
+                if gang_codes is not None:
+                    gang_codes.update(codes)
         if not args.nodes:
             if self.node_cache_capable and args.node_names:
                 return self._filter_node_names(policy, args.node_names, violating)

@@ -73,3 +73,46 @@ def make_policy(
 
 def rule(metricname: str, operator: str, target: int) -> Dict:
     return {"metricname": metricname, "operator": operator, "target": target}
+
+
+def make_mesh_nodes(rows: int, cols: int, prefix: str = "mesh") -> List[Node]:
+    """``rows x cols`` Node objects carrying ``pas-tpu-coord`` mesh
+    labels (row-major ``{prefix}-{row}-{col}``) — the in-memory analog
+    of FakeKubeClient.add_mesh for tests that never touch a client."""
+    from platform_aware_scheduling_tpu.utils import labels as shared_labels
+
+    return [
+        make_node(
+            f"{prefix}-{row}-{col}",
+            labels={
+                shared_labels.TPU_COORD_LABEL: shared_labels.format_coord(
+                    row, col
+                )
+            },
+        )
+        for row in range(rows)
+        for col in range(cols)
+    ]
+
+
+def make_gang_pod(
+    name: str,
+    group: str,
+    size: int,
+    topology: str = "",
+    namespace: str = "default",
+    policy: str = "",
+    **kwargs,
+) -> Pod:
+    """A gang-member pod: group + size (+ optional topology) labels
+    (utils/labels.py), plus the telemetry-policy label when given."""
+    from platform_aware_scheduling_tpu.utils import labels as shared_labels
+
+    labels = dict(kwargs.pop("labels", None) or {})
+    labels[shared_labels.GROUP_LABEL] = group
+    labels[shared_labels.GANG_SIZE_LABEL] = str(size)
+    if topology:
+        labels[shared_labels.GANG_TOPOLOGY_LABEL] = topology
+    if policy:
+        labels["telemetry-policy"] = policy
+    return make_pod(name, namespace=namespace, labels=labels, **kwargs)

@@ -24,6 +24,16 @@ from platform_aware_scheduling_tpu.kube.client import (
     NotFoundError,
 )
 from platform_aware_scheduling_tpu.kube.objects import Node, Pod
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
+
+
+def mesh_coord_labels(row: int, col: int) -> Dict[str, str]:
+    """The node labels carrying one mesh coordinate (the production
+    cluster's ``pas-tpu-coord``), synthesized for hermetic gang tests
+    and benchmarks — no real cluster labels needed (docs/gang.md)."""
+    return {
+        shared_labels.TPU_COORD_LABEL: shared_labels.format_coord(row, col)
+    }
 
 
 def _unescape_pointer(token: str) -> str:
@@ -143,6 +153,34 @@ class FakeKubeClient:
             raw = self._nodes.pop(name, None)
         if raw is not None:
             self._hubs["nodes"].publish("DELETED", raw)
+
+    def add_mesh(
+        self,
+        rows: int,
+        cols: int,
+        prefix: str = "mesh",
+        extra_labels: Optional[Dict[str, str]] = None,
+    ) -> List[str]:
+        """Seed an ``rows x cols`` TPU node mesh: one node per cell
+        carrying its ``pas-tpu-coord`` label (row-major names
+        ``{prefix}-{row}-{col}``).  Returns the node names in row-major
+        order — the hermetic substrate of tests/test_gang.py and
+        benchmarks/gang_load.py."""
+        names: List[str] = []
+        for row in range(rows):
+            for col in range(cols):
+                name = f"{prefix}-{row}-{col}"
+                labels = dict(mesh_coord_labels(row, col))
+                if extra_labels:
+                    labels.update(extra_labels)
+                self.add_node(
+                    {
+                        "metadata": {"name": name, "labels": labels},
+                        "status": {"allocatable": {}},
+                    }
+                )
+                names.append(name)
+        return names
 
     # -- nodes ---------------------------------------------------------------
 

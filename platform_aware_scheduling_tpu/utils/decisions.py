@@ -63,6 +63,8 @@ CODE_GAS_UNKNOWN_NODE = 3
 CODE_GAS_NO_GPUS = 4
 CODE_GAS_CAPACITY = 5
 CODE_GAS_ERROR = 6  # host-loop unexpected failure; no device analog
+CODE_GANG_RESERVED = 7  # node held by another gang's reservation
+CODE_GANG_INFEASIBLE = 8  # no feasible slice / node outside the gang's slice
 
 #: code -> bounded Prometheus ``reason`` label (never per-rule/per-node:
 #: label cardinality stays fixed; per-rule detail lives in the records
@@ -74,6 +76,8 @@ CODE_LABELS: Dict[int, str] = {
     CODE_GAS_NO_GPUS: "gas_no_gpus",
     CODE_GAS_CAPACITY: "gas_capacity",
     CODE_GAS_ERROR: "gas_error",
+    CODE_GANG_RESERVED: "gang_reserved",
+    CODE_GANG_INFEASIBLE: "gang_infeasible",
 }
 
 REASON_FAIL_CLOSED = "degraded fail-closed"

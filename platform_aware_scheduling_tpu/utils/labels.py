@@ -1,0 +1,104 @@
+"""Shared cluster-object label vocabulary.
+
+One home for every ``pas-*`` label the subsystems read off pods and
+nodes, so ``gang/``, ``rebalance/``, and the decision records all import
+one definition (hoisted out of ``rebalance/actuator.py``, which keeps a
+back-compat alias).  This module must stay importable without jax.
+
+  * ``GROUP_LABEL`` — the workload-group key: the rebalance actuator's
+    min-available accounting unit AND (together with ``GANG_SIZE_LABEL``)
+    the gang identity for all-or-nothing co-scheduling (docs/gang.md);
+  * ``GANG_SIZE_LABEL`` — the gang's total member count ``k``; a pod
+    carrying both group and size labels is a gang member;
+  * ``GANG_TOPOLOGY_LABEL`` — the required ICI sub-mesh shape, e.g.
+    ``4x4`` (a contiguous 4-row by 4-column slice); absent means any
+    ``k`` mesh nodes (no adjacency constraint);
+  * ``TPU_COORD_LABEL`` — a node's mesh coordinate ``"row,col"``
+    (synthesized by testing/fake_kube for hermetic meshes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+GROUP_LABEL = "pas-workload-group"
+GANG_SIZE_LABEL = "pas-gang-size"
+GANG_TOPOLOGY_LABEL = "pas-gang-topology"
+TPU_COORD_LABEL = "pas-tpu-coord"
+
+
+def gang_id_for(namespace: str, pod_labels: Dict[str, str]) -> Optional[str]:
+    """The gang identity of a pod, or None when the pod is not a gang
+    member.  A gang needs BOTH the group label (identity) and a
+    WELL-FORMED size label (+ consistent topology when given) — a bare
+    ``pas-workload-group`` stays what it always was: the rebalance
+    min-available unit.  The validation here is the single classifier
+    (GangSpec.from_pod gates on it), so a pod with a malformed gang
+    label is non-gang EVERYWHERE — scheduler and rebalance actuator can
+    never disagree about membership."""
+    group = pod_labels.get(GROUP_LABEL)
+    if not group:
+        return None
+    raw_size = pod_labels.get(GANG_SIZE_LABEL)
+    if raw_size is None:
+        return None
+    try:
+        size = int(raw_size)
+    except ValueError:
+        return None
+    if size < 1:
+        return None
+    raw_topo = pod_labels.get(GANG_TOPOLOGY_LABEL)
+    if raw_topo:
+        topo = parse_topology(raw_topo)
+        if topo is None or topo[0] * topo[1] != size:
+            return None
+    return f"{namespace}/{group}"
+
+
+#: sanity ceiling per mesh dimension: the dense [rows, cols] grids the
+#: topology kernel allocates are sized by the LARGEST labeled
+#: coordinate, so one mislabeled node (``"1000000,1000000"``) must not
+#: turn every gang Filter into a terabyte allocation.  1024x1024 = 1M
+#: cells comfortably covers real TPU pod meshes.
+MAX_MESH_DIM = 1024
+
+
+def format_coord(row: int, col: int) -> str:
+    """The ``pas-tpu-coord`` label value for one mesh cell — the single
+    writer-side formatter (parse_coord is the reader); every mesh
+    synthesizer goes through it so the wire format cannot fork."""
+    return f"{row},{col}"
+
+
+def parse_coord(node_labels: Dict[str, str]) -> Optional[tuple]:
+    """``pas-tpu-coord: "2,3"`` -> (2, 3); None when absent/malformed or
+    outside the ``MAX_MESH_DIM`` sanity bound (a coordinate-less node
+    simply sits outside the mesh)."""
+    raw = node_labels.get(TPU_COORD_LABEL)
+    if not raw:
+        return None
+    row, sep, col = raw.partition(",")
+    if not sep:
+        return None
+    try:
+        i, j = int(row), int(col)
+    except ValueError:
+        return None
+    if i < 0 or j < 0 or i >= MAX_MESH_DIM or j >= MAX_MESH_DIM:
+        return None
+    return i, j
+
+
+def parse_topology(raw: str) -> Optional[tuple]:
+    """``"4x4"`` -> (4, 4); None when malformed."""
+    a, sep, b = raw.partition("x")
+    if not sep:
+        return None
+    try:
+        rows, cols = int(a), int(b)
+    except ValueError:
+        return None
+    if rows <= 0 or cols <= 0:
+        return None
+    return rows, cols

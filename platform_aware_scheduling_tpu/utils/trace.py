@@ -132,7 +132,7 @@ declare("pas_profile_captures_total", "counter", "Bounded jax.profiler traces ca
 declare("pas_rebalance_plans_total", "counter", "Rebalance cycles that produced a plan (including empty plans).")
 declare("pas_rebalance_moves_planned_total", "counter", "Pod moves proposed by rebalance plans (within the churn budget).")
 declare("pas_rebalance_moves_executed_total", "counter", "Pod evictions actually executed by the rebalance actuator.")
-declare("pas_rebalance_moves_skipped_total", "counter", "Planned moves not executed (label: reason in dry_run/rate_limit/cooldown/min_available/pdb/error).")
+declare("pas_rebalance_moves_skipped_total", "counter", "Planned moves not executed (label: reason in dry_run/rate_limit/cooldown/min_available/pdb/gang_partial/error).")
 declare("pas_rebalance_candidate_nodes", "gauge", "Nodes currently past the deschedule hysteresis threshold (eviction candidates).")
 declare("pas_rebalance_convergence_cycles", "gauge", "Enforcement cycles the most recent violation episode took from first violation back to zero.")
 declare("pas_rebalance_plan_latency_seconds", "gauge", "Wall latency of the most recent incremental replan solve.")
@@ -148,12 +148,21 @@ declare("pas_degraded", "gauge", "1 while the named subsystem runs degraded: tel
 # placement-quality feedback, /debug/decisions; docs/observability.md
 # "Decision provenance")
 declare("pas_decision_records_total", "counter", "Scheduling decisions recorded into the decision log (label: verb in filter/prioritize/gas_filter/rebalance).")
-declare("pas_decision_filtered_nodes_total", "counter", "Nodes filtered out of scheduling decisions, by reason class (label: reason in rule_violation/fail_closed/gas_unknown_node/gas_no_gpus/gas_capacity/gas_error).")
+declare("pas_decision_filtered_nodes_total", "counter", "Nodes filtered out of scheduling decisions, by reason class (label: reason in rule_violation/fail_closed/gas_unknown_node/gas_no_gpus/gas_capacity/gas_error/gang_reserved/gang_infeasible).")
 declare("pas_decision_open", "gauge", "Decision records currently awaiting outcome feedback (pod bind / rebalance).")
 declare("pas_decision_closed_total", "counter", "Decision records closed by a pod-bind observation.")
 declare("pas_decision_violated_at_bind_total", "counter", "Pods bound onto a node the Filter decision had marked violating — the placement-quality red flag.")
 declare("pas_decision_chosen_rank_total", "counter", "Bind observations by the chosen node's rank in the Prioritize ordering (label: rank in 1/2/3/4_8/9_16/17_plus/unknown).")
 declare("pas_decision_evicted_open_total", "counter", "Open decision records overwritten by the ring before any outcome feedback arrived (ring too small for the bind latency).")
+# gang & topology-aware scheduling (gang/group.py + ops/topology.py:
+# atomic multi-host slice placement with TTL reservations; docs/gang.md)
+declare("pas_gang_reservations_total", "counter", "Gang slice reservations created (a feasible anchor found and its nodes held).")
+declare("pas_gang_reservation_expirations_total", "counter", "Gang reservations reclaimed after their TTL expired before the gang fully bound.")
+declare("pas_gang_admitted_total", "counter", "Gangs fully bound (every member landed on its reserved slice).")
+declare("pas_gang_rejected_total", "counter", "Gang Filter passes that found no feasible slice (label: reason in infeasible/no_mesh).")
+declare("pas_gang_active", "gauge", "Gangs currently tracked and not yet fully bound (forming or reserved).")
+declare("pas_gang_reserved_nodes", "gauge", "Nodes currently held by gang reservations (bound gangs included until released).")
+declare("pas_gang_time_to_full_seconds", "histogram", "Time from a gang's first sighting to fully bound (label: topology).")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where
@@ -527,6 +536,14 @@ def help_texts() -> Dict[str, str]:
     return {name: help_text for name, (_kind, help_text) in METRICS.items()}
 
 
+#: process-wide extra exposition providers (zero-arg -> valid exposition
+#: text or ""), appended to every /metrics page: subsystems whose metric
+#: family is not a plain counter/gauge (the gang tracker's
+#: pas_gang_time_to_full_seconds histogram lives in its own
+#: LatencyRecorder) register ONE provider here at import time.
+EXTRA_PROVIDERS: List[Callable[[], str]] = []
+
+
 def exposition(
     recorders: Iterable[LatencyRecorder] = (),
     counter_sets: Iterable[CounterSet] = (),
@@ -535,14 +552,16 @@ def exposition(
     """One valid Prometheus text page: every recorder merged under the
     single ``pas_request_duration_seconds`` family (one # TYPE line no
     matter how many recorders feed it), then each counter set, then the
-    process-wide COUNTERS.  HELP text comes from the declared METRICS
-    inventory."""
+    process-wide COUNTERS and EXTRA_PROVIDERS.  HELP text comes from the
+    declared METRICS inventory."""
     helps = help_texts()
     parts = [histograms_text(list(recorders), help_texts=helps)]
     for cs in counter_sets:
         parts.append(cs.prometheus_text(help_texts=helps))
     if include_global:
         parts.append(COUNTERS.prometheus_text(help_texts=helps))
+        for provider in list(EXTRA_PROVIDERS):
+            parts.append(provider())
     return "".join(parts)
 
 

@@ -268,6 +268,7 @@ def histograms_text(
     recorders: Iterable["LatencyRecorder"],
     metric: str = HISTOGRAM_METRIC,
     help_texts: Optional[Dict[str, str]] = None,
+    label_name: str = "verb",
 ) -> str:
     """All recorders' labels merged under ONE histogram family with a
     single ``# TYPE`` line — concatenating per-recorder dumps would emit
@@ -300,10 +301,13 @@ def histograms_text(
         for bound, n in zip(_BUCKETS, buckets):
             cumulative += n
             lines.append(
-                f'{metric}_bucket{{verb="{label}",le="{bound:g}"}} {cumulative}'
+                f'{metric}_bucket{{{label_name}="{label}",le="{bound:g}"}} '
+                f"{cumulative}"
             )
         cumulative += buckets[-1]
-        lines.append(f'{metric}_bucket{{verb="{label}",le="+Inf"}} {cumulative}')
-        lines.append(f'{metric}_sum{{verb="{label}"}} {total:.9f}')
-        lines.append(f'{metric}_count{{verb="{label}"}} {count}')
+        lines.append(
+            f'{metric}_bucket{{{label_name}="{label}",le="+Inf"}} {cumulative}'
+        )
+        lines.append(f'{metric}_sum{{{label_name}="{label}"}} {total:.9f}')
+        lines.append(f'{metric}_count{{{label_name}="{label}"}} {count}')
     return "\n".join(lines) + "\n"

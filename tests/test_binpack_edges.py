@@ -1,0 +1,150 @@
+"""Pin ops/binpack.py edge cases the gang/topology work leans on
+(ISSUE 7 satellite): zero-card nodes, requests exactly equal to per-card
+capacity, and int64 saturation near the quantization bound.  These pin
+CURRENT behavior so the shared i64/masking machinery can be reused with
+known semantics."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops.binpack import (
+    BinpackNodeState,
+    BinpackRequest,
+    NO_CARD,
+    binpack_kernel,
+)
+
+INT64_MAX = 2**63 - 1
+
+
+def make_state(used, capacity, card_valid=None, card_real=None):
+    """[1, C, R] single-node state from plain int lists."""
+    used = np.asarray(used, dtype=np.int64)[None, :, :]  # [1, C, R]
+    capacity = np.asarray(capacity, dtype=np.int64)[None, :]  # [1, R]
+    n, c, r = used.shape
+    used_hi, used_lo = i64.split_int64_np(used)
+    cap_hi, cap_lo = i64.split_int64_np(capacity)
+    valid = (
+        np.ones((n, c), bool)
+        if card_valid is None
+        else np.asarray(card_valid, bool)[None, :]
+    )
+    real = (
+        np.ones((n, c), bool)
+        if card_real is None
+        else np.asarray(card_real, bool)[None, :]
+    )
+    return BinpackNodeState(
+        used=i64.I64(hi=jnp.asarray(used_hi), lo=jnp.asarray(used_lo)),
+        capacity=i64.I64(hi=jnp.asarray(cap_hi), lo=jnp.asarray(cap_lo)),
+        cap_present=jnp.ones((n, r), bool),
+        card_valid=jnp.asarray(valid),
+        card_real=jnp.asarray(real),
+        card_order=jnp.broadcast_to(
+            jnp.arange(c, dtype=jnp.int32), (n, c)
+        ),
+    )
+
+
+def make_request(need, num_gpus=1):
+    """[1, R] single-container request."""
+    need = np.asarray(need, dtype=np.int64)[None, :]
+    need_hi, need_lo = i64.split_int64_np(need)
+    return BinpackRequest(
+        need=i64.I64(hi=jnp.asarray(need_hi), lo=jnp.asarray(need_lo)),
+        need_active=jnp.asarray(need != 0)
+        if np.any(need)
+        else jnp.ones_like(jnp.asarray(need), bool),
+        num_gpus=jnp.asarray([num_gpus], dtype=jnp.int32),
+        container_active=jnp.asarray([True]),
+    )
+
+
+class TestZeroCardNodes:
+    def test_no_real_cards_fails_a_gpu_request(self):
+        state = make_state(
+            used=[[0], [0]], capacity=[100],
+            card_real=[False, False],
+        )
+        result = binpack_kernel(state, make_request([10]), max_gpus=1)
+        assert not bool(result.fits[0])
+        assert int(result.cards[0, 0, 0]) == int(NO_CARD)
+
+    def test_no_valid_cards_fails_a_gpu_request(self):
+        """Cards gone from the node's GPU label (card_valid false) are
+        just as unusable as padding lanes."""
+        state = make_state(
+            used=[[0], [0]], capacity=[100],
+            card_valid=[False, False],
+        )
+        result = binpack_kernel(state, make_request([10]), max_gpus=1)
+        assert not bool(result.fits[0])
+
+    def test_zero_gpu_container_fits_a_cardless_node(self):
+        """A container wanting zero GPUs books nothing and passes even
+        with no cards at all (wanted = step < num_gpus never holds)."""
+        state = make_state(
+            used=[[0]], capacity=[100], card_real=[False],
+        )
+        result = binpack_kernel(
+            state, make_request([10], num_gpus=0), max_gpus=1
+        )
+        assert bool(result.fits[0])
+
+
+class TestExactCapacity:
+    def test_request_exactly_equal_to_capacity_fits(self):
+        """used + need == cap passes checkResourceCapacity (<=, not <)."""
+        state = make_state(used=[[0]], capacity=[100])
+        result = binpack_kernel(state, make_request([100]), max_gpus=1)
+        assert bool(result.fits[0])
+        assert int(result.cards[0, 0, 0]) == 0
+
+    def test_one_unit_over_capacity_fails(self):
+        state = make_state(used=[[1]], capacity=[100])
+        result = binpack_kernel(state, make_request([100]), max_gpus=1)
+        assert not bool(result.fits[0])
+
+    def test_two_full_cap_shares_take_two_cards(self):
+        """Each share fills a card exactly; first-fit walks to the next
+        card in order rather than overflowing the first."""
+        state = make_state(used=[[0], [0]], capacity=[100])
+        result = binpack_kernel(
+            state, make_request([100], num_gpus=2), max_gpus=2
+        )
+        assert bool(result.fits[0])
+        picks = [int(result.cards[0, 0, k]) for k in range(2)]
+        assert picks == [0, 1]
+
+
+class TestI64Saturation:
+    def test_sum_overflowing_int64_fails(self):
+        """used + need past INT64_MAX must be detected as overflow (the
+        split-limb sign-flip check), never wrap into a bogus fit."""
+        state = make_state(used=[[INT64_MAX - 1]], capacity=[INT64_MAX])
+        result = binpack_kernel(state, make_request([2]), max_gpus=1)
+        assert not bool(result.fits[0])
+
+    def test_sum_landing_exactly_on_int64_max_fits(self):
+        state = make_state(used=[[INT64_MAX - 2]], capacity=[INT64_MAX])
+        result = binpack_kernel(state, make_request([2]), max_gpus=1)
+        assert bool(result.fits[0])
+
+    def test_negative_need_fails(self):
+        """A negative request share can never fit (need_neg gate)."""
+        state = make_state(used=[[0]], capacity=[100])
+        result = binpack_kernel(state, make_request([-1]), max_gpus=1)
+        assert not bool(result.fits[0])
+
+    def test_nonpositive_capacity_fails(self):
+        """Capacity <= 0 fails cap_ok even for a zero-cost share."""
+        state = make_state(used=[[0]], capacity=[0])
+        result = binpack_kernel(state, make_request([1]), max_gpus=1)
+        assert not bool(result.fits[0])
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

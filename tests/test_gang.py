@@ -1,0 +1,1182 @@
+"""Gang & topology-aware scheduling suite (ISSUE 7, docs/gang.md).
+
+Covers the whole new capability layer:
+
+  * topology-feasibility kernel device<->host parity (byte-exact arrays)
+    and its edge cases;
+  * GangTracker reservation lifecycle on a fake clock: forming ->
+    reserved -> bound -> released, TTL expiry + reclaim, competing-gang
+    serialization, rejection reasons, counters;
+  * verb integration: gang members Filter/Prioritize against their
+    reserved slice with concrete reasons through the decision-provenance
+    taxonomy, Bind promotes reservations, non-gang pods fail gang-held
+    nodes;
+  * the ACCEPTANCE invariant over real sockets on BOTH front-ends: two
+    competing gangs on a mesh that fits them both fully bind with gang
+    tracking on (zero deadlock; no member of an incomplete gang binds
+    after TTL expiry), the same scenario deadlocks half-placed without
+    it, and device<->host feasibility parity is byte-exact on the wire;
+  * gang-atomic eviction in the rebalance actuator (never a subset).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from benchmarks.gang_load import (
+    _bind,
+    _filter_passing,
+    _gang_pod_obj,
+    _post,
+    build_mesh_service,
+    run_deadlock_ab,
+)
+from platform_aware_scheduling_tpu.gang import (
+    GangSpec,
+    GangTracker,
+    STATE_BOUND,
+    STATE_FORMING,
+    STATE_RESERVED,
+)
+from platform_aware_scheduling_tpu.ops import topology
+from platform_aware_scheduling_tpu.rebalance.actuator import SafeActuator
+from platform_aware_scheduling_tpu.testing.builders import (
+    make_gang_pod,
+    make_mesh_nodes,
+    make_pod,
+)
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.utils import decisions, trace
+from wirehelpers import get_request, post_bytes, raw_request, start_async, \
+    start_threaded
+
+
+# ---------------------------------------------------------------------------
+# topology kernel
+# ---------------------------------------------------------------------------
+
+
+class TestTopologyKernel:
+    def test_device_host_parity_byte_exact(self):
+        rng = np.random.default_rng(7)
+        for _ in range(25):
+            m, n = rng.integers(1, 10, 2)
+            free = rng.random((m, n)) < 0.55
+            for h, w in [(1, 1), (2, 2), (2, 3), (3, 1), (int(m), int(n))]:
+                device = topology.topology_feasibility_device(free, h, w)
+                host = topology.topology_feasibility_host(free, h, w)
+                for d_arr, h_arr in zip(device, host):
+                    assert d_arr.dtype == h_arr.dtype
+                    assert np.array_equal(d_arr, h_arr)
+
+    def test_full_mesh_every_anchor_feasible(self):
+        feas = topology.topology_feasibility_host(np.ones((4, 4), bool), 2, 2)
+        assert feas.anchor_ok[:3, :3].all()
+        assert not feas.anchor_ok[3, :].any()  # window would overflow
+        assert feas.node_ok.all()
+
+    def test_empty_mesh_nothing_feasible(self):
+        feas = topology.topology_feasibility_host(np.zeros((4, 4), bool), 2, 2)
+        assert not feas.anchor_ok.any()
+        assert not feas.node_ok.any()
+        assert topology.best_anchor(feas) is None
+
+    def test_window_larger_than_mesh_is_infeasible(self):
+        for fn in (
+            topology.topology_feasibility_host,
+            topology.topology_feasibility_device,
+        ):
+            feas = fn(np.ones((2, 2), bool), 3, 1)
+            assert not feas.anchor_ok.any()
+
+    def test_exact_fit_single_anchor(self):
+        feas = topology.topology_feasibility_host(np.ones((2, 4), bool), 2, 4)
+        assert np.argwhere(feas.anchor_ok).tolist() == [[0, 0]]
+        # nothing outside the window remains: zero stranded fragments
+        assert int(feas.anchor_score[0, 0]) == 0
+
+    def test_best_anchor_minimizes_stranded_fragments(self):
+        """On an L-shaped free region the 2x2 window snugly in the
+        corner strands fewer free cells than one in the open area."""
+        free = np.ones((4, 4), bool)
+        free[2:, 2:] = False  # only an L remains
+        feas = topology.topology_feasibility_host(free, 2, 2)
+        best = topology.best_anchor(feas)
+        assert best is not None
+        i, j, score = best
+        # every feasible anchor's score is >= the winner's
+        scores = feas.anchor_score[feas.anchor_ok]
+        assert score == int(scores.min())
+
+    def test_node_score_is_min_over_covering_windows(self):
+        free = np.ones((3, 3), bool)
+        feas = topology.topology_feasibility_host(free, 2, 2)
+        # center node is covered by all four 2x2 windows
+        covering = [
+            feas.anchor_score[i, j] for i, j in [(0, 0), (0, 1), (1, 0), (1, 1)]
+        ]
+        assert int(feas.node_score[1, 1]) == int(min(covering))
+
+
+class TestMeshView:
+    def test_parses_coords_and_skips_unlabeled(self):
+        nodes = make_mesh_nodes(2, 3) + [make_pod("not-a-mesh-node")]
+        # a pod has no coord label; also add a malformed node
+        from platform_aware_scheduling_tpu.testing.builders import make_node
+
+        nodes.append(make_node("bad", labels={"pas-tpu-coord": "x,1"}))
+        mesh = topology.MeshView([n for n in nodes if hasattr(n, "raw")])
+        assert mesh.rows == 2 and mesh.cols == 3
+        assert len(mesh) == 6
+        assert mesh.coord_of["mesh-1-2"] == (1, 2)
+
+    def test_free_mask_and_names_for(self):
+        mesh = topology.MeshView(make_mesh_nodes(2, 2))
+        mask = mesh.free_mask({"mesh-0-0", "mesh-1-1", "unknown"})
+        assert mask.tolist() == [[True, False], [False, True]]
+        assert mesh.names_for([(0, 0), (0, 1)]) == ["mesh-0-0", "mesh-0-1"]
+        assert mesh.names_for([(5, 5)]) is None
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+
+
+class TestGangSpec:
+    def test_full_spec(self):
+        spec = GangSpec.from_pod(make_gang_pod("p", "train", 8, "2x4"))
+        assert spec.gang_id == "default/train"
+        assert spec.size == 8
+        assert spec.topology == (2, 4)
+        assert spec.topology_label == "2x4"
+
+    def test_size_only_spec(self):
+        spec = GangSpec.from_pod(make_gang_pod("p", "train", 3))
+        assert spec.size == 3 and spec.topology is None
+        assert spec.topology_label == "any"
+
+    def test_group_without_size_is_not_a_gang(self):
+        pod = make_pod("p", labels={"pas-workload-group": "train"})
+        assert GangSpec.from_pod(pod) is None
+
+    @pytest.mark.parametrize(
+        "size,topo",
+        [("zero", ""), ("0", ""), ("8", "4x4"), ("8", "2by4"), ("8", "x")],
+    )
+    def test_malformed_specs_fail_open_to_non_gang(self, size, topo):
+        labels = {"pas-workload-group": "g", "pas-gang-size": size}
+        if topo:
+            labels["pas-gang-topology"] = topo
+        assert GangSpec.from_pod(make_pod("p", labels=labels)) is None
+
+
+# ---------------------------------------------------------------------------
+# tracker lifecycle
+# ---------------------------------------------------------------------------
+
+
+def make_tracker(rows=4, cols=4, ttl_s=30.0, use_device=True, clock=None):
+    nodes = make_mesh_nodes(rows, cols)
+    clock_box = clock or [0.0]
+    tracker = GangTracker(
+        nodes_provider=lambda: nodes,
+        ttl_s=ttl_s,
+        use_device=use_device,
+        clock=lambda: clock_box[0],
+    )
+    names = [n.name for n in nodes]
+    return tracker, names, clock_box
+
+
+class TestGangTracker:
+    def test_reservation_lifecycle(self):
+        tracker, names, clock = make_tracker()
+        before = trace.COUNTERS.get(
+            "pas_gang_reservations_total", kind="counter"
+        )
+        failed, codes = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        assert tracker.gang_state("default/ga") == STATE_RESERVED
+        allowed = sorted(set(names) - set(failed))
+        assert len(allowed) == 4
+        assert set(codes.values()) == {decisions.CODE_GANG_INFEASIBLE}
+        assert (
+            trace.COUNTERS.get("pas_gang_reservations_total", kind="counter")
+            == before + 1
+        )
+        # bind all four members (each registered via its own filter)
+        for i, node in enumerate(allowed):
+            pod = make_gang_pod(f"a-{i}", "ga", 4, "2x2")
+            tracker.filter_overlay(pod, names)
+            tracker.observe_bind("default", f"a-{i}", node)
+        assert tracker.gang_state("default/ga") == STATE_BOUND
+        # release frees the slice
+        assert tracker.release("default/ga")
+        assert tracker.gang_state("default/ga") is None
+        assert tracker.reserved_nodes() == {}
+
+    def test_competing_gangs_serialize_on_disjoint_slices(self):
+        tracker, names, _clock = make_tracker()
+        failed_a, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 8, "2x4"), names
+        )
+        failed_b, codes_b = tracker.filter_overlay(
+            make_gang_pod("b-0", "gb", 8, "2x4"), names
+        )
+        allowed_a = set(names) - set(failed_a)
+        allowed_b = set(names) - set(failed_b)
+        assert len(allowed_a) == 8 and len(allowed_b) == 8
+        assert not (allowed_a & allowed_b)
+        # gang B's view of gang A's slice carries the reserved code
+        reserved_codes = {
+            n: c
+            for n, c in codes_b.items()
+            if c == decisions.CODE_GANG_RESERVED
+        }
+        assert set(reserved_codes) == allowed_a
+
+    def test_third_gang_rejected_when_mesh_is_full(self):
+        tracker, names, _clock = make_tracker()
+        tracker.filter_overlay(make_gang_pod("a-0", "ga", 8, "2x4"), names)
+        tracker.filter_overlay(make_gang_pod("b-0", "gb", 8, "2x4"), names)
+        before = trace.COUNTERS.get(
+            "pas_gang_rejected_total",
+            kind="counter",
+            labels={"reason": "infeasible"},
+        )
+        failed_c, codes_c = tracker.filter_overlay(
+            make_gang_pod("c-0", "gc", 8, "2x4"), names
+        )
+        assert set(failed_c) == set(names)  # all-or-nothing: nothing passes
+        assert tracker.gang_state("default/gc") == STATE_FORMING
+        assert all(
+            c == decisions.CODE_GANG_INFEASIBLE for c in codes_c.values()
+        )
+        assert (
+            trace.COUNTERS.get(
+                "pas_gang_rejected_total",
+                kind="counter",
+                labels={"reason": "infeasible"},
+            )
+            == before + 1
+        )
+
+    def test_ttl_expiry_reclaims_the_slice(self):
+        tracker, names, clock = make_tracker(ttl_s=10.0)
+        tracker.filter_overlay(make_gang_pod("a-0", "ga", 8, "2x4"), names)
+        before = trace.COUNTERS.get(
+            "pas_gang_reservation_expirations_total", kind="counter"
+        )
+        clock[0] = 11.0
+        assert tracker.prune() == 1
+        assert tracker.gang_state("default/ga") == STATE_FORMING
+        assert tracker.reserved_nodes() == {}
+        assert (
+            trace.COUNTERS.get(
+                "pas_gang_reservation_expirations_total", kind="counter"
+            )
+            == before + 1
+        )
+        # a waiting gang can now take the freed slice
+        failed_b, _ = tracker.filter_overlay(
+            make_gang_pod("b-0", "gb", 16, "4x4"), names
+        )
+        assert len(set(names) - set(failed_b)) == 16
+
+    def test_member_filter_refreshes_ttl(self):
+        tracker, names, clock = make_tracker(ttl_s=10.0)
+        tracker.filter_overlay(make_gang_pod("a-0", "ga", 8, "2x4"), names)
+        clock[0] = 8.0  # touch before expiry
+        tracker.filter_overlay(make_gang_pod("a-1", "ga", 8, "2x4"), names)
+        clock[0] = 16.0  # past the original deadline, not the refreshed one
+        assert tracker.prune() == 0
+        assert tracker.gang_state("default/ga") == STATE_RESERVED
+
+    def test_no_expiry_once_fully_bound(self):
+        tracker, names, clock = make_tracker(ttl_s=10.0)
+        allowed = None
+        for i in range(4):
+            failed, _ = tracker.filter_overlay(
+                make_gang_pod(f"a-{i}", "ga", 4, "2x2"), names
+            )
+            allowed = sorted(set(names) - set(failed))
+        for i, node in enumerate(allowed):
+            tracker.observe_bind("default", f"a-{i}", node)
+        assert tracker.gang_state("default/ga") == STATE_BOUND
+        clock[0] = 1000.0
+        assert tracker.prune() == 0
+        assert tracker.gang_state("default/ga") == STATE_BOUND
+
+    def test_size_only_gang_needs_no_mesh(self):
+        tracker = GangTracker(nodes_provider=lambda: [], clock=lambda: 0.0)
+        names = [f"n-{i}" for i in range(5)]
+        failed, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 3), names
+        )
+        allowed = sorted(set(names) - set(failed))
+        assert len(allowed) == 3  # deterministic: sorted-name order
+        assert allowed == sorted(names)[:3]
+
+    def test_topology_gang_without_mesh_rejected_no_mesh(self):
+        tracker = GangTracker(nodes_provider=lambda: [], clock=lambda: 0.0)
+        names = [f"n-{i}" for i in range(16)]
+        failed, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        assert set(failed) == set(names)
+        assert "no mesh coordinates" in failed[names[0]]
+
+    def test_non_gang_pod_fails_only_reserved_nodes(self):
+        tracker, names, _clock = make_tracker()
+        failed_a, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        slice_a = set(names) - set(failed_a)
+        failed, codes = tracker.filter_overlay(make_pod("plain"), names)
+        assert set(failed) == slice_a
+        assert all(
+            c == decisions.CODE_GANG_RESERVED for c in codes.values()
+        )
+        assert "reserved by gang default/ga" in failed[sorted(slice_a)[0]]
+
+    def test_admitted_counter_and_histogram(self):
+        tracker, names, clock = make_tracker()
+        before = trace.COUNTERS.get("pas_gang_admitted_total", kind="counter")
+        from platform_aware_scheduling_tpu.gang.group import FULL_GANG_LATENCY
+
+        hist_before = FULL_GANG_LATENCY.summary("2x2")["count"]
+        failed, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        allowed = sorted(set(names) - set(failed))
+        clock[0] = 2.5
+        for i, node in enumerate(allowed):
+            tracker.filter_overlay(
+                make_gang_pod(f"a-{i}", "ga", 4, "2x2"), names
+            )
+            tracker.observe_bind("default", f"a-{i}", node)
+        assert (
+            trace.COUNTERS.get("pas_gang_admitted_total", kind="counter")
+            == before + 1
+        )
+        summary = FULL_GANG_LATENCY.summary("2x2")
+        assert summary["count"] == hist_before + 1
+        assert summary["max"] >= 2.5
+
+    def test_expiry_discards_stale_binds(self):
+        """Review fix: binds on an abandoned slice must not count toward
+        admission after a re-reservation — a gang can never be admitted
+        straddling two slices."""
+        tracker, names, clock = make_tracker(ttl_s=10.0)
+        failed, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        old_slice = sorted(set(names) - set(failed))
+        for i in range(2):  # partial: 2 of 4 bind, then the TTL lapses
+            tracker.filter_overlay(
+                make_gang_pod(f"a-{i}", "ga", 4, "2x2"), names
+            )
+            tracker.observe_bind("default", f"a-{i}", old_slice[i])
+        clock[0] = 11.0
+        assert tracker.prune() == 1
+        # steal part of the old slice so the re-reservation moves
+        tracker.filter_overlay(
+            make_gang_pod("x-0", "gx", 4, "1x4"), [old_slice[0]] + names
+        )
+        failed2, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        new_slice = sorted(set(names) - set(failed2))
+        # two fresh binds are NOT enough — the old ones were discarded
+        for i, node in enumerate(new_slice[:2]):
+            tracker.filter_overlay(
+                make_gang_pod(f"a-{i}", "ga", 4, "2x2"), names
+            )
+            tracker.observe_bind("default", f"a-{i}", node)
+        assert tracker.gang_state("default/ga") == STATE_RESERVED
+        for i, node in enumerate(new_slice[2:], start=2):
+            tracker.filter_overlay(
+                make_gang_pod(f"a-{i}", "ga", 4, "2x2"), names
+            )
+            tracker.observe_bind("default", f"a-{i}", node)
+        assert tracker.gang_state("default/ga") == STATE_BOUND
+
+    def test_dead_gang_sweep_releases_completed_jobs(self):
+        """Review fix: a bound gang whose pods have all disappeared is
+        released by the periodic sweep, so a finished job's slice cannot
+        stay reserved until restart."""
+        nodes = make_mesh_nodes(4, 4)
+        clock = [0.0]
+        live_pods = []
+        tracker = GangTracker(
+            nodes_provider=lambda: nodes,
+            pods_provider=lambda: list(live_pods),
+            ttl_s=30.0,
+            mesh_max_age_s=5.0,
+            clock=lambda: clock[0],
+        )
+        names = [n.name for n in nodes]
+        failed, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        allowed = sorted(set(names) - set(failed))
+        for i, node in enumerate(allowed):
+            pod = make_gang_pod(f"a-{i}", "ga", 4, "2x2")
+            live_pods.append(pod)
+            tracker.filter_overlay(pod, names)
+            tracker.observe_bind("default", f"a-{i}", node)
+        assert tracker.gang_state("default/ga") == STATE_BOUND
+        clock[0] = 10.0
+        assert tracker.prune() == 0  # members alive: the hold persists
+        assert tracker.gang_state("default/ga") == STATE_BOUND
+        live_pods.clear()  # the job finishes; its pods are deleted
+        clock[0] = 20.0
+        tracker.prune()
+        assert tracker.gang_state("default/ga") is None
+        assert tracker.reserved_nodes() == {}
+
+    def test_sweep_treats_succeeded_pods_as_dead(self):
+        """Review fix: a completed Job's pods linger as Succeeded until
+        GC — they no longer run on the slice, so the sweep must release
+        the hold (same liveness rule as the actuator's group floor)."""
+        nodes = make_mesh_nodes(4, 4)
+        clock = [0.0]
+        pods = []
+        tracker = GangTracker(
+            nodes_provider=lambda: nodes,
+            pods_provider=lambda: list(pods),
+            mesh_max_age_s=5.0,
+            clock=lambda: clock[0],
+        )
+        names = [n.name for n in nodes]
+        failed, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        for i, node in enumerate(sorted(set(names) - set(failed))):
+            pod = make_gang_pod(
+                f"a-{i}", "ga", 4, "2x2", phase="Succeeded"
+            )
+            pods.append(pod)
+            tracker.filter_overlay(pod, names)
+            tracker.observe_bind("default", f"a-{i}", node)
+        assert tracker.gang_state("default/ga") == STATE_BOUND
+        clock[0] = 10.0
+        tracker.prune()
+        assert tracker.gang_state("default/ga") is None
+
+    def test_sweep_never_blocks_the_filter_path(self):
+        """Review fix: the sweep's cluster pod LIST runs off the verb's
+        thread — a hung pods_provider must not stall filter_overlay."""
+        import threading as _threading
+        import time as _time
+
+        release_provider = _threading.Event()
+
+        def slow_pods():
+            release_provider.wait(10.0)
+            return []
+
+        nodes = make_mesh_nodes(2, 2)
+        clock = [0.0]
+        tracker = GangTracker(
+            nodes_provider=lambda: nodes,
+            pods_provider=slow_pods,
+            mesh_max_age_s=0.0,  # every call is sweep-eligible
+            clock=lambda: clock[0],
+        )
+        names = [n.name for n in nodes]
+        # put a bound gang in place so the sweep has work to hand off
+        failed, _ = tracker.filter_overlay(
+            make_gang_pod("a-0", "ga", 4, "2x2"), names
+        )
+        for i, node in enumerate(sorted(set(names) - set(failed))):
+            tracker.filter_overlay(
+                make_gang_pod(f"a-{i}", "ga", 4, "2x2"), names
+            )
+            tracker.observe_bind("default", f"a-{i}", node)
+        clock[0] = 1.0
+        t0 = _time.perf_counter()
+        tracker.filter_overlay(make_pod("plain"), names)
+        elapsed = _time.perf_counter() - t0
+        release_provider.set()
+        assert elapsed < 2.0, f"filter blocked {elapsed:.1f}s on the sweep"
+
+    def test_mesh_coordinates_are_sanity_bounded(self):
+        """Review fix: one mislabeled coordinate must not size the dense
+        mesh grids into the terabytes — out-of-bound coords parse as
+        no-coordinate (the node sits outside the mesh)."""
+        from platform_aware_scheduling_tpu.testing.builders import make_node
+        from platform_aware_scheduling_tpu.utils import labels as shared
+
+        assert shared.parse_coord({"pas-tpu-coord": "1000000,1000000"}) is None
+        assert shared.parse_coord(
+            {"pas-tpu-coord": f"{shared.MAX_MESH_DIM},0"}
+        ) is None
+        assert shared.parse_coord(
+            {"pas-tpu-coord": f"{shared.MAX_MESH_DIM - 1},0"}
+        ) == (shared.MAX_MESH_DIM - 1, 0)
+        nodes = make_mesh_nodes(2, 2) + [
+            make_node("rogue", labels={"pas-tpu-coord": "999999,999999"})
+        ]
+        mesh = topology.MeshView(nodes)
+        assert (mesh.rows, mesh.cols) == (2, 2)  # the rogue node is ignored
+
+    def test_prioritize_first_reservation_avoids_violating_nodes(self):
+        """Review fix: a Prioritize-FIRST gang arrival solves over the
+        same telemetry-clean candidates Filter would — it cannot reserve
+        a slice containing a violating node."""
+        from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+        from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+        extender, _kube, names = build_mesh_service(4, 4, gang=True)
+        hot = {n for n in names if n.startswith(("mesh-0-", "mesh-1-"))}
+        extender.cache.write_metric(
+            "mesh_metric",
+            {
+                n: NodeMetric(value=Quantity(2 * 10**9 if n in hot else 1))
+                for n in names
+            },
+        )
+        response = _post(
+            extender,
+            "prioritize",
+            {"Pod": _gang_pod_obj("a-0", "gang-a", 8, "2x4"),
+             "NodeNames": names},
+        )
+        ranked = [e["Host"] for e in json.loads(response.body)]
+        assert ranked and not (set(ranked) & hot)
+
+    def test_prioritize_overlay_ranks_reserved_slice(self):
+        tracker, names, _clock = make_tracker()
+        pod = make_gang_pod("a-0", "ga", 4, "2x2")
+        failed, _ = tracker.filter_overlay(pod, names)
+        reserved = [n for n in names if n not in failed]
+        ranked = tracker.prioritize_overlay(pod, names)
+        assert [hp.host for hp in ranked] == reserved  # row-major slice order
+        assert [hp.score for hp in ranked] == [10, 9, 8, 7]
+        assert tracker.prioritize_overlay(make_pod("plain"), names) is None
+
+    def test_device_and_host_trackers_choose_identical_slices(self):
+        results = []
+        for use_device in (True, False):
+            tracker, names, _clock = make_tracker(use_device=use_device)
+            # carve an irregular free region via a blocking gang
+            tracker.filter_overlay(
+                make_gang_pod("x-0", "gx", 4, "1x4"), names
+            )
+            failed, _ = tracker.filter_overlay(
+                make_gang_pod("a-0", "ga", 6, "2x3"), names
+            )
+            results.append(sorted(set(names) - set(failed)))
+        assert results[0] == results[1]
+
+
+# ---------------------------------------------------------------------------
+# verb integration (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestVerbIntegration:
+    def test_gang_member_filter_passes_only_slice_with_concrete_reasons(self):
+        extender, _kube, names = build_mesh_service(4, 4, gang=True)
+        pod = _gang_pod_obj("a-0", "gang-a", 8, "2x4")
+        response = _post(
+            extender, "filter", {"Pod": pod, "NodeNames": names}
+        )
+        assert response.status == 200
+        obj = json.loads(response.body)
+        assert len(obj["NodeNames"]) == 8
+        assert len(obj["FailedNodes"]) == 8
+        assert all(
+            "outside reserved 2x4 slice" in reason
+            for reason in obj["FailedNodes"].values()
+        )
+
+    def test_competing_gang_sees_reserved_reason(self):
+        extender, _kube, names = build_mesh_service(4, 4, gang=True)
+        _post(
+            extender,
+            "filter",
+            {"Pod": _gang_pod_obj("a-0", "gang-a", 8, "2x4"),
+             "NodeNames": names},
+        )
+        response = _post(
+            extender,
+            "filter",
+            {"Pod": _gang_pod_obj("b-0", "gang-b", 8, "2x4"),
+             "NodeNames": names},
+        )
+        failed = json.loads(response.body)["FailedNodes"]
+        assert any(
+            "reserved by gang default/gang-a" in reason
+            for reason in failed.values()
+        )
+
+    def test_decision_records_carry_gang_reason_codes(self):
+        decisions.DECISIONS.configure(enabled=True, capacity=64)
+        try:
+            extender, _kube, names = build_mesh_service(4, 4, gang=True)
+            before_res = trace.COUNTERS.get(
+                "pas_decision_filtered_nodes_total",
+                kind="counter",
+                labels={"reason": "gang_reserved"},
+            )
+            before_inf = trace.COUNTERS.get(
+                "pas_decision_filtered_nodes_total",
+                kind="counter",
+                labels={"reason": "gang_infeasible"},
+            )
+            _post(
+                extender,
+                "filter",
+                {"Pod": _gang_pod_obj("a-0", "gang-a", 8, "2x4"),
+                 "NodeNames": names},
+            )
+            _post(
+                extender,
+                "filter",
+                {"Pod": _gang_pod_obj("b-0", "gang-b", 8, "2x4"),
+                 "NodeNames": names},
+            )
+            # gang B's record: 8 nodes held by A (gang_reserved)
+            assert (
+                trace.COUNTERS.get(
+                    "pas_decision_filtered_nodes_total",
+                    kind="counter",
+                    labels={"reason": "gang_reserved"},
+                )
+                == before_res + 8
+            )
+            # gang A's record: 8 nodes outside its slice (gang_infeasible)
+            assert (
+                trace.COUNTERS.get(
+                    "pas_decision_filtered_nodes_total",
+                    kind="counter",
+                    labels={"reason": "gang_infeasible"},
+                )
+                >= before_inf + 8
+            )
+            snap = decisions.DECISIONS.snapshot(verb="filter", limit=4)
+            assert snap["returned"] >= 2
+            record = snap["records"][0]
+            assert any(
+                "gang" in reason for reason in record["violating"].values()
+            )
+        finally:
+            decisions.DECISIONS.configure(enabled=True, capacity=512)
+
+    def test_prioritize_serves_gang_slice_in_anchor_order(self):
+        extender, _kube, names = build_mesh_service(4, 4, gang=True)
+        pod = _gang_pod_obj("a-0", "gang-a", 8, "2x4")
+        passing = _filter_passing(extender, pod, names)
+        response = _post(
+            extender, "prioritize", {"Pod": pod, "NodeNames": names}
+        )
+        ranked = json.loads(response.body)
+        assert [e["Host"] for e in ranked] == passing
+        assert ranked[0]["Score"] == 10
+
+    def test_bind_promotes_and_releases_nothing_until_full(self):
+        extender, _kube, names = build_mesh_service(4, 4, gang=True)
+        pods = [_gang_pod_obj(f"a-{i}", "gang-a", 8, "2x4") for i in range(8)]
+        passing = _filter_passing(extender, pods[0], names)
+        for pod in pods[1:]:
+            _filter_passing(extender, pod, names)
+        for pod, node in zip(pods[:7], passing):
+            _bind(extender, pod, node)
+        assert extender.gangs.gang_state("default/gang-a") == STATE_RESERVED
+        _bind(extender, pods[7], passing[7])
+        assert extender.gangs.gang_state("default/gang-a") == STATE_BOUND
+
+    def test_reservation_avoids_telemetry_violating_nodes(self):
+        """Review fix: the reservation solve's free mask excludes nodes
+        the telemetry Filter already marked violating — the gang lands
+        on a clean slice instead of livelocking on one it can never
+        fully bind."""
+        from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+        from platform_aware_scheduling_tpu.utils.quantity import Quantity
+
+        extender, _kube, names = build_mesh_service(4, 4, gang=True)
+        # rows 0-1 violate the dontschedule rule (value > 10^9)
+        hot = {n for n in names if n.startswith(("mesh-0-", "mesh-1-"))}
+        extender.cache.write_metric(
+            "mesh_metric",
+            {
+                n: NodeMetric(
+                    value=Quantity(2 * 10**9 if n in hot else 1)
+                )
+                for n in names
+            },
+        )
+        response = _post(
+            extender,
+            "filter",
+            {"Pod": _gang_pod_obj("a-0", "gang-a", 8, "2x4"),
+             "NodeNames": names},
+        )
+        obj = json.loads(response.body)
+        passing = set(obj["NodeNames"])
+        assert passing == {
+            n for n in names if n.startswith(("mesh-2-", "mesh-3-"))
+        }
+        # the hot rows kept their telemetry reason, not a gang reason
+        assert all(
+            "threshold" in obj["FailedNodes"][n] for n in sorted(hot)
+        )
+
+    def test_non_gang_filtering_unchanged_without_tracker(self):
+        """gang=off keeps the stock path: same candidates pass, and the
+        response cache is probed as before (bypass counter untouched by
+        plain pods)."""
+        extender, _kube, names = build_mesh_service(4, 4, gang=False)
+        pod = {
+            "metadata": {
+                "name": "plain",
+                "namespace": "default",
+                "labels": {"telemetry-policy": "gang-pol"},
+            }
+        }
+        response = _post(
+            extender, "filter", {"Pod": pod, "NodeNames": names}
+        )
+        obj = json.loads(response.body)
+        assert sorted(obj["NodeNames"]) == sorted(names)
+        assert obj["FailedNodes"] == {}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance invariant, over real sockets on both front-ends
+# ---------------------------------------------------------------------------
+
+
+def _socket_schedule_two_gangs(server, names):
+    """Drive the full admit loop over real sockets: strict A/B pod
+    interleave, Filter -> Prioritize -> Bind per pod, until quiescent.
+    Returns {group: [bound nodes]} and the unplaced pod count."""
+    port = server.port
+    pods = []
+    for i in range(8):
+        pods.append(_gang_pod_obj(f"a-{i}", "gang-a", 8, "2x4"))
+        pods.append(_gang_pod_obj(f"b-{i}", "gang-b", 8, "2x4"))
+    available = list(names)
+    bound = {"gang-a": [], "gang-b": []}
+    pending = list(pods)
+    for _round in range(12):
+        progressed = []
+        for pod in pending:
+            body = json.dumps({"Pod": pod, "NodeNames": available}).encode()
+            status, _h, payload = raw_request(
+                port, post_bytes("/scheduler/filter", body)
+            )
+            assert status == 200
+            passing = json.loads(payload).get("NodeNames") or []
+            if not passing:
+                continue
+            body = json.dumps({"Pod": pod, "NodeNames": passing}).encode()
+            status, _h, payload = raw_request(
+                port, post_bytes("/scheduler/prioritize", body)
+            )
+            ranked = json.loads(payload or b"[]") or []
+            node = (
+                max(ranked, key=lambda e: e["Score"])["Host"]
+                if ranked
+                else passing[0]
+            )
+            bind_body = json.dumps(
+                {
+                    "PodName": pod["metadata"]["name"],
+                    "PodNamespace": "default",
+                    "PodUID": "uid",
+                    "Node": node,
+                }
+            ).encode()
+            status, _h, _payload = raw_request(
+                port, post_bytes("/scheduler/bind", bind_body)
+            )
+            assert status == 404  # TAS bind parity: 404, feedback consumed
+            available.remove(node)
+            group = pod["metadata"]["labels"]["pas-workload-group"]
+            bound[group].append(node)
+            progressed.append(pod)
+        if not progressed:
+            break
+        pending = [p for p in pending if p not in progressed]
+    return bound, len(pending)
+
+
+@pytest.mark.parametrize("serving", ["threaded", "async"])
+class TestAllOrNothingOverSockets:
+    def test_two_competing_gangs_both_fully_bind(self, serving):
+        extender, kube, names = build_mesh_service(4, 4, gang=True)
+        server = (
+            start_async(extender) if serving == "async"
+            else start_threaded(extender)
+        )
+        try:
+            bound, unplaced = _socket_schedule_two_gangs(server, names)
+            assert unplaced == 0
+            assert len(bound["gang-a"]) == 8 and len(bound["gang-b"]) == 8
+            assert not (set(bound["gang-a"]) & set(bound["gang-b"]))
+            mesh = topology.MeshView(kube.list_nodes())
+            for group in ("gang-a", "gang-b"):
+                mask = mesh.free_mask(bound[group])
+                feas = topology.topology_feasibility_host(mask, 2, 4)
+                assert feas.anchor_ok.any(), f"{group} is not a valid slice"
+            assert extender.gangs.gang_state("default/gang-a") == STATE_BOUND
+            assert extender.gangs.gang_state("default/gang-b") == STATE_BOUND
+        finally:
+            server.shutdown()
+
+    def test_gang_off_deadlocks_half_placed(self, serving):
+        """The control: same interleave over the same sockets with no
+        tracker — every pod binds, but NEITHER gang's node set forms a
+        contiguous 2x4 slice (the half-placed deadlock)."""
+        extender, kube, names = build_mesh_service(4, 4, gang=False)
+        server = (
+            start_async(extender) if serving == "async"
+            else start_threaded(extender)
+        )
+        try:
+            bound, unplaced = _socket_schedule_two_gangs(server, names)
+            assert unplaced == 0  # everything "scheduled"...
+            mesh = topology.MeshView(kube.list_nodes())
+            valid = 0
+            for group in ("gang-a", "gang-b"):
+                mask = mesh.free_mask(bound[group])
+                for h, w in ((2, 4), (4, 2)):
+                    feas = topology.topology_feasibility_host(mask, h, w)
+                    if feas.anchor_ok.any():
+                        valid += 1
+                        break
+            assert valid == 0  # ...but no gang ever forms a valid slice
+        finally:
+            server.shutdown()
+
+    def test_no_incomplete_gang_member_binds_after_ttl_expiry(self, serving):
+        clock = [0.0]
+        extender, _kube, names = build_mesh_service(
+            4, 4, gang=True, ttl_s=10.0
+        )
+        extender.gangs._clock = lambda: clock[0]
+        server = (
+            start_async(extender) if serving == "async"
+            else start_threaded(extender)
+        )
+        try:
+            port = server.port
+            pod = _gang_pod_obj("a-0", "gang-a", 8, "2x4")
+            body = json.dumps({"Pod": pod, "NodeNames": names}).encode()
+            status, _h, payload = raw_request(
+                port, post_bytes("/scheduler/filter", body)
+            )
+            assert len(json.loads(payload)["NodeNames"]) == 8
+            clock[0] = 11.0  # reservation lapses with zero binds
+            status, _h, payload = raw_request(
+                port, post_bytes("/scheduler/filter", body)
+            )
+            # the expired gang re-forms and re-reserves atomically in the
+            # same verdict — never a stale half-hold
+            obj = json.loads(payload)
+            assert len(obj["NodeNames"]) == 8
+            assert extender.gangs.gang_state("default/gang-a") == (
+                STATE_RESERVED
+            )
+            # an expired reservation's nodes went back to the pool first:
+            # the expiration was counted
+            assert (
+                trace.COUNTERS.get(
+                    "pas_gang_reservation_expirations_total", kind="counter"
+                )
+                >= 1
+            )
+        finally:
+            server.shutdown()
+
+
+class TestDeadlockAB:
+    def test_gang_on_admits_both_gang_off_deadlocks(self):
+        """The bench scenario IS the acceptance test: same verbs, same
+        interleave.  gang-on -> both gangs form valid 2x4 slices;
+        gang-off -> every pod binds but NEITHER gang is a valid slice."""
+        result = run_deadlock_ab()
+        assert result["gang_on"]["gangs_admitted_as_valid_slice"] == 2
+        assert result["gang_on"]["deadlock"] is False
+        assert result["gang_off"]["deadlock"] is True
+
+    def test_device_host_wire_parity_byte_exact(self):
+        """The same gang scenario through a device-kernel tracker and a
+        host-mirror tracker produces byte-identical wire responses."""
+        bodies = {}
+        for use_device in (True, False):
+            extender, _kube, names = build_mesh_service(4, 4, gang=True)
+            extender.gangs.use_device = use_device
+            responses = []
+            for group in ("gang-a", "gang-b", "gang-c"):
+                pod = _gang_pod_obj(f"{group}-0", group, 8, "2x4")
+                for verb in ("filter", "prioritize"):
+                    response = _post(
+                        extender, verb, {"Pod": pod, "NodeNames": names}
+                    )
+                    responses.append((verb, response.status, response.body))
+            bodies[use_device] = responses
+        assert bodies[True] == bodies[False]
+
+
+# ---------------------------------------------------------------------------
+# /debug/gangs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("serving", ["threaded", "async"])
+class TestDebugGangsEndpoint:
+    def test_states_served_and_404_when_unwired(self, serving):
+        extender, _kube, names = build_mesh_service(4, 4, gang=True)
+        _filter_passing(
+            extender, _gang_pod_obj("a-0", "gang-a", 8, "2x4"), names
+        )
+        server = (
+            start_async(extender) if serving == "async"
+            else start_threaded(extender)
+        )
+        try:
+            status, _h, payload = get_request(server.port, "/debug/gangs")
+            assert status == 200
+            snap = json.loads(payload)
+            assert snap["enabled"] is True
+            assert snap["mesh"] == {"rows": 4, "cols": 4, "nodes": 16}
+            assert snap["gangs"][0]["gang"] == "default/gang-a"
+            assert snap["gangs"][0]["state"] == "reserved"
+            assert snap["gangs"][0]["anchor"]["rows"] == 2
+            assert snap["reserved_nodes"] == 8
+            # non-GET is 405
+            status, _h, _payload = raw_request(
+                server.port,
+                post_bytes("/debug/gangs", b"{}"),
+            )
+            assert status == 405
+        finally:
+            server.shutdown()
+        extender_off, _kube2, _names2 = build_mesh_service(4, 4, gang=False)
+        server = (
+            start_async(extender_off) if serving == "async"
+            else start_threaded(extender_off)
+        )
+        try:
+            status, _h, _payload = get_request(server.port, "/debug/gangs")
+            assert status == 404
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# gang-atomic eviction
+# ---------------------------------------------------------------------------
+
+
+def _gang_cluster(kube, n=4):
+    """n bound gang pods + one plain pod on a fake cluster; returns
+    (pods by key, all pods, moves for the gang)."""
+    from platform_aware_scheduling_tpu.rebalance.replan import Move
+
+    pods = []
+    for i in range(n):
+        pod = make_gang_pod(
+            f"g-{i}", "train", n, node_name=f"node-{i}", phase="Running"
+        )
+        kube.add_pod(pod)
+        pods.append(pod)
+    plain = make_pod("plain", node_name="node-9", phase="Running")
+    kube.add_pod(plain)
+    pods_by_key = {f"default/{p.name}": p for p in pods + [plain]}
+    moves = [
+        Move(
+            pod_key=f"default/g-{i}",
+            namespace="default",
+            name=f"g-{i}",
+            from_node=f"node-{i}",
+            to_node="node-x",
+            gain=1.0,
+        )
+        for i in range(n)
+    ]
+    return pods_by_key, pods + [plain], moves
+
+
+class TestGangAtomicEviction:
+    def test_partial_gang_moves_all_skip(self):
+        kube = FakeKubeClient()
+        pods_by_key, all_pods, moves = _gang_cluster(kube)
+        actuator = SafeActuator(kube, mode="active", min_available=0, burst=8)
+        result = actuator.actuate(moves[:2], pods_by_key, all_pods)
+        assert result.executed == []
+        assert result.skip_counts() == {"gang_partial": 2}
+        assert kube.evictions == []
+
+    def test_whole_gang_evicts_atomically(self):
+        kube = FakeKubeClient()
+        pods_by_key, all_pods, moves = _gang_cluster(kube)
+        actuator = SafeActuator(kube, mode="active", min_available=0, burst=8)
+        result = actuator.actuate(moves, pods_by_key, all_pods)
+        assert len(result.executed) == 4
+        assert len(kube.evictions) == 4
+
+    def test_rate_gate_is_all_or_nothing_for_a_gang(self):
+        kube = FakeKubeClient()
+        pods_by_key, all_pods, moves = _gang_cluster(kube)
+        # burst 2 < gang size 4: the whole gang waits, nothing partial
+        actuator = SafeActuator(kube, mode="active", min_available=0, burst=2)
+        result = actuator.actuate(moves, pods_by_key, all_pods)
+        assert result.executed == []
+        assert result.skip_counts() == {"rate_limit": 4}
+        assert kube.evictions == []
+
+    def test_min_available_floor_gates_the_whole_gang(self):
+        kube = FakeKubeClient()
+        pods_by_key, all_pods, moves = _gang_cluster(kube)
+        actuator = SafeActuator(kube, mode="active", min_available=1, burst=8)
+        result = actuator.actuate(moves, pods_by_key, all_pods)
+        assert result.executed == []
+        assert result.skip_counts() == {"min_available": 4}
+
+    def test_dry_run_records_whole_gang_as_dry_run(self):
+        kube = FakeKubeClient()
+        pods_by_key, all_pods, moves = _gang_cluster(kube)
+        actuator = SafeActuator(
+            kube, mode="dry-run", min_available=0, burst=8
+        )
+        result = actuator.actuate(moves, pods_by_key, all_pods)
+        assert result.skip_counts() == {"dry_run": 4}
+        assert kube.evictions == []
+
+    def test_whole_gang_evicts_with_production_pod_keys(self):
+        """Review fix: membership completeness is compared via
+        object_key on the Pod objects, so the production pod_key format
+        (``ns&name`` from replan's object_key) matches too — a whole-gang
+        plan must evict, not skip gang_partial."""
+        from platform_aware_scheduling_tpu.kube.objects import object_key
+        from platform_aware_scheduling_tpu.rebalance.replan import Move
+
+        kube = FakeKubeClient()
+        pods = []
+        for i in range(4):
+            pod = make_gang_pod(
+                f"g-{i}", "train", 4, node_name=f"node-{i}", phase="Running"
+            )
+            kube.add_pod(pod)
+            pods.append(pod)
+        pods_by_key = {object_key(p): p for p in pods}  # "default&g-0"
+        moves = [
+            Move(
+                pod_key=object_key(p),
+                namespace="default",
+                name=p.name,
+                from_node=f"node-{i}",
+                to_node="node-x",
+                gain=1.0,
+            )
+            for i, p in enumerate(pods)
+        ]
+        actuator = SafeActuator(kube, mode="active", min_available=0, burst=8)
+        result = actuator.actuate(moves, pods_by_key, pods)
+        assert len(result.executed) == 4
+        assert result.skip_counts() == {}
+
+    def test_whole_gang_eviction_releases_the_reservation(self):
+        """Review fix: a fully-evicted gang's slice goes back to the
+        pool (actuator -> tracker release hook, wired by assemble)."""
+        from platform_aware_scheduling_tpu.rebalance.replan import Move
+
+        tracker, names, _clock = make_tracker()
+        kube = FakeKubeClient()
+        pods = []
+        failed, _ = tracker.filter_overlay(
+            make_gang_pod("g-0", "train", 4, "2x2"), names
+        )
+        allowed = sorted(set(names) - set(failed))
+        for i, node in enumerate(allowed):
+            pod = make_gang_pod(
+                "g-%d" % i, "train", 4, "2x2",
+                node_name=node, phase="Running",
+            )
+            kube.add_pod(pod)
+            pods.append(pod)
+            tracker.filter_overlay(pod, names)
+            tracker.observe_bind("default", f"g-{i}", node)
+        assert tracker.gang_state("default/train") == STATE_BOUND
+        pods_by_key = {f"default/{p.name}": p for p in pods}
+        moves = [
+            Move(
+                pod_key=f"default/{p.name}",
+                namespace="default",
+                name=p.name,
+                from_node="n",
+                to_node="m",
+                gain=1.0,
+            )
+            for p in pods
+        ]
+        actuator = SafeActuator(kube, mode="active", min_available=0, burst=8)
+        actuator.gang_tracker = tracker
+        result = actuator.actuate(moves, pods_by_key, pods)
+        assert len(result.executed) == 4
+        assert tracker.gang_state("default/train") is None
+        assert tracker.reserved_nodes() == {}
+
+    def test_malformed_gang_labels_are_non_gang_everywhere(self):
+        """Review fix: one classifier (labels.gang_id_for) for scheduler
+        AND actuator — a malformed size label means plain-pod semantics
+        in both, so the pod stays evictable."""
+        from platform_aware_scheduling_tpu.rebalance.replan import Move
+        from platform_aware_scheduling_tpu.utils import labels as shared
+
+        bad_labels = {
+            "pas-workload-group": "train",
+            "pas-gang-size": "not-a-number",
+        }
+        assert shared.gang_id_for("default", bad_labels) is None
+        assert GangSpec.from_pod(make_pod("p", labels=bad_labels)) is None
+        # topology inconsistent with size is equally non-gang
+        assert (
+            shared.gang_id_for(
+                "default",
+                {**bad_labels, "pas-gang-size": "8",
+                 "pas-gang-topology": "3x3"},
+            )
+            is None
+        )
+        kube = FakeKubeClient()
+        pod = make_pod(
+            "p", labels=bad_labels, node_name="node-0", phase="Running"
+        )
+        kube.add_pod(pod)
+        move = Move(
+            pod_key="default/p", namespace="default", name="p",
+            from_node="node-0", to_node="node-x", gain=1.0,
+        )
+        actuator = SafeActuator(kube, mode="active", min_available=0, burst=8)
+        result = actuator.actuate([move], {"default/p": pod}, [pod])
+        assert len(result.executed) == 1  # evicted as a plain pod
+
+    def test_plain_pods_keep_the_stock_gates(self):
+        from platform_aware_scheduling_tpu.rebalance.replan import Move
+
+        kube = FakeKubeClient()
+        pods_by_key, all_pods, _moves = _gang_cluster(kube)
+        move = Move(
+            pod_key="default/plain",
+            namespace="default",
+            name="plain",
+            from_node="node-9",
+            to_node="node-x",
+            gain=1.0,
+        )
+        actuator = SafeActuator(kube, mode="active", min_available=0, burst=8)
+        result = actuator.actuate([move], pods_by_key, all_pods)
+        assert len(result.executed) == 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -104,6 +104,22 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_gang_families_declared(self):
+        """ISSUE 7: the gang-scheduling metric families are part of the
+        declared inventory (docs/gang.md)."""
+        expected = {
+            "pas_gang_reservations_total": "counter",
+            "pas_gang_reservation_expirations_total": "counter",
+            "pas_gang_admitted_total": "counter",
+            "pas_gang_rejected_total": "counter",
+            "pas_gang_active": "gauge",
+            "pas_gang_reserved_nodes": "gauge",
+            "pas_gang_time_to_full_seconds": "histogram",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
     def test_fault_tolerance_families_declared(self):
         """ISSUE 5: the retry/circuit/degraded families are part of the
         declared inventory (docs/robustness.md)."""
