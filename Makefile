@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions test-serving test-obs test-rebalance test-faults test-decisions test-gang trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -79,6 +79,19 @@ test-gang:
 # deadlocks half-placed) + 10k-node reservation throughput
 bench-gang:
 	python -m benchmarks.gang_load
+
+# predictive-telemetry suite (docs/forecast.md): kernel device<->host
+# byte-exact parity, history-ring semantics, forecast-vs-snapshot ranking
+# parity through the real verbs on both front-ends, trend-aware
+# hysteresis, degraded bounded extrapolation, /debug/forecast
+test-forecast:
+	python -m pytest tests/test_forecast.py -q
+
+# forecast A/B alone: trending violated-at-bind + transient-spike
+# eviction suppression + forecaster on-vs-off p99 (skip the 10k-node
+# overhead tier with the scenario functions directly)
+bench-forecast:
+	python -m benchmarks.forecast_load
 
 # metric-name convention gate (docs/observability.md): every emitted
 # metric is declared in trace.METRICS, pas_-prefixed snake_case, no

@@ -169,7 +169,7 @@ def _detail_path(round_override=None) -> str:
 
 def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
-    chaos=None, decisions=None, gang=None,
+    chaos=None, decisions=None, gang=None, forecast=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -263,6 +263,36 @@ def assemble_line(
             "admissions_per_s_10k_nodes": throughput.get(
                 "admissions_per_s"
             ),
+        }
+    if forecast is not None:
+        # full scenario records to disk; the line keeps the placement-
+        # quality headline (forecast-on avoids the violated-at-bind
+        # placements and the transient-spike evictions snapshot mode
+        # pays — docs/forecast.md) + the on-vs-off p99 overhead
+        detail["forecast"] = forecast
+        trending = forecast.get("trending") or {}
+        spike = forecast.get("spike") or {}
+        over = forecast.get("overhead") or {}
+        result["forecast"] = {
+            "violated_at_bind_snapshot": (trending.get("snapshot") or {}).get(
+                "violated_at_bind"
+            ),
+            "violated_at_bind_forecast": (trending.get("forecast") or {}).get(
+                "violated_at_bind"
+            ),
+            "spike_evictions_snapshot": (spike.get("snapshot") or {}).get(
+                "evictions"
+            ),
+            "spike_evictions_forecast": (spike.get("forecast") or {}).get(
+                "evictions"
+            ),
+            "spike_suppressed": (spike.get("forecast") or {}).get(
+                "suppressed"
+            ),
+            "overhead_pct_prioritize_p99": over.get(
+                "overhead_pct_prioritize_p99"
+            ),
+            "overhead_pct_filter_p99": over.get("overhead_pct_filter_p99"),
         }
     if chaos is not None:
         # full per-side latency dicts to disk; the line keeps only the
@@ -482,6 +512,30 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"gang bench failed: {exc}", file=sys.stderr)
 
+    # --- predictive telemetry: trending/spike placement-quality A/B +
+    # forecaster on-vs-off p99 (benchmarks/forecast_load.py;
+    # docs/forecast.md) ---
+    forecast_out = None
+    try:
+        from benchmarks import forecast_load
+
+        forecast_out = forecast_load.run(num_nodes=NUM_NODES)
+        trending = forecast_out["trending"]
+        spike = forecast_out["spike"]
+        print(
+            f"forecast: violated-at-bind snapshot="
+            f"{trending['snapshot']['violated_at_bind']} vs forecast="
+            f"{trending['forecast']['violated_at_bind']}; spike evictions "
+            f"{spike['snapshot']['evictions']} vs "
+            f"{spike['forecast']['evictions']} (suppressed "
+            f"{spike['forecast']['suppressed']}); overhead p99 "
+            f"{forecast_out['overhead']['overhead_pct_prioritize_p99']}% "
+            f"prioritize",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"forecast bench failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -493,7 +547,7 @@ def main():
 
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
-        decisions_out, gang,
+        decisions_out, gang, forecast_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

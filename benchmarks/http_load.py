@@ -83,9 +83,14 @@ def node_names(num_nodes: int) -> List[str]:
     return [f"node-{i:05d}" for i in range(num_nodes)]
 
 
-def build_extender(num_nodes: int, device: bool, seed: int = 3):
+def build_extender(
+    num_nodes: int, device: bool, seed: int = 3, forecast: bool = False
+):
     """(extender, node names) over a seeded cache; ``device=False`` is the
-    host control.  Both are nodeCacheCapable so either wire mode works."""
+    host control.  Both are nodeCacheCapable so either wire mode works.
+    ``forecast=True`` attaches a Forecaster over a short seeded trending
+    history (--forecast=on analog; docs/forecast.md) so rankings serve
+    from predicted values."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
@@ -103,18 +108,41 @@ def build_extender(num_nodes: int, device: bool, seed: int = 3):
         "load_metric",
         {n: NodeMetric(value=Quantity(int(v))) for n, v in zip(names, values)},
     )
+    forecaster = None
+    if forecast and mirror is not None:
+        from platform_aware_scheduling_tpu.forecast import Forecaster
+
+        # a long period so the static bench cache doesn't read as an
+        # outage mid-measurement (horizon extension would churn views)
+        forecaster = Forecaster(cache, mirror, window=8, period_s=300.0)
+        for step in range(1, 5):  # short per-node trends, deterministic
+            cache.write_metric(
+                "load_metric",
+                {
+                    n: NodeMetric(value=Quantity(int(v) + step * (i % 7)))
+                    for i, (n, v) in enumerate(zip(names, values))
+                },
+            )
+        forecaster.refresh()
     ext = MetricsExtender(cache, mirror=mirror, node_cache_capable=True)
+    if forecaster is not None:
+        ext.forecaster = forecaster
+        ext.warm_fastpath()  # forecast rankings warm like snapshot ones
     return ext, names
 
 
 def build_service(
-    num_nodes: int, device: bool, seed: int = 3, serving: str = "threaded"
+    num_nodes: int,
+    device: bool,
+    seed: int = 3,
+    serving: str = "threaded",
+    forecast: bool = False,
 ):
     """(server, node names) — a live unsafe-HTTP extender over a seeded
     cache (see build_extender).  ``serving="async"`` serves through the
     event-loop micro-batching front-end (docs/serving.md) instead of the
     reference-parity threaded server."""
-    ext, names = build_extender(num_nodes, device, seed)
+    ext, names = build_extender(num_nodes, device, seed, forecast=forecast)
     if serving == "async":
         from platform_aware_scheduling_tpu.serving import AsyncServer
 
@@ -393,6 +421,7 @@ def _serve_forever(
     builder=None,
     serving: str = "threaded",
     decisions_enabled: bool = True,
+    forecast: bool = False,
 ) -> None:
     """Subprocess entry: start the service, print ``READY <port>``, block.
     The server gets its own process (and GIL) — in-process serving would
@@ -414,7 +443,9 @@ def _serve_forever(
     if builder is not None:
         server, _ = builder(num_nodes, device=device)
     else:
-        server, _ = build_service(num_nodes, device=device, serving=serving)
+        server, _ = build_service(
+            num_nodes, device=device, serving=serving, forecast=forecast
+        )
     devicewatch.DeviceWatcher(period_s=2.0).start()
     tune_for_serving()
     print(f"READY {server.port}", flush=True)
@@ -427,6 +458,7 @@ def _spawn_service(
     module: str = "benchmarks.http_load",
     serving: str = "threaded",
     decisions_enabled: bool = True,
+    forecast: bool = False,
 ) -> tuple:
     """(process, port) for an isolated service subprocess running
     ``python -m <module> --serve`` (shared by the GAS A/B)."""
@@ -443,6 +475,7 @@ def _spawn_service(
             "1" if device else "0",
             serving,
             "1" if decisions_enabled else "0",
+            "1" if forecast else "0",
         ],
         stdout=subprocess.PIPE,
         text=True,
@@ -872,6 +905,7 @@ if __name__ == "__main__":
             decisions_enabled=(
                 sys.argv[5] == "1" if len(sys.argv) > 5 else True
             ),
+            forecast=(sys.argv[6] == "1" if len(sys.argv) > 6 else False),
         )
     elif len(sys.argv) > 1 and sys.argv[1] == "--decisions":
         nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000

@@ -83,6 +83,24 @@ def check_front_end(serving: str) -> str:
         assert status == 200, f"{serving}: /debug/gangs -> {status}"
         gangs = json.loads(payload)
         assert gangs["enabled"] is True
+        # forecast endpoint: 404 while off (--forecast=off), then 200
+        # with an enabled payload once a forecaster is wired
+        assert "/debug/forecast" in paths, (
+            f"{serving}: index missing forecast"
+        )
+        status, _payload = _get(port, "/debug/forecast")
+        assert status == 404, (
+            f"{serving}: /debug/forecast must 404 while off -> {status}"
+        )
+        from platform_aware_scheduling_tpu.forecast import Forecaster
+
+        server.scheduler.forecaster = Forecaster(
+            server.scheduler.cache, server.scheduler.mirror, window=4
+        )
+        status, payload = _get(port, "/debug/forecast")
+        assert status == 200, f"{serving}: /debug/forecast -> {status}"
+        forecast = json.loads(payload)
+        assert forecast["enabled"] is True
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "

@@ -105,6 +105,69 @@ def add_gang_flags(parser: argparse.ArgumentParser) -> None:
                         "tracker relists nodes (Go duration)")
 
 
+def add_forecast_flags(
+    parser: argparse.ArgumentParser, forecast: bool = True
+) -> None:
+    """Predictive-telemetry flag surface (docs/forecast.md).  Like
+    ``--degradedMode``, the flags only exist where a Forecaster is
+    actually built (TAS): GAS has no telemetry cache to forecast over,
+    and offering flags it would silently ignore is worse than not
+    offering them (``add_forecast_flags(parser, forecast=False)`` is the
+    explicit no-op adoption both mains share)."""
+    if not forecast:
+        return
+    parser.add_argument("--forecast", default="off", choices=["off", "on"],
+                        help="schedule on forecasts, not snapshots: a "
+                        "batched on-device EWMA/Holt fit over the "
+                        "telemetry refresh history ranks scheduleonmetric "
+                        "on predicted-at-bind values, holds eviction "
+                        "streaks on transient spikes trending back down, "
+                        "and lets degraded last-known-good mode serve "
+                        "bounded extrapolations (docs/forecast.md)")
+    parser.add_argument("--forecastWindow", type=int, default=32,
+                        help="refresh-history samples kept per metric "
+                        "(the fit's lookback window)")
+    parser.add_argument("--forecastHorizon", default="",
+                        help="how far ahead predictions target (Go "
+                        "duration); empty = one refresh period ahead "
+                        "(the value at the next refresh); capped at "
+                        "--forecastWindow refresh steps — no fit "
+                        "predicts further ahead than it looked back")
+    parser.add_argument("--forecastBandBound", type=float, default=0.25,
+                        help="max mean relative uncertainty band under "
+                        "which degraded LKG mode keeps serving forecast "
+                        "extrapolations; past it the pre-forecast "
+                        "frozen-LKG/neutral behavior returns")
+
+
+def forecast_options(args, sync_period_s: float) -> Optional[dict]:
+    """The --forecast* flags as the options dict ``assemble`` builds a
+    Forecaster from (None = off)."""
+    if getattr(args, "forecast", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+    horizon_s = None
+    if getattr(args, "forecastHorizon", ""):
+        horizon_s = parse_duration(args.forecastHorizon)
+    return {
+        "window": args.forecastWindow,
+        "horizon_s": horizon_s,
+        "period_s": sync_period_s,
+        "band_bound": args.forecastBandBound,
+    }
+
+
+def build_forecaster(cache, mirror, options: Optional[dict]):
+    """The Forecaster for --forecast=on (None when off or when the
+    assembly is host-only — the forecast views ride the device mirror)."""
+    if options is None or mirror is None:
+        return None
+    from platform_aware_scheduling_tpu.forecast import Forecaster
+
+    return Forecaster(cache, mirror, **options)
+
+
 def build_gang_tracker(args, kube_client):
     """The GangTracker for --gang=on (None when off), over the kube
     client's node list as the mesh-coordinate source."""

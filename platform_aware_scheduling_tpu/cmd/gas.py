@@ -45,10 +45,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--queueDepth", type=int, default=256,
                         help="async serving: admission queue bound; past it "
                         "requests get 503 + Retry-After")
-    # parity with cmd/tas.py via the one shared helper (cmd/common.py)
+    # parity with cmd/tas.py via the one shared helper (cmd/common.py);
+    # forecast=False: GAS has no telemetry cache to forecast over, so the
+    # --forecast* flags are explicitly NOT offered (no dead flags — the
+    # same stance --degradedMode takes above)
     common.add_profile_flag(parser)
     common.add_robustness_flags(parser, degraded=False)
     common.add_decision_flags(parser)
+    common.add_forecast_flags(parser, forecast=False)
     return parser
 
 

@@ -108,6 +108,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_robustness_flags(parser)
     common.add_decision_flags(parser)
     common.add_gang_flags(parser)
+    common.add_forecast_flags(parser)
     return parser
 
 
@@ -124,6 +125,7 @@ def assemble(
     breakers=None,
     degraded_mode: Optional[str] = None,
     gang_tracker=None,
+    forecast_options: Optional[dict] = None,
 ):
     """Wire cache + mirror + extender + controller + enforcer (the body of
     ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
@@ -138,7 +140,14 @@ def assemble(
     ``gang_tracker``: the --gang=on GangTracker
     (common.build_gang_tracker); attached to the extender so Filter/
     Prioritize/Bind consult gang reservations and the front-ends serve
-    GET /debug/gangs (docs/gang.md)."""
+    GET /debug/gangs (docs/gang.md).
+
+    ``forecast_options``: the --forecast=on options dict
+    (common.forecast_options); a Forecaster (forecast/engine.py) is
+    built over the cache's history rings + the mirror and attached to
+    the extender (predicted-value ranking, /debug/forecast), the
+    degraded controller (bounded extrapolation), and the rebalancer
+    (trend-aware hysteresis) — docs/forecast.md."""
     cache = AutoUpdatingCache()
     mirror: Optional[TensorStateMirror] = None
     if enable_device_path:
@@ -149,12 +158,24 @@ def assemble(
         from platform_aware_scheduling_tpu.tas.planner import BatchPlanner
 
         planner = BatchPlanner(cache, mirror, solver=batch_solver)
+    # the forecaster must exist BEFORE the extender: MetricsExtender's
+    # constructor runs the first warm pass, and the history rings must
+    # already be recording when the initial metric seeds land
+    forecaster = common.build_forecaster(cache, mirror, forecast_options)
     extender = MetricsExtender(
         cache,
         mirror=mirror,
         planner=planner,
         node_cache_capable=node_cache_capable,
     )
+    if forecaster is not None:
+        extender.forecaster = forecaster
+        # after the forecaster's own refit subscription (appended at its
+        # construction above), so each refresh pass re-warms rankings
+        # against the fit it JUST published — warm_fastpath alone fires
+        # mid-pass, before the refit, and would leave every fresh
+        # forecast view cold to its first request
+        cache.on_refresh_pass.append(extender.warm_forecast_rankings)
     if gang_tracker is not None:
         extender.gangs = gang_tracker
 
@@ -175,6 +196,7 @@ def assemble(
             breakers=breakers,
             mode=degraded_mode or MODE_LAST_KNOWN_GOOD,
         )
+        degraded.forecaster = forecaster  # bounded LKG extrapolation
         extender.degraded = degraded
         enforcer.degraded = degraded
 
@@ -191,6 +213,7 @@ def assemble(
             **(rebalance_options or {}),
         )
         rebalancer.degraded = degraded
+        rebalancer.forecaster = forecaster  # trend-aware hysteresis
         rebalancer.attach(enforcer)
         extender.rebalancer = rebalancer
         # gang-atomic eviction completes the loop: a whole-gang eviction
@@ -274,6 +297,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         breakers=breakers,
         degraded_mode=args.degradedMode,
         gang_tracker=common.build_gang_tracker(args, kube_client),
+        forecast_options=common.forecast_options(args, sync_period_s),
         rebalance_mode=args.rebalance,
         rebalance_options={
             "hysteresis_cycles": args.rebalanceHysteresis,

@@ -83,6 +83,7 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/decisions", "description": "scheduling decision provenance records; filters: ?pod=<name>&verb=<verb>&limit=<n> (404 when --decisionLog=off)"},
     {"path": "/debug/rebalance", "description": "last rebalance plan + loop state (404 when --rebalance=off)"},
     {"path": "/debug/gangs", "description": "gang reservations + lifecycle state (404 when --gang=off)"},
+    {"path": "/debug/forecast", "description": "per-metric forecast fits: slopes, horizons, uncertainty bands (404 when --forecast=off)"},
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
 ]
 
@@ -431,6 +432,22 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=gangs.to_json(),
+            )
+        if bare_path == "/debug/forecast":
+            # forecast fits + extrapolation state (forecast/engine.py);
+            # 404 when no forecaster is wired (--forecast=off or GAS)
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            forecaster = getattr(self.scheduler, "forecaster", None)
+            if forecaster is None:
+                return HTTPResponse.json(
+                    b'{"error": "forecasting not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=forecaster.to_json(),
             )
         if bare_path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of

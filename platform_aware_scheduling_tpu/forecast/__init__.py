@@ -1,0 +1,6 @@
+"""Predictive telemetry: schedule on trajectories, not snapshots
+(docs/forecast.md)."""
+
+from platform_aware_scheduling_tpu.forecast.engine import Forecaster
+
+__all__ = ["Forecaster"]

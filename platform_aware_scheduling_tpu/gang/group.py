@@ -202,6 +202,12 @@ class GangTracker:
         self._lock = threading.Lock()
         self._gangs: Dict[str, _Gang] = {}
         self._member_gang: Dict[str, str] = {}  # pod key -> gang id
+        # bumped whenever the set of gang-held nodes can have changed
+        # (reserve, TTL expiry, release/drop of a holding gang): the
+        # Filter response cache keys non-gang entries on this, so a
+        # cached verdict can never outlive the reservation state it
+        # encoded (docs/gang.md)
+        self._reservation_version = 0
         self._mesh: Optional[topology.MeshView] = None
         self._mesh_at: float = -float("inf")
         self._swept_at: float = -float("inf")
@@ -327,6 +333,8 @@ class GangTracker:
                 # a gang straddling two slices
                 gang.bound = {}
                 expired += 1
+        if expired:
+            self._reservation_version += 1
         idle_bound = 10.0 * self.ttl_s
         for gang_id in [
             gid
@@ -340,6 +348,8 @@ class GangTracker:
     def _drop_locked(self, gang_id: str) -> None:
         dropped = self._gangs.pop(gang_id, None)
         if dropped is not None:
+            if dropped.reserved_nodes:
+                self._reservation_version += 1  # its slice is free again
             # released = removed from tracking; the terminal state is
             # stamped on the object so any held reference reads true
             dropped.state = STATE_RELEASED
@@ -421,6 +431,7 @@ class GangTracker:
             gang.anchor = (i, j, hh, ww)
         gang.state = STATE_RESERVED
         gang.expires_at = now + self.ttl_s
+        self._reservation_version += 1
         return None
 
     # -- verb overlays ---------------------------------------------------------
@@ -455,7 +466,7 @@ class GangTracker:
                 for name in candidates:
                     holder = held.get(name)
                     if holder is not None:
-                        failed[name] = f"gang: node reserved by gang {holder}"
+                        failed[name] = shared_labels.gang_reserved_reason(holder)
                         codes[name] = decisions.CODE_GANG_RESERVED
                 gauges = self._publish_gauges_locked()
             else:
@@ -487,7 +498,7 @@ class GangTracker:
                         holder = held.get(name)
                         if holder is not None:
                             failed[name] = (
-                                f"gang: node reserved by gang {holder}"
+                                shared_labels.gang_reserved_reason(holder)
                             )
                             codes[name] = decisions.CODE_GANG_RESERVED
                         else:
@@ -608,6 +619,31 @@ class GangTracker:
         return existed
 
     # -- introspection ---------------------------------------------------------
+
+    def cache_token(self) -> Tuple[int, Dict[str, str]]:
+        """(reservation version, {node: holding gang id}) for the Filter
+        response cache (tas/telemetryscheduler._gang_cache_token): every
+        reservation change bumps the version, so a cached response keyed
+        on it can never outlive the state it encoded.  Prunes expired
+        reservations first — a cache-hit steady state must still observe
+        TTL expiry (the expiry itself bumps the version and misses the
+        stale entries)."""
+        now = self._clock()
+        self._sweep_dead_gangs(now)
+        with self._lock:
+            expired = self._prune_locked(now)
+            version = self._reservation_version
+            held = self._reserved_map_locked()  # built fresh already
+            # gauges only when something actually expired — this runs on
+            # every non-gang Filter request, and the common no-expiry
+            # case must not pay two all-gang walks under the lock
+            gauges = self._publish_gauges_locked() if expired else None
+        if expired:
+            trace.COUNTERS.inc(
+                "pas_gang_reservation_expirations_total", expired
+            )
+            self._set_gauges(gauges)
+        return version, held
 
     def reserved_nodes(self) -> Dict[str, str]:
         with self._lock:

@@ -1,0 +1,221 @@
+"""Batched telemetry forecasting: EWMA level + Holt linear trend for
+every (metric, node) series in one fused pass.
+
+PAPER.md's TAS acts on *snapshots*: `scheduleonmetric` ranks the value at
+last refresh, `dontschedule`/`deschedule` fire on instantaneous threshold
+crossings.  A node trending toward violation at bind time is a worse
+placement than a node in a transient spike, but both score identically on
+a snapshot (ROADMAP item 4).  This kernel turns the refresh *history*
+(tas/cache.py rings, staged dense by ops/state.build_history_tensor)
+into per-series trajectory estimates:
+
+  * **level** — exponentially weighted estimate of where the series is;
+  * **trend** — Holt's linear-trend term: milli-units per refresh step;
+  * **resid** — mean absolute one-step-ahead residual, the noise scale;
+  * **predicted** — ``level + trend * h`` at a horizon of ``h`` steps;
+  * **band** — ``resid * (1 + h)``: an uncertainty band that WIDENS with
+    extrapolation distance (degraded mode serves forecasts only while
+    this stays inside its bound, tas/degraded.py).
+
+One ``lax.scan`` over the time axis updates all ``M x N`` series at once
+— the same all-in-one-program shape as ops/scoring.py (which ranks all
+nodes per pass) and ops/topology.py (which scores all anchors per pass).
+Ragged/missing samples ride a validity mask: an invalid slot carries the
+state forward untouched, so a metric with 3 samples and one with W
+coexist in the same tensor.
+
+**Exactness.**  All arithmetic is int32 on the milli-quantized, per-row
+de-scaled domain (ops/state.history_value_bits — window-aware so the
+W-1-term residual accumulator has headroom too): the smoothing weights
+are dyadic (alpha = 2^-ALPHA_SHIFT, beta = 2^-BETA_SHIFT) so every
+update is adds + arithmetic shifts — associative, branch-free, and
+bit-identical between XLA and numpy.  :func:`forecast_host` is the exact
+numpy mirror (byte-exact parity pinned by tests/test_forecast.py, the
+same contract ops/topology.py keeps), and :func:`forecast_fit` falls
+back to it on any device exception — forecasting trouble must never
+fail a verb.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from platform_aware_scheduling_tpu.utils import trace
+
+#: dyadic smoothing weights: alpha = 1/2 (level), beta = 1/4 (trend).
+#: Dyadic so the recursion stays in exact integer shifts; 1/2 tracks the
+#: level fast enough that a refresh-period-scale trend shows within a few
+#: samples, 1/4 keeps the trend estimate calm through single-sample noise.
+ALPHA_SHIFT = 1
+BETA_SHIFT = 2
+
+
+class ForecastResult(NamedTuple):
+    """Per-(metric, node) fit in the SCALED int32 domain (values were
+    arithmetic-right-shifted per metric row at staging; callers shift
+    outputs back up, ops/state.HistoryTensor.shift).  Identical from
+    either execution path."""
+
+    level: np.ndarray  # int32 [M, N] — smoothed current value
+    trend: np.ndarray  # int32 [M, N] — slope per refresh step
+    resid: np.ndarray  # int32 [M, N] — mean |one-step-ahead error|
+    predicted: np.ndarray  # int32 [M, N] — level + trend * horizon
+    band: np.ndarray  # int32 [M, N] — resid * (1 + horizon)
+    samples: np.ndarray  # int32 [M, N] — valid samples folded in
+
+
+def _forecast_kernel(values: jnp.ndarray, valid: jnp.ndarray, horizon: jnp.ndarray):
+    """(level, trend, resid, predicted, band, samples) over int32
+    ``[M, N, W]`` history + bool validity mask; ``horizon`` is an int32
+    scalar (refresh steps ahead).  One scan over W updates every series.
+
+    Per valid sample past the first:
+      err  = x - (L + b)           # one-step-ahead surprise
+      adj  = err >> ALPHA_SHIFT    # alpha * err
+      L'   = L + b + adj           # Holt level update
+      b'   = b + (adj >> BETA_SHIFT)   # Holt trend update (beta * adj)
+    The first valid sample seeds L = x, b = 0.  Invalid slots carry
+    state through untouched (ragged series, failed-refresh gaps)."""
+    m, n, w = values.shape
+    zero = jnp.zeros((m, n), dtype=jnp.int32)
+
+    def step(carry, xs):
+        level, trend, count, acc = carry
+        x, v = xs
+        first = v & (count == 0)
+        later = v & (count > 0)
+        pred1 = level + trend
+        err = x - pred1
+        adj = jnp.right_shift(err, ALPHA_SHIFT)
+        level = jnp.where(first, x, jnp.where(later, pred1 + adj, level))
+        trend = jnp.where(
+            later,
+            trend + jnp.right_shift(adj, BETA_SHIFT),
+            jnp.where(first, jnp.int32(0), trend),
+        )
+        acc = jnp.where(later, acc + jnp.abs(err), acc)
+        count = jnp.where(v, count + jnp.int32(1), count)
+        return (level, trend, count, acc), None
+
+    xs = (
+        jnp.moveaxis(values.astype(jnp.int32), -1, 0),
+        jnp.moveaxis(valid, -1, 0),
+    )
+    (level, trend, count, acc), _ = jax.lax.scan(
+        step, (zero, zero, zero, zero), xs
+    )
+    # mean |residual| over the count-1 one-step-ahead errors (int division
+    # of non-negatives: floor == trunc, identical in XLA and numpy)
+    resid = acc // jnp.maximum(count - jnp.int32(1), jnp.int32(1))
+    h = horizon.astype(jnp.int32)
+    predicted = level + trend * h
+    band = resid * (jnp.int32(1) + h)
+    return level, trend, resid, predicted, band, count
+
+
+forecast_kernel = trace.watch_jit(
+    "forecast_kernel", jax.jit(_forecast_kernel)
+)
+
+
+def forecast_device(
+    values: np.ndarray, valid: np.ndarray, horizon: int
+) -> ForecastResult:
+    """Device path: the jitted kernel over the staged history."""
+    out = forecast_kernel(
+        jnp.asarray(values, dtype=jnp.int32),
+        jnp.asarray(valid, dtype=bool),
+        jnp.int32(int(horizon)),
+    )
+    level, trend, resid, predicted, band, samples = (
+        np.asarray(part) for part in out
+    )
+    return ForecastResult(
+        level=level,
+        trend=trend,
+        resid=resid,
+        predicted=predicted,
+        band=band,
+        samples=samples,
+    )
+
+
+def forecast_host(
+    values: np.ndarray, valid: np.ndarray, horizon: int
+) -> ForecastResult:
+    """Exact numpy mirror of the device kernel (same int32 adds/shifts in
+    the same order) — the parity control and the no-device fallback,
+    mirroring the ops/topology.py dual-path structure."""
+    values = np.asarray(values, dtype=np.int32)
+    valid = np.asarray(valid, dtype=bool)
+    m, n, w = values.shape
+    level = np.zeros((m, n), dtype=np.int32)
+    trend = np.zeros((m, n), dtype=np.int32)
+    count = np.zeros((m, n), dtype=np.int32)
+    acc = np.zeros((m, n), dtype=np.int32)
+    for t in range(w):
+        x = values[:, :, t]
+        v = valid[:, :, t]
+        first = v & (count == 0)
+        later = v & (count > 0)
+        pred1 = level + trend
+        err = x - pred1
+        adj = err >> ALPHA_SHIFT
+        level = np.where(first, x, np.where(later, pred1 + adj, level))
+        trend = np.where(
+            later,
+            trend + (adj >> BETA_SHIFT),
+            np.where(first, np.int32(0), trend),
+        )
+        acc = np.where(later, acc + np.abs(err), acc)
+        count = np.where(v, count + np.int32(1), count)
+    resid = (acc // np.maximum(count - np.int32(1), np.int32(1))).astype(
+        np.int32
+    )
+    h = np.int32(int(horizon))
+    predicted = (level + trend * h).astype(np.int32)
+    band = (resid * (np.int32(1) + h)).astype(np.int32)
+    return ForecastResult(
+        level=level,
+        trend=trend,
+        resid=resid,
+        predicted=predicted,
+        band=band,
+        samples=count,
+    )
+
+
+def forecast_fit(
+    values: np.ndarray,
+    valid: np.ndarray,
+    horizon: int,
+    use_device: bool = True,
+) -> ForecastResult:
+    """The dual-path entry: device kernel by default, exact host mirror
+    as the control/fallback (device trouble must never fail the caller —
+    the same invariant the TAS fastpath and ops/topology.py keep)."""
+    if use_device:
+        try:
+            return forecast_device(values, valid, horizon)
+        except Exception:
+            pass
+    return forecast_host(values, valid, horizon)
+
+
+def extend_horizon(
+    fit: ForecastResult, horizon: int
+) -> ForecastResult:
+    """Re-extrapolate a stored fit to a new horizon WITHOUT refitting —
+    the degraded-mode path: during an outage no new samples arrive, the
+    fit stands, and only (predicted, band) move as the horizon grows.
+    Same int32 arithmetic as both kernels' tails, so a fit extended to
+    ``h`` equals a fresh fit run at ``h``."""
+    h = np.int32(int(horizon))
+    predicted = (fit.level + fit.trend * h).astype(np.int32)
+    band = (fit.resid * (np.int32(1) + h)).astype(np.int32)
+    return fit._replace(predicted=predicted, band=band)

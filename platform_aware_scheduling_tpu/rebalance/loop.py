@@ -102,6 +102,18 @@ class Rebalancer:
         # must hold the zero-evictions invariant even when driven
         # directly (docs/robustness.md)
         self.degraded = None
+        # optional forecast.Forecaster (docs/forecast.md): per-node trend
+        # signs classify a violation as trending-up (streak advances as
+        # before) vs transient-spike-with-negative-slope (streak HOLDS —
+        # the eviction that spike would have triggered is suppressed and
+        # counted on pas_forecast_suppressed_evictions_total)
+        self.forecaster = None
+        # nodes whose hold-at-threshold-minus-one already counted a
+        # suppressed eviction: a spike held for many cycles is ONE
+        # suppressed eviction, not one per cycle (membership drops when
+        # the node leaves the at-threshold hold, so a later fresh spike
+        # counts again)
+        self._suppress_counted: set = set()
         # convergence episode tracking: first violating cycle after a
         # clean one opens an episode; the next clean cycle closes it and
         # publishes its length
@@ -163,7 +175,24 @@ class Rebalancer:
                     "pas_rebalance_convergence_cycles",
                     float(self._last_convergence),
                 )
-        candidates = self.drift.observe(violations)
+        hold = self._trend_holds(violations)
+        # suppressed = held nodes snapshot hysteresis would have evicted
+        # this cycle: streak at k-1 (advancing would reach k) OR already
+        # at/past k (a deferred eviction the hold now blocks outright).
+        # A held node's streak is frozen, so it re-satisfies the test
+        # every cycle of the spike; the counted set de-duplicates the
+        # episode to ONE
+        prior = self.drift.streaks()
+        at_threshold = {
+            node
+            for node in hold
+            if prior.get(node, 0) + 1 >= self.drift.k
+        }
+        newly_suppressed = at_threshold - self._suppress_counted
+        self._suppress_counted = at_threshold
+        if newly_suppressed and self.forecaster is not None:
+            self.forecaster.count_suppressed_eviction(len(newly_suppressed))
+        candidates = self.drift.observe(violations, hold=hold)
         trace.COUNTERS.set_gauge(
             "pas_rebalance_candidate_nodes", float(len(candidates))
         )
@@ -171,6 +200,7 @@ class Rebalancer:
             "cycle": cycle_no,
             "mode": self.mode,
             "violating_nodes": sorted(violations),
+            "trend_held_nodes": sorted(hold),
             "candidate_nodes": sorted(candidates),
             "moves": [],
             "executed": [],
@@ -231,6 +261,45 @@ class Rebalancer:
                 component="rebalance",
             )
         return record
+
+    def _trend_holds(self, violations: Dict[str, List[str]]) -> frozenset:
+        """Violating nodes whose violated deschedule metrics are ALL
+        trending strictly down (docs/forecast.md): the transient-spike
+        signature whose streak the drift detector holds.  Fails open to
+        the empty set — snapshot hysteresis — on any trouble."""
+        forecaster = self.forecaster
+        if forecaster is None or not violations:
+            return frozenset()
+        try:
+            mirror = self.replanner.mirror
+            metric_names: Dict[str, tuple] = {}
+            held = set()
+            for node, policies in violations.items():
+                metrics: List[str] = []
+                for policy_name in policies:
+                    names = metric_names.get(policy_name)
+                    if names is None:
+                        compiled, _view = mirror.policy_with_view_by_name(
+                            policy_name
+                        )
+                        rules = (
+                            compiled.deschedule
+                            if compiled is not None
+                            else None
+                        )
+                        names = (
+                            tuple(rules.metric_names)
+                            if rules is not None
+                            else ()
+                        )
+                        metric_names[policy_name] = names
+                    metrics.extend(names)
+                if metrics and forecaster.trending_down(node, metrics):
+                    held.add(node)
+            return frozenset(held)
+        except Exception as exc:  # trend trouble must never stop the loop
+            klog.error("trend classification failed open: %r", exc)
+            return frozenset()
 
     def _evictable_pods(self, candidates: Dict[str, List[str]]):
         """(evictable pods on candidate nodes, key -> Pod, all pods,

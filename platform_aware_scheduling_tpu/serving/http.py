@@ -295,7 +295,7 @@ class AsyncServer:
                 if bare_path in (
                     "/metrics", "/debug", "/debug/", "/debug/traces",
                     "/debug/decisions", "/debug/rebalance",
-                    "/debug/gangs", "/healthz", "/readyz",
+                    "/debug/gangs", "/debug/forecast", "/healthz", "/readyz",
                 ):
                     # observability endpoints bypass the admission queue:
                     # they must stay readable precisely when the queue is

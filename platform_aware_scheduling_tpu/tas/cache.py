@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from platform_aware_scheduling_tpu.tas.metrics import Client, NodeMetricsInfo
@@ -129,6 +130,18 @@ class AutoUpdatingCache:
         self.on_metric_delete: List[Callable[[str], None]] = []
         self.on_policy_write: List[Callable[[str, str, TASPolicy], None]] = []
         self.on_policy_delete: List[Callable[[str, str], None]] = []
+        # fired once at the END of each update_all_metrics pass (after
+        # every per-metric write of the pass landed) — the forecast
+        # subsystem refits here, once per pass instead of once per metric
+        self.on_refresh_pass: List[Callable[[], None]] = []
+        # refresh-history substrate (docs/forecast.md): a bounded ring of
+        # the last W data-bearing refreshes per metric — (monotonic stamp,
+        # {node: milli int}) samples.  A FAILED refresh appends nothing,
+        # so gaps stay visible through the stamps; delete_metric drops the
+        # ring with the metric.  Off (window 0) until configure_history.
+        self._history_window = 0
+        self._history: Dict[str, deque] = {}
+        self._history_generation = 0
 
     # -- Reader ---------------------------------------------------------------
 
@@ -168,8 +181,28 @@ class AutoUpdatingCache:
             else:
                 # a data-bearing write IS a refresh — the freshness clock
                 # this metric is judged by (telemetry_freshness)
+                stamp = self._clock()
+                # the history sample (one milli conversion per node) is
+                # built OUTSIDE the lock — at 10k nodes that work must
+                # not block request-path readers of metric_ages()/
+                # history_snapshot().  The bare int read of the window
+                # is racy only against configure_history; the locked
+                # re-check below decides
+                sample = None
+                if self._history_window:
+                    sample = {
+                        node: metric.value.milli_value_exact()[0]
+                        for node, metric in payload.items()
+                    }
                 with self._mtx:
-                    self._last_refresh[metric_name] = self._clock()
+                    self._last_refresh[metric_name] = stamp
+                    if self._history_window and sample is not None:
+                        ring = self._history.get(metric_name)
+                        if ring is None:
+                            ring = deque(maxlen=self._history_window)
+                            self._history[metric_name] = ring
+                        ring.append((stamp, sample))
+                        self._history_generation += 1
             for hook in self.on_metric_write:
                 hook(metric_name, payload)
 
@@ -194,6 +227,11 @@ class AutoUpdatingCache:
                     del self._metric_refcounts[metric_name]
                     self._store.delete(METRIC_PATH.format(metric_name))
                     self._last_refresh.pop(metric_name, None)
+                    # the history ring dies with the metric: a later
+                    # re-registration must not forecast from a ghost
+                    # series (docs/forecast.md)
+                    if self._history.pop(metric_name, None) is not None:
+                        self._history_generation += 1
                     evicted = True
                 elif total is not None:
                     self._metric_refcounts[metric_name] = total - 1
@@ -260,6 +298,56 @@ class AutoUpdatingCache:
                 round(age, 6),
                 labels={"metric": name},
             )
+        # one end-of-pass notification (never per metric): the forecast
+        # subsystem refits against the pass's complete sample set here,
+        # in the refresh thread — requests only ever read a finished fit
+        for hook in list(self.on_refresh_pass):
+            try:
+                hook()
+            except Exception as exc:  # a subscriber must not stop refreshes
+                klog.error("refresh-pass subscriber failed: %r", exc)
+
+    # -- refresh history (docs/forecast.md) -------------------------------------
+
+    def configure_history(self, window: int) -> None:
+        """Enable (or re-bound) the per-metric refresh-history rings:
+        each data-bearing write appends one ``(stamp, {node: milli})``
+        sample, bounded at the last ``window`` samples.  Failed refreshes
+        append nothing — the gap shows up as stamp spacing, never as a
+        fabricated sample."""
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"history window must be >= 1, got {window}")
+        with self._mtx:
+            if window != self._history_window:
+                self._history = {
+                    name: deque(ring, maxlen=window)
+                    for name, ring in self._history.items()
+                }
+                self._history_window = window
+                self._history_generation += 1
+
+    def history_window(self) -> int:
+        with self._mtx:
+            return self._history_window
+
+    def history_generation(self) -> int:
+        """Monotonic counter bumped on every history mutation — the
+        forecaster's memoization key (tas/forecast engine refits only
+        when this moves)."""
+        with self._mtx:
+            return self._history_generation
+
+    def history_snapshot(
+        self,
+    ) -> Tuple[int, Dict[str, List[Tuple[float, Dict[str, int]]]]]:
+        """(generation, {metric: [(stamp, {node: milli}), ...]}) oldest
+        first.  Sample dicts are shared read-only — consumers must not
+        mutate them."""
+        with self._mtx:
+            return self._history_generation, {
+                name: list(ring) for name, ring in self._history.items()
+            }
 
     def metric_ages(self) -> Dict[str, Optional[float]]:
         """Registered metric -> seconds since its last data-bearing write

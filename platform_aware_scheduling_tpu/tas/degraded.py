@@ -79,6 +79,17 @@ class DegradedModeController:
         self.lkg_max_age_s = lkg_max_age_s
         self.counters = counters if counters is not None else trace.COUNTERS
         self._lock = threading.Lock()
+        # optional forecast.Forecaster (docs/forecast.md): while telemetry
+        # is stale PAST the frozen-LKG window, last-known-good mode keeps
+        # serving under *bounded extrapolation* — Prioritize ranks on the
+        # grown-horizon predictions themselves, Filter keeps its
+        # last-known-good threshold VERDICTS alive (the forecast gates
+        # how long they stand, it does not re-evaluate the rules) —
+        # until the widening uncertainty band exceeds its bound, then
+        # falls back to today's frozen-LKG/neutral behavior.  The
+        # eviction suspension is NOT relaxed: extrapolation serves
+        # verbs, never actuation.
+        self.forecaster = None
 
     # -- inputs ----------------------------------------------------------------
 
@@ -130,11 +141,25 @@ class DegradedModeController:
             return False
         return all(age is not None and age <= bound for age in ages.values())
 
+    def _extrapolation_ok(self) -> Tuple[bool, str]:
+        """May a forecaster carry this consumer past the frozen-LKG
+        window?  The band check is the forecaster's (it widens with
+        extrapolation distance, so a long outage always trips back);
+        any trouble fails closed to the pre-forecast behavior."""
+        if self.forecaster is None:
+            return False, ""
+        try:
+            return self.forecaster.extrapolation_ok()
+        except Exception:
+            return False, "forecast extrapolation check failed"
+
     # -- the three consumer answers --------------------------------------------
 
     def filter_decision(self) -> Tuple[str, str]:
         """dontschedule/Filter behavior right now: ``normal`` when
-        telemetry is healthy, else per ``--degradedMode``."""
+        telemetry is healthy, else per ``--degradedMode``.  In
+        last-known-good mode a wired forecaster extends the LKG window
+        with bounded extrapolation (docs/forecast.md)."""
         ok, reason = self.telemetry_status()
         if ok:
             self._publish(telemetry=False)
@@ -142,13 +167,22 @@ class DegradedModeController:
         self._publish(telemetry=True)
         if self.mode == MODE_FAIL_CLOSED:
             return ACTION_FAIL_CLOSED, reason
-        if self.mode == MODE_LAST_KNOWN_GOOD and self._within_lkg_bound():
-            return ACTION_LAST_KNOWN_GOOD, reason
+        if self.mode == MODE_LAST_KNOWN_GOOD:
+            if self._within_lkg_bound():
+                return ACTION_LAST_KNOWN_GOOD, reason
+            extrapolate, band_reason = self._extrapolation_ok()
+            if extrapolate:
+                self.forecaster.count_extrapolated_serve()
+                return ACTION_LAST_KNOWN_GOOD, (
+                    f"{reason}; extrapolating: {band_reason}"
+                )
         return ACTION_FAIL_OPEN, reason
 
     def prioritize_decision(self) -> Tuple[str, str]:
         """scheduleonmetric behavior right now (mode-independent):
-        last-known-good scores within the bounded age, neutral past it."""
+        last-known-good scores within the bounded age, then bounded
+        forecast extrapolation while the uncertainty band holds, then
+        neutral."""
         ok, reason = self.telemetry_status()
         if ok:
             self._publish(telemetry=False)
@@ -156,6 +190,12 @@ class DegradedModeController:
         self._publish(telemetry=True)
         if self._within_lkg_bound():
             return ACTION_LAST_KNOWN_GOOD, reason
+        extrapolate, band_reason = self._extrapolation_ok()
+        if extrapolate:
+            self.forecaster.count_extrapolated_serve()
+            return ACTION_LAST_KNOWN_GOOD, (
+                f"{reason}; extrapolating: {band_reason}"
+            )
         return ACTION_NEUTRAL, reason
 
     def evictions_allowed(self) -> Tuple[bool, str]:

@@ -43,6 +43,7 @@ from platform_aware_scheduling_tpu.ops.scoring import (
 )
 from platform_aware_scheduling_tpu.ops.state import CompiledPolicy, DeviceView
 from platform_aware_scheduling_tpu.utils import decisions, trace
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
 
 # op id -> operator name, for decoding device rule indexes into the
 # shared reason strings (decisions.rule_reason keeps host parity)
@@ -157,9 +158,17 @@ class PrioritizeFastPath:
         # [ranked, table, planned_row, span_bytes, response], MRU first.
         self._responses: List[list] = []
         # same idea for Filter: [violation_set, use_nn, span_bytes, body,
-        # n_failed] — the failed-entry count rides along so decision
-        # records on cache hits stay O(1)
+        # n_failed, gang_version] — the failed-entry count rides along so
+        # decision records on cache hits stay O(1); gang_version keys the
+        # reservation state a gang-mode response encoded (None = no gang
+        # tracker), so a reservation change can never serve stale bytes
         self._filter_responses: List[list] = []
+        # merged (telemetry + gang reservation) Filter verdicts, one per
+        # (violation-set identity, reservation version, policy):
+        # [violations, version, policy, merged frozenset, merged reasons,
+        #  merged reason-bytes table] — MRU, shared by every non-gang
+        # request at one (state, reservation) generation
+        self._gang_merged: List[list] = []
         # [ranked, table, top-K (name, score) head] — the shared
         # prioritize score breakdown decision records reference
         self._explain_heads: List[list] = []
@@ -243,6 +252,14 @@ class PrioritizeFastPath:
                 key = (view.row_version(row), row, op)
                 self._rank[key] = perms[i][: int(counts[i])].astype(np.int64)
         return len(missing)
+
+    def warm_pairs(self, view: DeviceView, pairs) -> None:
+        """Warm rankings for (metric row, op) pairs against ``view``
+        WITHOUT the precompute pruning — the forecast warmer's entry
+        (forecast views carry negative version markers the prune would
+        drop; they expire naturally when the next fit publishes)."""
+        for row, op in pairs:
+            self._ranking(view, int(row), int(op))
 
     def precompute(self, view: DeviceView, pairs, wirec=None) -> None:
         """Warm the request-time state for (metric_row, op) pairs: the
@@ -585,6 +602,7 @@ class PrioritizeFastPath:
         violations: frozenset,
         compiled: Optional[CompiledPolicy] = None,
         policy_name: str = "",
+        reason_table: Optional[list] = None,
     ) -> Tuple[bytes, int]:
         """Native NodeNames-mode Filter response: candidate row lookup,
         violation partition, and byte assembly all happen in
@@ -595,12 +613,14 @@ class PrioritizeFastPath:
         Returns ``(body, failed count)``.  With ``compiled`` given, the
         FailedNodes values carry the concrete per-rule reason strings
         (pre-encoded once per state via :meth:`reason_table`); without it
-        the reference literal "Node violates" is emitted."""
+        the reference literal "Node violates" is emitted.  An explicit
+        ``reason_table`` (the gang-merged overlay, :meth:`gang_merged`)
+        overrides the per-rule one."""
         table = self._table_for(view)
         n_rows = len(table.node_names)
         mask = self._violation_mask(violations, n_rows)
-        reasons = None
-        if compiled is not None:
+        reasons = reason_table
+        if reasons is None and compiled is not None:
             rule_map = self.violation_rule_map(compiled, view)
             if rule_map is not None:
                 reasons = self.reason_table(
@@ -608,19 +628,91 @@ class PrioritizeFastPath:
                 )
         return wirec.filter_encode(parsed, table.native(wirec), mask, reasons)
 
+    def gang_merged(
+        self,
+        compiled: CompiledPolicy,
+        view: DeviceView,
+        policy_name: str,
+        violations: frozenset,
+        reasons: Dict[str, str],
+        held: Dict[str, str],
+        version: int,
+    ) -> Tuple[frozenset, Dict[str, str], list]:
+        """The non-gang-pod Filter verdict under active reservations:
+        ``(merged violating rows, merged {node: reason}, merged per-row
+        reason-bytes table)`` — telemetry violations plus gang-held
+        nodes, with the telemetry reason winning a collision exactly like
+        the exact path's overlay merge (the overlay only ever fails
+        telemetry-CLEAN candidates).  Memoized per (violation-set
+        identity, reservation version, policy) so every cached request at
+        one generation shares the same objects."""
+        with self._lock:
+            for idx, entry in enumerate(self._gang_merged):
+                if (
+                    entry[0] is violations
+                    and entry[1] == version
+                    and entry[2] == policy_name
+                ):
+                    if idx:
+                        self._gang_merged.insert(
+                            0, self._gang_merged.pop(idx)
+                        )
+                    return entry[3], entry[4], entry[5]
+        index = view.node_index
+        gang_rows: Dict[int, Tuple[str, str]] = {}
+        for node, gang_id in held.items():
+            row = index.get(node)
+            if row is not None and row not in violations:
+                gang_rows[row] = (node, gang_id)
+        merged = frozenset(violations | set(gang_rows))
+        merged_reasons = dict(reasons)
+        n_rows = len(view.node_names)
+        rule_map = self.violation_rule_map(compiled, view)
+        if rule_map is not None:
+            base = self.reason_table(
+                compiled, view, policy_name, violations, rule_map, n_rows
+            )
+            table = list(base[:n_rows])
+            table += [None] * (n_rows - len(table))
+        else:
+            table = [None] * n_rows
+        for row, (node, gang_id) in gang_rows.items():
+            reason = shared_labels.gang_reserved_reason(gang_id)
+            merged_reasons[node] = reason
+            if row < n_rows:
+                table[row] = json.dumps(reason).encode()
+        entry = [violations, version, policy_name, merged, merged_reasons, table]
+        with self._lock:
+            for existing in self._gang_merged:
+                if (
+                    existing[0] is violations
+                    and existing[1] == version
+                    and existing[2] == policy_name
+                ):
+                    return existing[3], existing[4], existing[5]
+            self._gang_merged.insert(0, entry)
+            del self._gang_merged[self.RESPONSE_CACHE_SIZE :]
+        return merged, merged_reasons, table
+
     # -- filter response reuse -------------------------------------------------
 
     def filter_lookup(
-        self, violations: frozenset, use_node_names: bool, parsed
+        self,
+        violations: frozenset,
+        use_node_names: bool,
+        parsed,
+        gang_version: Optional[int] = None,
     ) -> Optional[Tuple[bytes, int]]:
         """Cached (response bytes, failed count) for this exact candidate
-        span under this exact violation set, or None."""
+        span under this exact violation set (and, in gang mode, this
+        exact reservation version), or None."""
         with self._lock:
             responses = self._filter_responses
             for idx, entry in enumerate(responses):
                 if (
                     entry[0] is violations
                     and entry[1] == use_node_names
+                    and entry[5] == gang_version
                     and parsed.span_matches(use_node_names, entry[2])
                 ):
                     if idx:
@@ -635,6 +727,7 @@ class PrioritizeFastPath:
         parsed,
         body: bytes,
         n_failed: int = 0,
+        gang_version: Optional[int] = None,
     ) -> None:
         span = (
             parsed.node_names_span() if use_node_names else parsed.nodes_span()
@@ -643,7 +736,9 @@ class PrioritizeFastPath:
             return
         with self._lock:
             self._filter_responses.insert(
-                0, [violations, use_node_names, span, body, n_failed]
+                0,
+                [violations, use_node_names, span, body, n_failed,
+                 gang_version],
             )
             del self._filter_responses[self.RESPONSE_CACHE_SIZE :]
 

@@ -65,6 +65,7 @@ from platform_aware_scheduling_tpu.tas.fastpath import PrioritizeFastPath
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
 from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
 from platform_aware_scheduling_tpu.utils import decisions, klog, trace
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
 from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
 
 import jax.numpy as jnp
@@ -111,6 +112,14 @@ class MetricsExtender:
         # scanner are bypassed — the gang verdict is pod-label-dependent
         # state the span-keyed caches cannot key (docs/gang.md)
         self.gangs = None
+        # opt-in forecast.Forecaster, set by assembly when --forecast=on:
+        # scheduleonmetric ranks on predicted-at-bind values through the
+        # SAME fastpath/host machinery (the forecaster publishes a
+        # DeviceView of predicted milli values), decision records carry
+        # "predicted cpu=93 (slope +2.1/s)" provenance, and the
+        # front-ends serve GET /debug/forecast (404 while this is None).
+        # Off (None) keeps snapshot ranking byte-identical to before.
+        self.forecaster = None
         # opt-in tas.degraded.DegradedModeController, set by assembly:
         # when telemetry goes stale or a circuit opens, Filter fails
         # open/closed per --degradedMode and Prioritize degrades to
@@ -161,9 +170,47 @@ class MetricsExtender:
                     # one call warms the violation set AND its decoded
                     # provenance (reason strings keyed by policy name)
                     fastpath.violation_reasons(compiled, view, name)
+            if self.forecaster is not None:
+                # forecast rankings warm AFTER precompute (whose pruning
+                # keeps only real-view entries); the forecast view's
+                # negative version markers can never collide with them
+                self.warm_forecast_rankings()
             self._warmed = True
         except Exception as exc:  # warming must never break the writer
             klog.error("fastpath warm failed: %s", exc)
+
+    def warm_forecast_rankings(self) -> None:
+        """Warm the ranking cache for every device-eligible policy
+        against the CURRENT forecast view.  Called from warm_fastpath,
+        and — decisively — registered by assembly on the cache's
+        refresh-pass hook AFTER the forecaster's own refit subscription:
+        warm_fastpath fires on state change MID-pass, before the
+        end-of-pass refit replaces the forecast view, so without this
+        post-refit pass every fresh fit would go cold to its first
+        request.  Never raises."""
+        fastpath = self.fastpath
+        if self.forecaster is None or fastpath is None:
+            return
+        try:
+            policies, _view, host_only_map = self.mirror.policies_snapshot()
+
+            def host_only(name: str) -> bool:
+                return host_only_map.get(name, False)
+
+            for compiled in policies.values():
+                if not self._prioritize_device_eligible(compiled, host_only):
+                    continue
+                fview = self._forecast_rank_view(compiled)
+                if fview is not None:
+                    fastpath.warm_pairs(
+                        fview,
+                        [(
+                            compiled.scheduleonmetric_row,
+                            compiled.scheduleonmetric_op,
+                        )],
+                    )
+        except Exception as exc:  # warming must never break the refresher
+            klog.error("forecast ranking warm failed: %s", exc)
 
     # -- readiness (utils/health.py) -------------------------------------------
 
@@ -365,12 +412,20 @@ class MetricsExtender:
                     degraded_action = action
                     span.set("degraded", reason)
             probe = None
-            if degraded_action is None and self.gangs is None:
-                # gang mode bypasses the response cache entirely: the
-                # verdict depends on pod gang labels + live reservation
-                # state, which the span-keyed cache cannot key
-                with span.stage("cache_probe"):
-                    probe = self._filter_cache_probe(request)
+            if degraded_action is None:
+                # gang mode: the cache serves NON-gang pods, keyed on
+                # (gang reservation version, pod gang id) — any body
+                # that carries the gang group label at all may belong
+                # to a member (whose Filter has reservation side
+                # effects: TTL refresh, membership) and bypasses
+                gang_token = None
+                if self.gangs is not None:
+                    gang_token = self._gang_cache_token(request)
+                if self.gangs is None or gang_token is not None:
+                    with span.stage("cache_probe"):
+                        probe = self._filter_cache_probe(
+                            request, gang_token
+                        )
             # hit/miss attribution happens inside the probe, at its
             # non-None return sites only (it alone can tell a true
             # span-cache hit from the native encode that merely SEEDS the
@@ -396,10 +451,10 @@ class MetricsExtender:
             with span.stage("encode"):
                 body = result.to_json()
             if probe is not None:
-                parsed, violations, use_node_names = probe
+                parsed, violations, use_node_names, gang_version = probe
                 self.fastpath.filter_store(
                     violations, use_node_names, parsed, body,
-                    len(result.failed_nodes),
+                    len(result.failed_nodes), gang_version,
                 )
             if decisions.DECISIONS.enabled:
                 path = span.attrs.get("filter_cache", "exact")
@@ -436,18 +491,45 @@ class MetricsExtender:
         finally:
             self.recorder.observe("filter", time.perf_counter() - start)
 
-    def _filter_cache_probe(self, request: HTTPRequest):
+    def _gang_cache_token(self, request: HTTPRequest):
+        """(reservation version, held map) when this request may use the
+        Filter response cache under gang mode; None bypasses.  A body
+        mentioning the GANG SIZE label at all may belong to a member —
+        the native wire view exposes no pod labels beyond the policy, and
+        a member's Filter has reservation side effects (TTL refresh,
+        membership) a cached response would skip — so only size-label-
+        free bodies are cacheable.  The key is ``pas-gang-size``, not
+        ``pas-workload-group``: gang membership requires BOTH
+        (labels.gang_id_for), and the group label alone is the
+        rebalancer's min-available grouping that ordinary non-gang
+        workloads carry — those must keep their cache hits.  Fails open
+        to a bypass on any trouble."""
+        try:
+            if shared_labels.GANG_SIZE_LABEL.encode() in request.body:
+                return None
+            return self.gangs.cache_token()
+        except Exception as exc:
+            klog.error("gang cache token failed, cache bypass: %s", exc)
+            return None
+
+    def _filter_cache_probe(self, request: HTTPRequest, gang_token=None):
         """Filter response reuse (same burst-amortization as Prioritize's
         span cache): a cached HTTPResponse on hit; a (parsed, violations,
-        use_node_names) token when cacheable but missed (the verb stores
-        its exact Python-built bytes under that key); None when the
-        request isn't cacheable (host-only policy, odd shapes, no native
-        scanner) — the exact path then owns the response alone.
+        use_node_names, gang_version) token when cacheable but missed
+        (the verb stores its exact Python-built bytes under that key);
+        None when the request isn't cacheable (host-only policy, odd
+        shapes, no native scanner) — the exact path then owns the
+        response alone.
 
         Correctness: the key pairs the request's raw candidate-span bytes
         (memcmp, zero false positives) with the IDENTITY of the device
         violation frozenset — any state change produces a new frozenset,
-        so stale bytes can never match."""
+        so stale bytes can never match.  Under gang mode
+        (``gang_token``), the verdict additionally reflects gang-held
+        nodes: the violation set/reasons are the MERGED overlay
+        (fastpath.gang_merged) and the key carries the reservation
+        version, so a reservation change misses instead of serving a
+        stale verdict."""
         if self.fastpath is None:
             return None
         wirec = get_wirec()
@@ -487,11 +569,25 @@ class MetricsExtender:
             if explained is None:
                 return None
             violations, reasons, _indexes = explained
+            gang_version = None
+            reason_table = None
+            if gang_token is not None:
+                gang_version, held = gang_token
+                if held:
+                    # merge the reservation overlay into the verdict the
+                    # cached bytes will encode (non-gang pods fail
+                    # gang-held nodes with the concrete gang reason)
+                    violations, reasons, reason_table = (
+                        self.fastpath.gang_merged(
+                            compiled, view, policy.name, violations,
+                            reasons, held, gang_version,
+                        )
+                    )
             candidates = (
                 parsed.num_node_names if use_node_names else parsed.num_nodes
             )
             cached = self.fastpath.filter_lookup(
-                violations, use_node_names, parsed
+                violations, use_node_names, parsed, gang_version
             )
             if cached is not None:
                 body, n_failed = cached
@@ -511,10 +607,12 @@ class MetricsExtender:
                 # raise here lands in the outer except -> None -> the
                 # caller counts it a bypass, never miss+bypass
                 body, n_failed = self.fastpath.filter_parsed(
-                    wirec, view, parsed, violations, compiled, policy.name
+                    wirec, view, parsed, violations, compiled, policy.name,
+                    reason_table=reason_table,
                 )
                 self.fastpath.filter_store(
-                    violations, use_node_names, parsed, body, n_failed
+                    violations, use_node_names, parsed, body, n_failed,
+                    gang_version,
                 )
                 span.set("filter_cache", "miss")
                 trace.COUNTERS.inc("pas_filter_cache_miss_total")
@@ -527,7 +625,7 @@ class MetricsExtender:
             # response via the returned token — still a miss
             span.set("filter_cache", "miss")
             trace.COUNTERS.inc("pas_filter_cache_miss_total")
-            return parsed, violations, use_node_names
+            return parsed, violations, use_node_names, gang_version
         except (ValueError, TypeError):
             return None
         except Exception as exc:
@@ -603,11 +701,19 @@ class MetricsExtender:
         materialized str cannot UTF-8-encode for the name-table lookup.
         Either way the request must fall back to the exact path, never
         drop the connection (round-2 advisor finding)."""
-        if self.gangs is not None:
+        if self.gangs is not None and (
+            shared_labels.GANG_SIZE_LABEL.encode() in request.body
+        ):
             # the parsed wire view exposes no pod gang labels, so the
-            # native scanner cannot tell a gang member apart — with gang
-            # tracking on, Prioritize serves through the exact path,
-            # whose overlay can (docs/gang.md)
+            # native scanner cannot tell a gang member apart — a body
+            # that mentions the gang SIZE label at all serves through
+            # the exact path, whose overlay can.  Size-label-free bodies
+            # are provably non-gang (membership requires pas-gang-size,
+            # labels.gang_id_for — the group label alone is ordinary
+            # rebalance grouping), and a non-gang pod's Prioritize never
+            # consults reservations (prioritize_overlay returns None
+            # before any side effect), so the native path stays exact
+            # (docs/gang.md)
             return None
         if self.fastpath is None:
             return None
@@ -664,16 +770,20 @@ class MetricsExtender:
         )
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
+                rank_view = self._forecast_rank_view(compiled) or view
                 body = self.fastpath.prioritize_parsed(
-                    wirec, compiled, view, parsed, planned, use_node_names,
-                    span=span,
+                    wirec, compiled, rank_view, parsed, planned,
+                    use_node_names, span=span,
                 )
                 span.set("path", "native")
+                if rank_view is not view:
+                    span.set("ranking", "forecast")
                 trace.COUNTERS.inc("pas_prioritize_native_total")
                 self._record_prioritize(
                     span, namespace, parsed.pod_name or "", policy_name,
                     "native", rule, int(candidates), planned,
-                    compiled=compiled, view=view,
+                    compiled=compiled, view=rank_view,
+                    forecast=rank_view is not view,
                 )
                 return HTTPResponse.json(body, status)
             except Exception as exc:
@@ -710,11 +820,15 @@ class MetricsExtender:
         compiled: Optional[CompiledPolicy] = None,
         view: Optional[DeviceView] = None,
         result: Optional[List[HostPriority]] = None,
+        forecast: bool = False,
     ) -> None:
         """One Prioritize decision record.  Device-path records reference
         the SHARED per-state score head + ranking (O(1) per request);
         host-path records copy the already-materialized top of their own
-        result list.  Never raises into the verb."""
+        result list.  ``forecast`` marks a ranking served from predicted
+        values — the record's detail then carries the concrete forecast
+        provenance ("predicted cpu=93 (slope +2.1/s)") for the top node.
+        Never raises into the verb."""
         log = decisions.DECISIONS
         if not log.enabled:
             return
@@ -728,6 +842,15 @@ class MetricsExtender:
                 )
             elif result:
                 head = [(hp.host, hp.score) for hp in result[:10]]
+            detail = None
+            if forecast and self.forecaster is not None:
+                detail = {"ranking": "forecast"}
+                if head and rule is not None:
+                    described = self.forecaster.describe(
+                        rule.metricname, head[0][0]
+                    )
+                    if described:
+                        detail["top"] = described
             log.record_prioritize(
                 request_id=span.trace_id,
                 pod_namespace=namespace,
@@ -741,6 +864,7 @@ class MetricsExtender:
                 planned=planned,
                 ranked=ranked,
                 node_index=node_index,
+                detail=detail,
             )
         except Exception as exc:  # provenance must never fail the verb
             klog.error("prioritize decision record failed: %r", exc)
@@ -830,14 +954,18 @@ class MetricsExtender:
                 planned = (
                     self.planner.planned_node(args.pod) if self.planner else None
                 )
+                rank_view = self._forecast_rank_view(compiled) or view
                 body = self.fastpath.prioritize_bytes(
-                    compiled, view, names, planned, span=span
+                    compiled, rank_view, names, planned, span=span
                 )
                 span.set("path", "device")
+                if rank_view is not view:
+                    span.set("ranking", "forecast")
                 self._record_prioritize(
                     span, args.pod.namespace, args.pod.name, policy.name,
                     "device", rule, len(names), planned,
-                    compiled=compiled, view=view,
+                    compiled=compiled, view=rank_view,
+                    forecast=rank_view is not view,
                 )
                 return body
             except Exception as exc:  # device trouble must never fail the verb
@@ -891,11 +1019,56 @@ class MetricsExtender:
             HostPriority(host=h, score=10 - i) for i, h in enumerate(reordered)
         ]
 
+    def _forecast_rank_view(self, compiled: Optional[CompiledPolicy]):
+        """The forecast DeviceView to rank this policy's scheduleonmetric
+        rule on, or None (snapshot ranking).  Never raises into a verb —
+        forecasting trouble degrades to snapshot behavior."""
+        forecaster = self.forecaster
+        if forecaster is None or compiled is None:
+            return None
+        try:
+            return forecaster.ranking_view(compiled.scheduleonmetric_metric)
+        except Exception as exc:
+            klog.error("forecast ranking view failed, snapshot serves: %s", exc)
+            return None
+
     def _prioritize_host(
         self, rule: TASPolicyRule, candidate_names: List[str]
     ) -> List[HostPriority]:
         """prioritizeNodesForRule (telemetryscheduler.go:128-149), exact
-        host semantics."""
+        host semantics.  With a forecaster wired, ranking reads the SAME
+        predicted milli values the device forecast view carries (the
+        native<->host byte-comparability contract extends to forecasts);
+        forecasting trouble falls back to the snapshot read.
+
+        HOST-ONLY metrics never forecast: they are host-only precisely
+        because their values are not milli-exact (sub-milli Quantities,
+        milli-domain overflow — ops/state.py), and the history rings
+        hold milli-truncated samples, so a forecast would silently
+        replace the exact-Quantity ranking this path exists to provide
+        with lossy-domain garbage."""
+        if self.forecaster is not None and not (
+            self.mirror is not None
+            and self.mirror.metric_host_only(rule.metricname)
+        ):
+            try:
+                predicted = self.forecaster.host_metric(rule.metricname)
+            except Exception as exc:
+                klog.error(
+                    "forecast host metric failed, snapshot serves: %s", exc
+                )
+                predicted = None
+            if predicted is not None:
+                filtered = {
+                    name: predicted[name]
+                    for name in candidate_names
+                    if name in predicted
+                }
+                ordered = core.ordered_list(filtered, rule.operator)
+                return [
+                    HostPriority(host=entry.node_name, score=10 - i)
+                    for i, entry in enumerate(ordered)
+                ]
         try:
             node_data = self.cache.read_metric(rule.metricname)
         except CacheMissError as exc:

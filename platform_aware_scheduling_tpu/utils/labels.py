@@ -27,6 +27,15 @@ GANG_TOPOLOGY_LABEL = "pas-gang-topology"
 TPU_COORD_LABEL = "pas-tpu-coord"
 
 
+def gang_reserved_reason(gang_id: str) -> str:
+    """The Filter FailedNodes reason for a node held by another gang's
+    reservation.  ONE format shared by the tracker's overlay
+    (gang/group.py) and the Filter response cache's merged verdict
+    (tas/fastpath.gang_merged) — the cached and exact paths must stay
+    byte-identical, so the string may only ever change here."""
+    return f"gang: node reserved by gang {gang_id}"
+
+
 def gang_id_for(namespace: str, pod_labels: Dict[str, str]) -> Optional[str]:
     """The gang identity of a pod, or None when the pod is not a gang
     member.  A gang needs BOTH the group label (identity) and a

@@ -163,6 +163,12 @@ declare("pas_gang_rejected_total", "counter", "Gang Filter passes that found no 
 declare("pas_gang_active", "gauge", "Gangs currently tracked and not yet fully bound (forming or reserved).")
 declare("pas_gang_reserved_nodes", "gauge", "Nodes currently held by gang reservations (bound gangs included until released).")
 declare("pas_gang_time_to_full_seconds", "histogram", "Time from a gang's first sighting to fully bound (label: topology).")
+# predictive telemetry (forecast/engine.py + ops/forecast.py: batched
+# EWMA/Holt fits over the refresh history; docs/forecast.md)
+declare("pas_forecast_fit_passes_total", "counter", "Batched forecast fit passes completed (one per telemetry refresh pass with history movement).")
+declare("pas_forecast_extrapolated_serves_total", "counter", "Degraded-mode requests served past the frozen-LKG window under forecast confidence: Prioritize ranks on the extrapolated predictions, Filter keeps the last-known-good verdicts alive.")
+declare("pas_forecast_suppressed_evictions_total", "counter", "Eviction escalations held back because every violated metric was trending down (transient spike) when snapshot hysteresis would have escalated.")
+declare("pas_forecast_metric_slope", "gauge", "Mean per-node forecast slope in metric units per second (label: metric).")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where

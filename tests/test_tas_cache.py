@@ -199,6 +199,112 @@ class TestLastKnownGoodRetention:
         ) == "circuit_open"
 
 
+class TestHistoryRing:
+    """ISSUE 8 satellite: the refresh-history ring's semantics under
+    failure (docs/forecast.md).  A failed refresh appends NO sample while
+    the last-known-good value keeps aging; the ring stays bounded at W
+    across 10x W passes; a full delete drops the ring and the forecast
+    gauges with the metric."""
+
+    def _cache_on_fake_clock(self, window=4):
+        from platform_aware_scheduling_tpu.testing.faults import FakeClock
+        from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+        clock = FakeClock()
+        counters = CounterSet()
+        cache = AutoUpdatingCache(counters=counters, clock=clock.now)
+        cache._refresh_period = 1.0
+        cache.configure_history(window)
+        cache.write_metric("m1")  # register for refresh
+        return cache, clock, counters
+
+    def test_failed_refresh_appends_nothing_while_lkg_ages(self):
+        cache, clock, _counters = self._cache_on_fake_clock()
+        good = DummyMetricsClient(
+            {"m1": {"node A": NodeMetric(value=Quantity("7"))}}
+        )
+        cache.update_all_metrics(good)
+        clock.advance(1.0)
+        cache.update_all_metrics(good)
+        t_last_good = clock.now()
+        gen_before = cache.history_generation()
+        _gen, rings = cache.history_snapshot()
+        assert len(rings["m1"]) == 2
+        # the API goes away; passes keep running but the ring is frozen
+        bad = DummyMetricsClient({})
+        for _ in range(3):
+            clock.advance(1.0)
+            cache.update_all_metrics(bad)
+        assert cache.history_generation() == gen_before
+        _gen, rings = cache.history_snapshot()
+        assert len(rings["m1"]) == 2  # no fabricated samples
+        # the GAP is visible: the newest stamp predates the failures
+        assert rings["m1"][-1][0] == pytest.approx(t_last_good)
+        # while the LKG value is still served AND aging
+        assert cache.read_metric("m1")["node A"].value.cmp_int64(7) == 0
+        assert cache.metric_ages()["m1"] == pytest.approx(3.0)
+
+    def test_ring_bounded_at_window_across_many_passes(self):
+        window = 4
+        cache, clock, _counters = self._cache_on_fake_clock(window)
+        for i in range(10 * window):
+            clock.advance(1.0)
+            cache.update_all_metrics(
+                DummyMetricsClient(
+                    {"m1": {"n": NodeMetric(value=Quantity(str(i)))}}
+                )
+            )
+        _gen, rings = cache.history_snapshot()
+        assert len(rings["m1"]) == window
+        # the ring holds exactly the LAST W samples, oldest first
+        values = [sample["n"] for _stamp, sample in rings["m1"]]
+        assert values == [
+            (10 * window - window + i) * 1000 for i in range(window)
+        ]
+
+    def test_delete_metric_drops_ring_and_gauges(self):
+        from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+        from platform_aware_scheduling_tpu.forecast import Forecaster
+
+        cache, clock, counters = self._cache_on_fake_clock()
+        mirror = TensorStateMirror()
+        mirror.attach(cache)
+        forecaster = Forecaster(
+            cache, mirror, window=4, period_s=1.0, counters=counters,
+            clock=clock.now,
+        )
+        for i in range(3):
+            clock.advance(1.0)
+            cache.update_all_metrics(
+                DummyMetricsClient(
+                    {"m1": {"n": NodeMetric(value=Quantity(str(i)))}}
+                )
+            )
+        assert forecaster.ensure_current() is not None
+        # the ramp (0, 1, 2) publishes a positive slope gauge
+        assert counters.get(
+            "pas_forecast_metric_slope", labels={"metric": "m1"},
+            kind="gauge",
+        ) > 0
+        gen_before = cache.history_generation()
+        cache.delete_metric("m1")
+        # the ring is gone (a re-registration must not forecast from a
+        # ghost series) and the generation moved so consumers refit
+        _gen, rings = cache.history_snapshot()
+        assert "m1" not in rings
+        assert cache.history_generation() > gen_before
+        # ...and the per-metric gauges died with it (a removed series
+        # reads back as the 0 default)
+        assert counters.get(
+            "pas_forecast_metric_slope", labels={"metric": "m1"},
+            kind="gauge",
+        ) == 0
+        assert counters.get(
+            "pas_telemetry_metric_age_seconds", labels={"metric": "m1"},
+            kind="gauge",
+        ) == 0
+
+
 class TestMetricsClient:
     def test_wrap_metrics_default_window(self):
         info = wrap_metrics(

@@ -120,6 +120,19 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_forecast_families_declared(self):
+        """ISSUE 8: the predictive-telemetry metric families are part of
+        the declared inventory (docs/forecast.md)."""
+        expected = {
+            "pas_forecast_fit_passes_total": "counter",
+            "pas_forecast_extrapolated_serves_total": "counter",
+            "pas_forecast_suppressed_evictions_total": "counter",
+            "pas_forecast_metric_slope": "gauge",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
     def test_fault_tolerance_families_declared(self):
         """ISSUE 5: the retry/circuit/degraded families are part of the
         declared inventory (docs/robustness.md)."""
