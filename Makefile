@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -92,6 +92,16 @@ test-forecast:
 # overhead tier with the scenario functions directly)
 bench-forecast:
 	python -m benchmarks.forecast_load
+
+# HA control-plane suite (docs/robustness.md "HA & leader election"):
+# lease conflict semantics, elector lifecycle + fencing, the multi-
+# replica exactly-one-actuator invariant, crash-safe gang recovery
+test-ha:
+	python -m pytest tests/test_lease.py tests/test_ha.py -q
+
+# HA A/B alone: c=8 spread over 3 replicas vs 1 + leader-kill failover
+bench-ha:
+	python -m benchmarks.ha_load
 
 # metric-name convention gate (docs/observability.md): every emitted
 # metric is declared in trace.METRICS, pas_-prefixed snake_case, no

@@ -169,7 +169,7 @@ def _detail_path(round_override=None) -> str:
 
 def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
-    chaos=None, decisions=None, gang=None, forecast=None,
+    chaos=None, decisions=None, gang=None, forecast=None, ha=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -298,9 +298,11 @@ def assemble_line(
         # full per-side latency dicts to disk; the line keeps only the
         # availability + p99-ratio headline (service stays flat through
         # a scripted 10% metrics-API error rate — docs/robustness.md)
+        # plus the leader-kill failover headline
         detail["chaos"] = chaos
         clean = chaos.get("clean") or {}
         faulty = chaos.get("faulty") or {}
+        lk = chaos.get("leader_kill") or {}
         result["chaos"] = {
             "num_nodes": chaos.get("num_nodes"),
             "availability_clean": clean.get("availability"),
@@ -308,6 +310,30 @@ def assemble_line(
             "p99_ratio_faulty_vs_clean": chaos.get(
                 "p99_ratio_faulty_vs_clean"
             ),
+            "failover_ticks": lk.get("failover_ticks"),
+            "failover_availability": lk.get("availability"),
+            "failover_duplicate_evictions": lk.get("duplicate_evictions"),
+        }
+    if ha is not None:
+        # full per-replica latency dicts to disk; the line keeps the
+        # scale-out ratios + failover accounting (docs/robustness.md
+        # "HA & leader election")
+        detail["ha"] = ha
+        fo = ha.get("failover") or {}
+        result["ha"] = {
+            "num_nodes": ha.get("num_nodes"),
+            "replicas": ha.get("replicas"),
+            "rps_ratio_multi_vs_single": ha.get(
+                "rps_ratio_multi_vs_single"
+            ),
+            "p99_ratio_multi_vs_single": ha.get(
+                "p99_ratio_multi_vs_single"
+            ),
+            "failover_ticks": fo.get("failover_ticks"),
+            "evictions_vs_baseline": (
+                f"{fo.get('evictions')}/{fo.get('evictions_baseline')}"
+            ),
+            "duplicate_evictions": fo.get("duplicate_evictions"),
         }
     if load is not None:
         # structural note: the filter MISS tier is ratio-capped independent
@@ -536,6 +562,31 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"forecast bench failed: {exc}", file=sys.stderr)
 
+    # --- HA control plane: c=8 over 3 replicas vs 1 + leader-kill
+    # failover accounting (benchmarks/ha_load.py; docs/robustness.md
+    # "HA & leader election") ---
+    ha_out = None
+    try:
+        from benchmarks import ha_load
+
+        # the chaos section already ran the leader-kill fleet; reuse its
+        # result rather than simulating the identical scenario twice
+        ha_out = ha_load.run(
+            failover_result=(chaos or {}).get("leader_kill")
+        )
+        fo = ha_out["failover"]
+        print(
+            f"ha: rps x{ha_out['rps_ratio_multi_vs_single']} over "
+            f"{ha_out['replicas']} replicas (p99 "
+            f"x{ha_out['p99_ratio_multi_vs_single']}); failover "
+            f"{fo['failover_ticks']} ticks, evictions "
+            f"{fo['evictions']}=={fo['evictions_baseline']} baseline, "
+            f"{fo['duplicate_evictions']} duplicates",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"ha bench failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -547,7 +598,7 @@ def main():
 
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
-        decisions_out, gang, forecast_out,
+        decisions_out, gang, forecast_out, ha_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

@@ -239,6 +239,61 @@ class ChaosScenario:
 
 
 # ---------------------------------------------------------------------------
+# leader kill: the multi-replica failover scenario (testing/ha.py)
+# ---------------------------------------------------------------------------
+
+
+def _probe_prioritize(stack) -> bool:
+    """One in-process Prioritize against a replica's extender — the
+    availability signal during failover (a follower must keep serving
+    the verbs while nobody holds the lease)."""
+    from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+    from platform_aware_scheduling_tpu.testing.ha import POLICY_NAME as HA_POL
+
+    body = json.dumps(
+        {
+            "Pod": {
+                "metadata": {
+                    "name": "probe-pod",
+                    "namespace": "default",
+                    "labels": {"telemetry-policy": HA_POL},
+                }
+            },
+            "NodeNames": [f"node-{i}" for i in range(stack.harness.num_nodes)],
+        }
+    ).encode()
+    response = stack.extender.prioritize(
+        HTTPRequest(
+            method="POST",
+            path="/scheduler/prioritize",
+            headers={"Content-Type": "application/json"},
+            body=body,
+        )
+    )
+    return response.status == 200
+
+
+def leader_kill(
+    replicas: int = 3, kill_tick: int = 1, max_ticks: int = 24
+) -> Dict:
+    """Scripted leader kill at tick K (docs/robustness.md "HA & leader
+    election"): a standby must take the lease within the lease duration,
+    every live replica must keep answering Prioritize throughout the
+    leaderless gap, and the fleet's total evictions must equal the
+    single-replica baseline with zero duplicates.  The scenario itself
+    is the shared ``testing.ha.leader_kill``; this wrapper adds the
+    Prioritize availability probe."""
+    from platform_aware_scheduling_tpu.testing import ha
+
+    return ha.leader_kill(
+        replicas=replicas,
+        kill_tick=kill_tick,
+        max_ticks=max_ticks,
+        probe=_probe_prioritize,
+    )
+
+
+# ---------------------------------------------------------------------------
 # the bench: live front-end under a seeded 10% API-error rate
 # ---------------------------------------------------------------------------
 
@@ -302,7 +357,8 @@ def _drive_side(error_rate: float, num_nodes: int, requests: int) -> Dict:
 
 def run(num_nodes: int = 256, requests: int = 400) -> Dict:
     """The ``chaos`` bench section: clean baseline vs scripted 10%
-    metrics-API error rate through the same live service."""
+    metrics-API error rate through the same live service, plus the
+    multi-replica leader-kill failover scenario."""
     out: Dict = {"num_nodes": num_nodes, "requests": requests}
     out["clean"] = _drive_side(0.0, num_nodes, requests)
     out["faulty"] = _drive_side(0.10, num_nodes, requests)
@@ -311,17 +367,23 @@ def run(num_nodes: int = 256, requests: int = 400) -> Dict:
     out["p99_ratio_faulty_vs_clean"] = (
         round(faulty_p99 / clean_p99, 3) if clean_p99 else None
     )
+    out["leader_kill"] = leader_kill()
     return out
 
 
 def main() -> None:
     result = run()
+    lk = result["leader_kill"]
     print(
         f"chaos: availability clean={result['clean']['availability']} "
         f"faulty={result['faulty']['availability']} at 10% API errors; "
         f"p99 {result['clean']['p99_ms']} ms -> "
         f"{result['faulty']['p99_ms']} ms "
-        f"(x{result['p99_ratio_faulty_vs_clean']})",
+        f"(x{result['p99_ratio_faulty_vs_clean']}); leader kill: "
+        f"failover {lk['failover_ticks']} ticks, availability "
+        f"{lk['availability']}, evictions {lk['evictions']}=="
+        f"{lk['evictions_baseline']} baseline, "
+        f"{lk['duplicate_evictions']} duplicates",
         file=sys.stderr,
     )
     print(json.dumps(result))

@@ -101,6 +101,26 @@ def check_front_end(serving: str) -> str:
         assert status == 200, f"{serving}: /debug/forecast -> {status}"
         forecast = json.loads(payload)
         assert forecast["enabled"] is True
+        # leader endpoint: 404 while unwired (--leaderElect off), then
+        # 200 with the role once an elector is attached
+        assert "/debug/leader" in paths, f"{serving}: index missing leader"
+        status, _payload = _get(port, "/debug/leader")
+        assert status == 404, (
+            f"{serving}: /debug/leader must 404 while off -> {status}"
+        )
+        from platform_aware_scheduling_tpu.kube.lease import LeaseElector
+        from platform_aware_scheduling_tpu.testing.fake_kube import (
+            FakeKubeClient,
+        )
+
+        elector = LeaseElector(FakeKubeClient(), identity="smoke-replica")
+        elector.tick()
+        server.scheduler.leadership = elector
+        status, payload = _get(port, "/debug/leader")
+        assert status == 200, f"{serving}: /debug/leader -> {status}"
+        leader = json.loads(payload)
+        assert leader["enabled"] is True
+        assert leader["role"] == "leader", leader
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "

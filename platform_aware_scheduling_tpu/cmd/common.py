@@ -140,6 +140,121 @@ def add_forecast_flags(
                         "frozen-LKG/neutral behavior returns")
 
 
+def add_ha_flags(parser: argparse.ArgumentParser, ha: bool = True) -> None:
+    """HA control-plane flag surface (docs/robustness.md "HA & leader
+    election"): leader election over a coordination.k8s.io Lease plus
+    the crash-safe gang reservation journal.  Like ``--degradedMode``
+    and ``--forecast``, the flags only exist where the machinery does
+    (TAS): GAS runs no singleton actuation loops and keeps no gang
+    state, and offering flags it would silently ignore is worse than
+    not offering them (``add_ha_flags(parser, ha=False)`` is the
+    explicit no-op adoption both mains share)."""
+    if not ha:
+        return
+    parser.add_argument("--leaderElect", action="store_true",
+                        help="run N replicas behind one Service with "
+                        "exactly one executing the actuation loops "
+                        "(rebalancer, deschedule labels, gang sweep): "
+                        "leadership rides a coordination.k8s.io Lease "
+                        "with a monotonic fencing token; followers keep "
+                        "serving Filter/Prioritize at full quality.  Off "
+                        "(the default) changes nothing on the wire")
+    parser.add_argument("--leaseName", default="pas-tas-extender",
+                        help="name of the leadership Lease object")
+    parser.add_argument("--leaseNamespace", default="default",
+                        help="namespace of the leadership Lease")
+    parser.add_argument("--leaseDuration", default="15s",
+                        help="how long a leadership grant survives "
+                        "without renew before standbys may take over "
+                        "(Go duration); also the deposed leader's "
+                        "self-demotion deadline")
+    parser.add_argument("--leaseRenewPeriod", default="",
+                        help="interval between renew/acquire attempts "
+                        "(Go duration); empty = a third of "
+                        "--leaseDuration, jittered deterministically "
+                        "per replica")
+    parser.add_argument("--replicaId", default="",
+                        help="this replica's lease holder identity; "
+                        "empty derives hostname-pid")
+    parser.add_argument("--gangJournal", default="off",
+                        choices=["off", "on"],
+                        help="journal gang slice reservations and binds "
+                        "to a ConfigMap (write-behind, breaker-gated) "
+                        "and recover them at startup, reconciled "
+                        "against live pods — a restart no longer "
+                        "orphans in-flight gangs (docs/gang.md)")
+    parser.add_argument("--gangJournalName", default="pas-gang-journal",
+                        help="name of the journal ConfigMap")
+    parser.add_argument("--gangJournalNamespace", default="default",
+                        help="namespace of the journal ConfigMap")
+
+
+def replica_identity(args) -> str:
+    """The lease holder identity: --replicaId or hostname-pid."""
+    explicit = getattr(args, "replicaId", "")
+    if explicit:
+        return explicit
+    import os
+    import socket
+
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+def build_lease_elector(args, kube_client):
+    """The LeaseElector for --leaderElect (None when off).  The client
+    should already be the fault-tolerant proxy: lease verbs are
+    classified idempotent-by-fencing there, so acquire/renew retry
+    within the lease duration (kube/retry.py)."""
+    if not getattr(args, "leaderElect", False):
+        return None
+    from platform_aware_scheduling_tpu.kube.lease import LeaseElector
+    from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+    duration_s = parse_duration(args.leaseDuration)
+    renew_s = (
+        parse_duration(args.leaseRenewPeriod)
+        if getattr(args, "leaseRenewPeriod", "")
+        else None
+    )
+    return LeaseElector(
+        kube_client,
+        identity=replica_identity(args),
+        lease_name=args.leaseName,
+        namespace=args.leaseNamespace,
+        lease_duration_s=duration_s,
+        renew_period_s=renew_s,
+    )
+
+
+def build_gang_journal(args, kube_client, breakers=None):
+    """The GangJournal for --gangJournal=on (None when off, or when
+    --gang is off — there is no state to journal).
+
+    The reservation ledger is REPLICA-LOCAL (each tracker journals its
+    own full-state snapshots), so under --leaderElect the journal name
+    is suffixed with the replica identity — N replicas sharing one
+    ConfigMap would last-writer-wins erase each other's reservations.
+    For recovery to find the journal across restarts, give replicas a
+    STABLE --replicaId (e.g. the StatefulSet pod name); the hostname-pid
+    default changes on every restart and orphans the previous journal
+    (docs/gang.md "Crash-safe reservations")."""
+    if getattr(args, "gangJournal", "off") != "on":
+        return None
+    if getattr(args, "gang", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.gang import GangJournal
+
+    name = args.gangJournalName
+    if getattr(args, "leaderElect", False):
+        name = f"{name}-{replica_identity(args)}"
+    return GangJournal(
+        kube_client,
+        name=name,
+        namespace=args.gangJournalNamespace,
+        breakers=breakers,
+    )
+
+
 def forecast_options(args, sync_period_s: float) -> Optional[dict]:
     """The --forecast* flags as the options dict ``assemble`` builds a
     Forecaster from (None = off)."""

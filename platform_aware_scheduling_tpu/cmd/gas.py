@@ -53,6 +53,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_robustness_flags(parser, degraded=False)
     common.add_decision_flags(parser)
     common.add_forecast_flags(parser, forecast=False)
+    common.add_ha_flags(parser, ha=False)
     return parser
 
 

@@ -109,6 +109,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_decision_flags(parser)
     common.add_gang_flags(parser)
     common.add_forecast_flags(parser)
+    common.add_ha_flags(parser)
     return parser
 
 
@@ -126,6 +127,8 @@ def assemble(
     degraded_mode: Optional[str] = None,
     gang_tracker=None,
     forecast_options: Optional[dict] = None,
+    leadership=None,
+    gang_journal=None,
 ):
     """Wire cache + mirror + extender + controller + enforcer (the body of
     ``tasController``, reference cmd/main.go:53-95).  Returns the pieces and
@@ -147,7 +150,19 @@ def assemble(
     built over the cache's history rings + the mirror and attached to
     the extender (predicted-value ranking, /debug/forecast), the
     degraded controller (bounded extrapolation), and the rebalancer
-    (trend-aware hysteresis) — docs/forecast.md."""
+    (trend-aware hysteresis) — docs/forecast.md.
+
+    ``leadership``: the --leaderElect LeaseElector
+    (common.build_lease_elector); attached to the enforcer (deschedule
+    label pass), the rebalancer + its actuator (cycle gate + per-
+    eviction fencing), the gang tracker (dead-sweep), and the extender
+    (/readyz condition, /debug/leader).  None — the default single-
+    replica assembly — leaves every behavior byte-identical.
+
+    ``gang_journal``: the --gangJournal=on GangJournal
+    (common.build_gang_journal); the tracker journals reservation/bind
+    mutations write-behind and recovers them here, reconciled against
+    live pods, before any verb is served (docs/gang.md)."""
     cache = AutoUpdatingCache()
     mirror: Optional[TensorStateMirror] = None
     if enable_device_path:
@@ -178,8 +193,19 @@ def assemble(
         cache.on_refresh_pass.append(extender.warm_forecast_rankings)
     if gang_tracker is not None:
         extender.gangs = gang_tracker
+        if gang_journal is not None:
+            # crash-safe reservations: recover the journaled slices —
+            # reconciled against live pods — BEFORE any verb can reserve
+            # over them, then journal every durable mutation from here on
+            gang_tracker.journal = gang_journal
+            gang_tracker.recover()
+    if leadership is not None:
+        extender.leadership = leadership
+        if gang_tracker is not None:
+            gang_tracker.leadership = leadership
 
     enforcer = core.MetricEnforcer(kube_client, mirror=mirror)
+    enforcer.leadership = leadership
     enforcer.register_strategy_type(deschedule.Strategy())
     enforcer.register_strategy_type(scheduleonmetric.Strategy())
     enforcer.register_strategy_type(dontschedule.Strategy())
@@ -214,6 +240,11 @@ def assemble(
         )
         rebalancer.degraded = degraded
         rebalancer.forecaster = forecaster  # trend-aware hysteresis
+        # singleton gating + per-eviction fencing (kube/lease.py): the
+        # cycle idles as "follower" off-leader, and even the leader's
+        # actuator re-verifies its fencing token before each eviction
+        rebalancer.leadership = leadership
+        rebalancer.actuator.leadership = leadership
         rebalancer.attach(enforcer)
         extender.rebalancer = rebalancer
         # gang-atomic eviction completes the loop: a whole-gang eviction
@@ -284,6 +315,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         get_kube_client(args.kubeConfig), retry_policy, breakers
     )
     metrics_client = CustomMetricsClient(kube_client)
+    # HA control plane (docs/robustness.md "HA & leader election"):
+    # leader election + crash-safe gang journal, both optional and both
+    # riding the fault-tolerant client built above
+    leadership = common.build_lease_elector(args, kube_client)
+    gang_journal = common.build_gang_journal(args, kube_client, breakers)
     # cost-analysis capture hangs off each kernel's FIRST compile, which
     # assemble's warm pass triggers — install before assembly
     common.install_cost_visibility()
@@ -298,6 +334,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         degraded_mode=args.degradedMode,
         gang_tracker=common.build_gang_tracker(args, kube_client),
         forecast_options=common.forecast_options(args, sync_period_s),
+        leadership=leadership,
+        gang_journal=gang_journal,
         rebalance_mode=args.rebalance,
         rebalance_options={
             "hysteresis_cycles": args.rebalanceHysteresis,
@@ -312,6 +350,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     common.maybe_start_profiler(args.profilePort)
     common.start_device_watch(stop=stop)
+    if leadership is not None:
+        # the election loop starts AFTER assembly so a recovered gang
+        # journal and warmed caches are in place before this replica can
+        # win the lease and begin actuating
+        leadership.start(stop)
 
     from platform_aware_scheduling_tpu.utils.gctuning import tune_for_serving
 

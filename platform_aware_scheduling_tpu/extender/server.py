@@ -84,6 +84,7 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/rebalance", "description": "last rebalance plan + loop state (404 when --rebalance=off)"},
     {"path": "/debug/gangs", "description": "gang reservations + lifecycle state (404 when --gang=off)"},
     {"path": "/debug/forecast", "description": "per-metric forecast fits: slopes, horizons, uncertainty bands (404 when --forecast=off)"},
+    {"path": "/debug/leader", "description": "leader-election state: role, lease holder, fencing token (404 when --leaderElect is off)"},
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
 ]
 
@@ -448,6 +449,22 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=forecaster.to_json(),
+            )
+        if bare_path == "/debug/leader":
+            # leader-election state (kube/lease.py); 404 when no elector
+            # is wired (--leaderElect off, or GAS)
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            leadership = getattr(self.scheduler, "leadership", None)
+            if leadership is None:
+                return HTTPResponse.json(
+                    b'{"error": "leader election not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=leadership.to_json(),
             )
         if bare_path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of

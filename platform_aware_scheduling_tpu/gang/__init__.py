@@ -9,3 +9,6 @@ from platform_aware_scheduling_tpu.gang.group import (  # noqa: F401
     STATE_RELEASED,
     STATE_RESERVED,
 )
+from platform_aware_scheduling_tpu.gang.journal import (  # noqa: F401
+    GangJournal,
+)

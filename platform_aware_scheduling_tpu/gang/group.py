@@ -212,6 +212,24 @@ class GangTracker:
         self._mesh_at: float = -float("inf")
         self._swept_at: float = -float("inf")
         self._sweeping = False
+        # optional kube.lease.LeaseElector: the dead-gang sweep is a
+        # singleton loop — cluster-wide pod LISTs from every replica
+        # would multiply API load for an action only one replica's
+        # release should perform (docs/robustness.md "HA & leader
+        # election").  Verb overlays are NOT gated: every replica serves
+        # Filter/Prioritize against its own reservation ledger.
+        self.leadership = None
+        # optional gang.journal.GangJournal: reservation/bind state is
+        # journaled write-behind after every mutation (and recovered by
+        # recover() at assembly) so a restart cannot lose live slices —
+        # docs/gang.md "Crash-safe reservations"
+        self.journal = None
+        self._journal_gen = 0  # bumped under the lock on durable changes
+        self._journal_saved_gen = 0
+        # serializes flushes: two verbs flushing concurrently could
+        # otherwise land an OLDER snapshot after a newer one while the
+        # generation math marks the state clean
+        self._journal_write_lock = threading.Lock()
 
     # -- mesh ------------------------------------------------------------------
 
@@ -248,6 +266,11 @@ class GangTracker:
         (``wait=True``) so tests and maintenance calls are
         deterministic."""
         if self.pods_provider is None:
+            return
+        if self.leadership is not None and not self.leadership.is_leader():
+            # singleton loop: only the leader scans the cluster and
+            # releases dead gangs (module attr doc); _swept_at is left
+            # alone so a freshly-promoted leader sweeps immediately
             return
         with self._lock:
             if self._sweeping or (now - self._swept_at) <= (
@@ -335,6 +358,7 @@ class GangTracker:
                 expired += 1
         if expired:
             self._reservation_version += 1
+            self._journal_gen += 1
         idle_bound = 10.0 * self.ttl_s
         for gang_id in [
             gid
@@ -350,6 +374,7 @@ class GangTracker:
         if dropped is not None:
             if dropped.reserved_nodes:
                 self._reservation_version += 1  # its slice is free again
+                self._journal_gen += 1
             # released = removed from tracking; the terminal state is
             # stamped on the object so any held reference reads true
             dropped.state = STATE_RELEASED
@@ -432,6 +457,7 @@ class GangTracker:
         gang.state = STATE_RESERVED
         gang.expires_at = now + self.ttl_s
         self._reservation_version += 1
+        self._journal_gen += 1
         return None
 
     # -- verb overlays ---------------------------------------------------------
@@ -528,6 +554,7 @@ class GangTracker:
                 "pas_gang_rejected_total", labels={"reason": rejected_reason}
             )
         self._set_gauges(gauges)
+        self._journal_flush()  # no-op unless durable state moved
         return failed, codes
 
     def prioritize_overlay(
@@ -587,6 +614,7 @@ class GangTracker:
                 )
                 return
             gang.bound[key] = node
+            self._journal_gen += 1  # binds are durable: recovery replays them
             if (
                 gang.state == STATE_RESERVED
                 and len(gang.bound) >= gang.spec.size
@@ -607,6 +635,7 @@ class GangTracker:
                 component="gang",
             )
         self._set_gauges(gauges)
+        self._journal_flush()
 
     def release(self, gang_id: str) -> bool:
         """Drop a gang and free its slice (job finished or evicted whole
@@ -616,7 +645,201 @@ class GangTracker:
             self._drop_locked(gang_id)
             gauges = self._publish_gauges_locked()
         self._set_gauges(gauges)
+        self._journal_flush()
         return existed
+
+    # -- crash-safe journal (gang/journal.py; docs/gang.md) --------------------
+
+    def _journal_snapshot_locked(self) -> Dict:
+        """The full durable state: every RESERVED/BOUND gang's slice and
+        binds.  Forming gangs hold nothing and are not journaled; TTL
+        deadlines are not journaled either — recovery re-arms a fresh
+        TTL so an abandoned reservation still expires on schedule."""
+        gangs = []
+        for gang in sorted(
+            self._gangs.values(), key=lambda g: (g.created_at, g.gang_id)
+        ):
+            if gang.state not in (STATE_RESERVED, STATE_BOUND):
+                continue
+            gangs.append(
+                {
+                    "gang": gang.gang_id,
+                    "state": gang.state,
+                    "size": gang.spec.size,
+                    "topology": (
+                        list(gang.spec.topology)
+                        if gang.spec.topology is not None
+                        else None
+                    ),
+                    "reserved_nodes": list(gang.reserved_nodes),
+                    "anchor": (
+                        list(gang.anchor) if gang.anchor is not None else None
+                    ),
+                    "bound": dict(gang.bound),
+                    "members": sorted(gang.members),
+                }
+            )
+        return {"gangs": gangs}
+
+    def _journal_flush(self) -> None:
+        """Write-behind: persist the snapshot iff durable state moved
+        since the last committed write.  A failed/skipped write leaves
+        the saved generation behind, so the NEXT durable mutation (or
+        maintenance call) retries — in-memory-only degradation heals
+        itself once the kube circuit closes."""
+        journal = self.journal
+        if journal is None:
+            return
+        # one flush at a time, and the snapshot is taken AFTER the write
+        # lock is held — so whichever flush runs last always persists
+        # the newest state (a concurrent mutation's own flush either
+        # waits here or finds the generation already saved)
+        with self._journal_write_lock:
+            with self._lock:
+                if self._journal_gen == self._journal_saved_gen:
+                    return
+                gen = self._journal_gen
+                snapshot = self._journal_snapshot_locked()
+            if journal.save(snapshot):
+                with self._lock:
+                    self._journal_saved_gen = max(
+                        self._journal_saved_gen, gen
+                    )
+
+    def recover(self) -> int:
+        """Restore journaled reservations at startup, reconciled against
+        live pods; returns the number of gangs restored.
+
+        Reconciliation is the safety half: a bind whose pod is gone is
+        simply dropped (the slice stays reserved for the re-forming
+        gang), but a bind CONTRADICTED by the live cluster — the pod
+        runs on a different node, or on a node outside the journaled
+        slice — discards the whole entry.  Replaying a contradicted
+        reservation is exactly how a recovered extender would admit a
+        gang straddling two slices; the journal is evidence, the
+        cluster is truth."""
+        journal = self.journal
+        if journal is None:
+            return 0
+        data = journal.load()
+        entries = (data or {}).get("gangs") or []
+        if not entries:
+            return 0
+        if self.pods_provider is None:
+            # no live view, no validation, no replay — same stance as a
+            # failing pod list below: restoring unreconciled state is
+            # the straddling hazard (docs/robustness.md recovery matrix)
+            klog.error(
+                "gang journal recovery: no pods_provider to reconcile "
+                "against; discarding %d journaled gangs",
+                len(entries),
+            )
+            trace.COUNTERS.inc(
+                "pas_gang_journal_discarded_total", len(entries)
+            )
+            return 0
+        live: Dict[str, str] = {}
+        try:
+            for pod in self.pods_provider():
+                if (
+                    pod.phase in ("Succeeded", "Failed")
+                    or pod.deletion_timestamp is not None
+                ):
+                    continue
+                live[f"{pod.namespace}/{pod.name}"] = (
+                    pod.spec_node_name or ""
+                )
+        except Exception as exc:
+            # no live view, no validation, no replay: restoring
+            # unreconciled state is the straddling hazard
+            klog.error(
+                "gang journal recovery: cannot list pods (%s); "
+                "discarding %d journaled gangs",
+                exc,
+                len(entries),
+            )
+            trace.COUNTERS.inc(
+                "pas_gang_journal_discarded_total", len(entries)
+            )
+            return 0
+        now = self._clock()
+        restored = 0
+        discarded = 0
+        with self._lock:
+            for entry in entries:
+                gang_id = entry.get("gang")
+                try:
+                    size = int(entry.get("size"))
+                    raw_topo = entry.get("topology")
+                    topo = tuple(raw_topo) if raw_topo else None
+                    reserved = [str(n) for n in entry.get("reserved_nodes")]
+                except (TypeError, ValueError):
+                    discarded += 1
+                    continue
+                if not gang_id or size < 1 or not reserved:
+                    discarded += 1
+                    continue
+                if gang_id in self._gangs:
+                    continue  # live state outranks the journal
+                slice_set = set(reserved)
+                members = set(entry.get("members") or []) | set(
+                    entry.get("bound") or {}
+                )
+                # the cluster is truth: a recovered bind is a live member
+                # RUNNING ON the journaled slice (even one whose bind
+                # observation the crash swallowed); a gone-or-unbound
+                # member just drops its bind; a live member bound OFF the
+                # slice contradicts the whole entry
+                contradicted = False
+                bound: Dict[str, str] = {}
+                for key in sorted(members):
+                    node_now = live.get(key)
+                    if not node_now:
+                        continue  # pod gone, or never actually bound
+                    if node_now not in slice_set:
+                        contradicted = True
+                        break
+                    bound[key] = node_now
+                if contradicted:
+                    discarded += 1
+                    klog.v(1).info_s(
+                        f"gang {gang_id}: journal contradicted by live "
+                        f"pods; discarding its reservation",
+                        component="gang",
+                    )
+                    continue
+                gang = _Gang(GangSpec(gang_id, size, topo), now)
+                gang.reserved_nodes = reserved
+                anchor = entry.get("anchor")
+                gang.anchor = tuple(anchor) if anchor else None
+                gang.bound = bound
+                gang.members = members | set(bound)
+                if entry.get("state") == STATE_BOUND and len(bound) >= size:
+                    gang.state = STATE_BOUND
+                    gang.expires_at = None
+                else:
+                    # fresh TTL: the recovered reservation holds exactly
+                    # one grace window for the gang to resume binding
+                    gang.state = STATE_RESERVED
+                    gang.expires_at = now + self.ttl_s
+                self._gangs[gang_id] = gang
+                for key in gang.members:
+                    self._member_gang[key] = gang_id
+                restored += 1
+            if restored:
+                self._reservation_version += 1
+            gauges = self._publish_gauges_locked()
+        if restored:
+            trace.COUNTERS.inc("pas_gang_journal_recovered_total", restored)
+            klog.v(1).info_s(
+                f"gang journal recovery: {restored} reservation(s) "
+                f"restored, {discarded} discarded",
+                component="gang",
+            )
+        if discarded:
+            trace.COUNTERS.inc("pas_gang_journal_discarded_total", discarded)
+        self._set_gauges(gauges)
+        return restored
 
     # -- introspection ---------------------------------------------------------
 
@@ -643,6 +866,7 @@ class GangTracker:
                 "pas_gang_reservation_expirations_total", expired
             )
             self._set_gauges(gauges)
+            self._journal_flush()
         return version, held
 
     def reserved_nodes(self) -> Dict[str, str]:
@@ -665,6 +889,7 @@ class GangTracker:
                 "pas_gang_reservation_expirations_total", expired
             )
         self._set_gauges(gauges)
+        self._journal_flush()
         return expired
 
     def snapshot(self) -> Dict:

@@ -1,0 +1,178 @@
+"""Crash-safe gang reservation journal over a ConfigMap-style kube
+object (docs/gang.md "Crash-safe reservations").
+
+A gang reservation lives in GangTracker memory — one extender restart
+used to orphan every in-flight slice: half-bound gangs lost their hold,
+already-bound members sat on nodes the re-formed gang might not
+re-reserve, and a re-reservation elsewhere could admit a gang straddling
+two slices.  The journal closes that hole:
+
+  * **Write-behind.**  In-memory state stays the source of truth; after
+    any durable mutation commits (reserve, expiry, release, bind) the
+    tracker flushes a full snapshot here (group.py's dirty-generation
+    counter coalesces bursts).  TTL refreshes are NOT durable — recovery
+    re-arms a fresh TTL — so the cache-hit steady state writes nothing.
+  * **Breaker-gated.**  A journal write is a kube write; while the kube
+    circuit is not closed the write is skipped outright
+    (``pas_gang_journal_skipped_total{reason="circuit_open"}``) and the
+    tracker degrades to in-memory-only — scheduling availability is
+    never hostage to journal durability.  Failed writes are likewise
+    counted and dropped; the next durable mutation retries naturally.
+  * **Reconciled recovery.**  ``GangTracker.recover()`` loads the
+    snapshot at assembly and replays it AGAINST LIVE PODS: binds whose
+    pod is gone, not running, or sitting on a node outside the journaled
+    slice invalidate their entry, and a contradicted entry is DISCARDED
+    (``pas_gang_journal_discarded_total``) rather than replayed — a
+    stale journal can never admit a gang straddling two slices.
+
+The backend speaks the ``get/create/update_configmap`` verb trio
+(kube/client.py, the fake in testing/fake_kube.py), with optimistic
+concurrency handled here: a conflicting update re-reads once and
+re-applies — last snapshot wins, which is correct because snapshots are
+full-state (no read-modify-write merge to lose).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from platform_aware_scheduling_tpu.kube.client import (
+    ConflictError,
+    NotFoundError,
+)
+from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+DEFAULT_JOURNAL_NAME = "pas-gang-journal"
+DEFAULT_JOURNAL_NAMESPACE = "default"
+
+#: snapshot schema version; a journal written by a different schema is
+#: ignored at load (recovery fails safe to an empty ledger)
+SCHEMA_VERSION = 1
+
+
+class GangJournal:
+    """One ConfigMap holding the tracker's full reservation snapshot."""
+
+    def __init__(
+        self,
+        kube_client,
+        name: str = DEFAULT_JOURNAL_NAME,
+        namespace: str = DEFAULT_JOURNAL_NAMESPACE,
+        breakers=None,
+        counters: Optional[CounterSet] = None,
+    ):
+        self.kube_client = kube_client
+        self.name = name
+        self.namespace = namespace
+        # CircuitBreakerRegistry (kube/retry.py) or None: the gate that
+        # turns journal writes off while the kube API is failing fast
+        self.breakers = breakers
+        self.counters = counters if counters is not None else trace.COUNTERS
+        # last committed resourceVersion: the steady-state save is ONE
+        # single-attempt PUT (no read) — a retrying GET on the verb path
+        # would block Filter for the whole read-retry deadline while the
+        # API struggles, before the breaker even opens
+        self._last_rv: Optional[str] = None
+
+    # -- gating ----------------------------------------------------------------
+
+    def _kube_circuit_closed(self) -> bool:
+        if self.breakers is None:
+            return True
+        from platform_aware_scheduling_tpu.kube.retry import (
+            GROUP_KUBE,
+            STATE_CLOSED as CLOSED,
+        )
+
+        return self.breakers.states().get(GROUP_KUBE, CLOSED) == CLOSED
+
+    def _skip(self, reason: str) -> None:
+        self.counters.inc(
+            "pas_gang_journal_skipped_total", labels={"reason": reason}
+        )
+
+    # -- persistence -----------------------------------------------------------
+
+    def _body(self, snapshot: Dict) -> Dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "data": {
+                "state": json.dumps(
+                    {"version": SCHEMA_VERSION, **snapshot}
+                )
+            },
+        }
+
+    def save(self, snapshot: Dict) -> bool:
+        """Persist one full-state snapshot; True on commit.  Skipped
+        (False) while the kube circuit is open; any write error is
+        counted and swallowed — the journal must never wedge a verb."""
+        if not self._kube_circuit_closed():
+            self._skip("circuit_open")
+            klog.v(2).info_s(
+                "gang journal write skipped: kube circuit open "
+                "(in-memory-only until it closes)",
+                component="gang",
+            )
+            return False
+        body = self._body(snapshot)
+        try:
+            committed = self._write(body)
+        except Exception as exc:
+            self._skip("error")
+            klog.error("gang journal write failed: %s", exc)
+            return False
+        self._last_rv = committed["metadata"]["resourceVersion"]
+        self.counters.inc("pas_gang_journal_writes_total")
+        return True
+
+    def _write(self, body: Dict) -> Dict:
+        """Commit one snapshot: a single PUT under the cached RV in the
+        steady state; 404/409/no-RV fall back to a read-then-write
+        round (first write, journal deleted, or a concurrent writer —
+        snapshots are full-state, so last wins)."""
+        if self._last_rv is not None:
+            attempt = dict(body, metadata=dict(body["metadata"]))
+            attempt["metadata"]["resourceVersion"] = self._last_rv
+            try:
+                return self.kube_client.update_configmap(attempt)
+            except (ConflictError, NotFoundError):
+                pass  # RV stale or object gone: learn the truth below
+        try:
+            current = self.kube_client.get_configmap(self.namespace, self.name)
+        except NotFoundError:
+            return self.kube_client.create_configmap(body)
+        body = dict(body, metadata=dict(body["metadata"]))
+        body["metadata"]["resourceVersion"] = current["metadata"][
+            "resourceVersion"
+        ]
+        return self.kube_client.update_configmap(body)
+
+    def load(self) -> Optional[Dict]:
+        """The last committed snapshot, or None (missing journal, parse
+        trouble, schema mismatch, API failure — recovery fails safe to
+        an empty ledger either way)."""
+        try:
+            obj = self.kube_client.get_configmap(self.namespace, self.name)
+        except NotFoundError:
+            return None
+        except Exception as exc:
+            klog.error("gang journal load failed: %s", exc)
+            return None
+        try:
+            state = json.loads((obj.get("data") or {}).get("state") or "")
+        except (ValueError, TypeError):
+            klog.error("gang journal unparseable; ignoring")
+            return None
+        if state.get("version") != SCHEMA_VERSION:
+            klog.error(
+                "gang journal schema %r != %r; ignoring",
+                state.get("version"),
+                SCHEMA_VERSION,
+            )
+            return None
+        return state

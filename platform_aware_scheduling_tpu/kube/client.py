@@ -314,6 +314,46 @@ class KubeClient:
             body=eviction,
         )
 
+    # -- coordination.k8s.io leases (HA leader election, kube/lease.py) -------
+
+    def _lease_base(self, namespace: str) -> str:
+        return f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+
+    def get_lease(self, namespace: str, name: str) -> Dict[str, Any]:
+        return self.request("GET", f"{self._lease_base(namespace)}/{name}")
+
+    def create_lease(self, lease: Dict[str, Any]) -> Dict[str, Any]:
+        ns = lease.get("metadata", {}).get("namespace", "default")
+        return self.request("POST", self._lease_base(ns), body=lease)
+
+    def update_lease(self, lease: Dict[str, Any]) -> Dict[str, Any]:
+        meta = lease.get("metadata", {})
+        return self.request(
+            "PUT",
+            f"{self._lease_base(meta.get('namespace', 'default'))}/{meta['name']}",
+            body=lease,
+        )
+
+    # -- configmaps (gang reservation journal, gang/journal.py) ---------------
+
+    def _configmap_base(self, namespace: str) -> str:
+        return f"/api/v1/namespaces/{namespace}/configmaps"
+
+    def get_configmap(self, namespace: str, name: str) -> Dict[str, Any]:
+        return self.request("GET", f"{self._configmap_base(namespace)}/{name}")
+
+    def create_configmap(self, configmap: Dict[str, Any]) -> Dict[str, Any]:
+        ns = configmap.get("metadata", {}).get("namespace", "default")
+        return self.request("POST", self._configmap_base(ns), body=configmap)
+
+    def update_configmap(self, configmap: Dict[str, Any]) -> Dict[str, Any]:
+        meta = configmap.get("metadata", {})
+        return self.request(
+            "PUT",
+            f"{self._configmap_base(meta.get('namespace', 'default'))}/{meta['name']}",
+            body=configmap,
+        )
+
     # -- TASPolicy CRD (reference pkg/telemetrypolicy/client/v1alpha1) --------
 
     def _crd_base(self, namespace: Optional[str]) -> str:

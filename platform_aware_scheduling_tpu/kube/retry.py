@@ -21,7 +21,11 @@ custom-metrics client (docs/robustness.md):
     update) are NEVER blind-retried — an ambiguous transport error on a
     write is raised to the caller, which owns the decision (the GAS
     annotate loop keeps exactly the reference's conflict-retry
-    semantics).  Watches pass through untouched — the informer owns
+    semantics).  Lease acquire/renew (kube/lease.py) are the exception:
+    idempotent BY FENCING — every attempt carries the observed
+    resourceVersion, so a retry of a committed write answers 409 — they
+    retry like reads, bounded within the lease duration by a per-verb
+    deadline.  Watches pass through untouched — the informer owns
     relist/backoff for streams.
 
 Metric families (declared in utils/trace.py, linted by trace-lint):
@@ -73,8 +77,20 @@ READ_VERBS = frozenset(
         "get_taspolicy",
         "get_node_custom_metric",
         "get_node_metric",
+        "get_lease",
+        "get_configmap",
     }
 )
+
+#: idempotent-by-fencing writes: lease acquire/renew carry the observed
+#: resourceVersion, so a retried attempt whose first try actually
+#: committed answers a deterministic 409 (never retried) — the blind-
+#: retry hazard that forbids retrying evictions does not exist here.
+#: These MAY retry under the policy like reads; the elector bounds the
+#: schedule within the lease duration via a per-verb deadline (a retry
+#: landing after the lease would have expired is worthless — a fresher
+#: tick re-reads and decides again).
+FENCED_WRITE_VERBS = frozenset({"create_lease", "update_lease"})
 
 #: non-idempotent writes: at most ONE attempt here.  Conflict-retry
 #: semantics (refresh + re-apply on 409) belong to the callers that can
@@ -89,6 +105,12 @@ WRITE_VERBS = frozenset(
         "create_taspolicy",
         "update_taspolicy",
         "delete_taspolicy",
+        # the gang journal's configmap writes are breaker-gated single
+        # attempts: a missed journal write degrades to in-memory-only
+        # state (gang/journal.py), which is strictly safer than a retry
+        # storm against a struggling API server
+        "create_configmap",
+        "update_configmap",
     }
 )
 
@@ -402,7 +424,11 @@ class FaultTolerantClient:
 
     def __getattr__(self, name: str):
         attr = getattr(self._inner, name)
-        if name in READ_VERBS:
+        if name in READ_VERBS or name in FENCED_WRITE_VERBS:
+            # fenced lease writes share the read retry loop: a duplicate
+            # attempt is rejected deterministically (409 on the stale
+            # resourceVersion), so transport-level retry cannot double-
+            # commit — unlike evictions, which stay single-attempt
             return self._wrap_read(name, attr)
         if name in WRITE_VERBS:
             return self._wrap_write(name, attr)

@@ -143,6 +143,14 @@ class SafeActuator:
         # fully-evicted gang's slice reservation is released so the mesh
         # nodes return to the pool instead of being held by a dead gang
         self.gang_tracker = None
+        # optional kube.lease.LeaseElector: with --leaderElect, EVERY
+        # eviction re-verifies the fencing token against the live lease
+        # first.  A leader deposed mid-cycle (its plan already computed,
+        # a standby already promoted) fails this check and the move is
+        # skipped with reason ``fenced`` — the new leader owns that pod
+        # now, and acting anyway is the double-eviction split-brain
+        # (docs/robustness.md "HA & leader election")
+        self.leadership = None
 
     # -- gates -----------------------------------------------------------------
 
@@ -264,7 +272,18 @@ class SafeActuator:
 
     def _evict(self, move: Move, pod: Pod, result: ActuationResult) -> bool:
         """One eviction through the subresource; False records the skip
-        (409 -> ``pdb``, anything else -> ``error``)."""
+        (409 -> ``pdb``, fencing refusal -> ``fenced``, anything else ->
+        ``error``).  The fencing check runs before EACH eviction, not
+        once per cycle: leadership can move between the first and last
+        move of one plan."""
+        if self.leadership is not None and not self.leadership.check_fencing():
+            klog.v(1).info_s(
+                f"eviction of {move.pod_key} refused: fencing token no "
+                f"longer valid (leadership moved)",
+                component="rebalance",
+            )
+            result.skip("fenced", move)
+            return False
         try:
             self.kube_client.evict_pod(pod.namespace, pod.name)
         except KubeError as exc:

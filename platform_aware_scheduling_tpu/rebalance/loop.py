@@ -102,6 +102,12 @@ class Rebalancer:
         # must hold the zero-evictions invariant even when driven
         # directly (docs/robustness.md)
         self.degraded = None
+        # optional kube.lease.LeaseElector (docs/robustness.md "HA &
+        # leader election"): the rebalance cycle is a singleton loop —
+        # followers freeze exactly like degraded cycles (streaks neither
+        # grow nor reset; violations are not this replica's to act on)
+        # and the idleness surfaces as actuation.reason="follower"
+        self.leadership = None
         # optional forecast.Forecaster (docs/forecast.md): per-node trend
         # signs classify a violation as trending-up (streak advances as
         # before) vs transient-spike-with-negative-slope (streak HOLDS —
@@ -141,6 +147,24 @@ class Rebalancer:
     def cycle(self, violations: Dict[str, List[str]]) -> Dict:
         """One rebalance cycle over this enforcement pass's violation
         map; returns (and stores for /debug/rebalance) the plan record."""
+        if self.leadership is not None and not self.leadership.is_leader():
+            # follower: same freeze semantics as degraded (streaks
+            # neither grow nor reset — the leader owns the hysteresis
+            # trajectory), surfaced with its own idle reason.  No
+            # decision record: every follower idles every cycle, and
+            # spamming the ring with non-decisions would evict real ones
+            record = {
+                "mode": self.mode,
+                "suspended": "follower: not the leader replica",
+                "idle_reason": "follower",
+                "violating_nodes": sorted(violations),
+                "moves": [],
+                "executed": [],
+                "skipped": {},
+            }
+            with self._lock:
+                self._last_plan = record
+            return record
         if self.degraded is not None:
             allowed, reason = self.degraded.evictions_allowed()
             if not allowed:
@@ -150,6 +174,7 @@ class Rebalancer:
                 record = {
                     "mode": self.mode,
                     "suspended": reason,
+                    "idle_reason": "degraded",
                     "violating_nodes": sorted(violations),
                     "moves": [],
                     "executed": [],
@@ -350,8 +375,24 @@ class Rebalancer:
         degraded_status = (
             self.degraded.status() if self.degraded is not None else None
         )
+        # why actuation is idle, as a concrete reason — not one opaque
+        # suspended flag: "off" (operator choice), "follower" (another
+        # replica leads), "degraded" (eviction suspension), or an active
+        # idle=False.  Precedence mirrors the cycle's own gate order.
+        if self.mode == MODE_OFF:
+            actuation = {"idle": True, "reason": "off"}
+        elif self.leadership is not None and not self.leadership.is_leader():
+            actuation = {"idle": True, "reason": "follower"}
+        elif degraded_status and not degraded_status["evictions"]["allowed"]:
+            actuation = {"idle": True, "reason": "degraded"}
+        else:
+            actuation = {"idle": False, "reason": None}
         return {
             "mode": self.mode,
+            "actuation": actuation,
+            "role": (
+                self.leadership.role() if self.leadership is not None else None
+            ),
             "degraded": degraded_status,
             "evictions_suspended": bool(
                 degraded_status

@@ -295,7 +295,8 @@ class AsyncServer:
                 if bare_path in (
                     "/metrics", "/debug", "/debug/", "/debug/traces",
                     "/debug/decisions", "/debug/rebalance",
-                    "/debug/gangs", "/debug/forecast", "/healthz", "/readyz",
+                    "/debug/gangs", "/debug/forecast", "/debug/leader",
+                    "/healthz", "/readyz",
                 ):
                     # observability endpoints bypass the admission queue:
                     # they must stay readable precisely when the queue is

@@ -120,6 +120,12 @@ class MetricEnforcer:
         # pressure (in-tree or external) is created from data we cannot
         # trust (docs/robustness.md, hard invariant)
         self.degraded = None
+        # optional kube.lease.LeaseElector: with --leaderElect, the
+        # deschedule label pass is a singleton loop — followers evaluate
+        # and publish violations (their caches stay warm for failover)
+        # but never write labels (docs/robustness.md "HA & leader
+        # election")
+        self.leadership = None
         self._lock = threading.RLock()
 
     def publish_violations(

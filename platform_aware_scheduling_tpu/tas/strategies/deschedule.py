@@ -134,7 +134,21 @@ class Strategy:
         stale violation map is still published so the rebalancer can
         record the suspension on /debug/rebalance — its own gate
         guarantees it neither plans, actuates, nor advances drift
-        streaks from it."""
+        streaks from it.
+
+        HA (docs/robustness.md "HA & leader election"): with leader
+        election wired, the label pass is a singleton loop.  A follower
+        still evaluates violations and publishes them — its drift
+        detector and /debug surfaces stay warm for failover — but never
+        writes ``=violating`` labels, so N replicas create exactly one
+        stream of eviction pressure."""
+        leadership = getattr(enforcer, "leadership", None)
+        if leadership is not None and not leadership.is_leader():
+            enforcer.publish_violations(
+                STRATEGY_TYPE,
+                self._node_status_for_strategy(enforcer, cache),
+            )
+            return 0
         degraded = getattr(enforcer, "degraded", None)
         if degraded is not None:
             allowed, reason = degraded.evictions_allowed()

@@ -126,6 +126,14 @@ class MetricsExtender:
         # last-known-good then neutral scores (docs/robustness.md).
         # None (the default) keeps exact reference behavior.
         self.degraded = None
+        # opt-in kube.lease.LeaseElector, set by assembly when
+        # --leaderElect: leadership state surfaces on /readyz (an
+        # informational condition — followers stay ready) and the
+        # front-ends serve GET /debug/leader (404 while this is None).
+        # Verb behavior is role-independent: every replica serves
+        # Filter/Prioritize; only the actuation loops are gated
+        # (docs/robustness.md "HA & leader election")
+        self.leadership = None
         # request-independent ranking/violation caches + byte-fragment
         # encoder (tas/fastpath.py) — the per-request device dispatch and
         # per-node Python objects the round-1 verdict flagged are gone
@@ -229,6 +237,12 @@ class MetricsExtender:
             # is not fully ready (docs/robustness.md)
             conditions.append(
                 ("degraded_mode", self.degraded.readiness_condition)
+            )
+        if self.leadership is not None:
+            # informational: always ok (followers serve traffic at full
+            # quality), the reason names the role and fencing token
+            conditions.append(
+                ("leadership", self.leadership.readiness_condition)
             )
         return conditions
 

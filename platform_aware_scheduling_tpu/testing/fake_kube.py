@@ -96,6 +96,14 @@ class FakeKubeClient:
         self._pods: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self._policies: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self._metrics: Dict[str, Dict[str, Dict[str, Any]]] = {}  # metric -> node -> item
+        # coordination.k8s.io Lease + ConfigMap stores (HA control plane,
+        # docs/robustness.md "HA & leader election"): both enforce
+        # optimistic concurrency — an update carrying a stale
+        # resourceVersion answers 409, exactly the conflict the real API
+        # server raises, so leader-election races resolve the same way
+        # against the fake as against kube
+        self._leases: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._configmaps: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self._hubs = {"nodes": _WatchHub(), "pods": _WatchHub(), "taspolicies": _WatchHub()}
         self.bindings: List[Dict[str, Any]] = []
         self.node_patches: List[Tuple[str, List[Dict[str, Any]]]] = []
@@ -359,6 +367,83 @@ class FakeKubeClient:
         if raw is None:
             raise NotFoundError(f"taspolicy {namespace}/{name} not found", status=404)
         self._hubs["taspolicies"].publish("DELETED", raw)
+
+    # -- coordination.k8s.io leases + configmaps ------------------------------
+    #
+    # Optimistic-concurrency object stores shared by leader election
+    # (kube/lease.py) and the gang journal (gang/journal.py).  The
+    # semantics under test: create of an existing object and update with
+    # a stale resourceVersion both answer 409, so exactly one of N
+    # concurrent acquirers can win any given transition.
+
+    def _oc_get(self, store, kind: str, namespace: str, name: str):
+        with self._lock:
+            raw = store.get((namespace, name))
+            if raw is None:
+                raise NotFoundError(
+                    f"{kind} {namespace}/{name} not found", status=404
+                )
+            return copy.deepcopy(raw)
+
+    def _oc_create(self, store, kind: str, obj: Dict[str, Any]):
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("namespace", "default")
+        key = (meta["namespace"], meta["name"])
+        with self._lock:
+            if key in store:
+                raise ConflictError(
+                    f"{kind} {key[0]}/{key[1]} already exists", status=409
+                )
+            meta["resourceVersion"] = self._next_rv()
+            store[key] = copy.deepcopy(obj)
+        return copy.deepcopy(obj)
+
+    def _oc_update(self, store, kind: str, obj: Dict[str, Any]):
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("namespace", "default")
+        key = (meta["namespace"], meta["name"])
+        with self._lock:
+            stored = store.get(key)
+            if stored is None:
+                raise NotFoundError(
+                    f"{kind} {key[0]}/{key[1]} not found", status=404
+                )
+            if (
+                meta.get("resourceVersion")
+                != stored["metadata"]["resourceVersion"]
+            ):
+                raise ConflictError(
+                    "Operation cannot be fulfilled: please apply your "
+                    "changes to the latest version and try again",
+                    status=409,
+                )
+            meta["resourceVersion"] = self._next_rv()
+            store[key] = copy.deepcopy(obj)
+        return copy.deepcopy(obj)
+
+    def get_lease(self, namespace: str, name: str) -> Dict[str, Any]:
+        self._fault("get_lease")
+        return self._oc_get(self._leases, "lease", namespace, name)
+
+    def create_lease(self, lease: Dict[str, Any]) -> Dict[str, Any]:
+        self._fault("create_lease")
+        return self._oc_create(self._leases, "lease", lease)
+
+    def update_lease(self, lease: Dict[str, Any]) -> Dict[str, Any]:
+        self._fault("update_lease")
+        return self._oc_update(self._leases, "lease", lease)
+
+    def get_configmap(self, namespace: str, name: str) -> Dict[str, Any]:
+        self._fault("get_configmap")
+        return self._oc_get(self._configmaps, "configmap", namespace, name)
+
+    def create_configmap(self, configmap: Dict[str, Any]) -> Dict[str, Any]:
+        self._fault("create_configmap")
+        return self._oc_create(self._configmaps, "configmap", configmap)
+
+    def update_configmap(self, configmap: Dict[str, Any]) -> Dict[str, Any]:
+        self._fault("update_configmap")
+        return self._oc_update(self._configmaps, "configmap", configmap)
 
     # -- watches -------------------------------------------------------------
 

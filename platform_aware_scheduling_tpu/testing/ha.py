@@ -1,0 +1,431 @@
+"""Multi-replica HA harness: N fully-assembled TAS stacks sharing ONE
+fake cluster on ONE fake clock (docs/robustness.md "HA & leader
+election").
+
+The chaos harness (benchmarks/chaos_load.ChaosScenario) proves one
+replica's outage behavior; this harness proves the FLEET's: every
+replica owns its own caches, mirror, enforcer, rebalancer, circuit
+breakers and :class:`~platform_aware_scheduling_tpu.kube.lease.LeaseElector`,
+but they all contend on the same FakeKubeClient lease, see the same
+pods, and evict into the same eviction log — so the exactly-one-actuator
+invariant is checked END TO END, not per component:
+
+  * ``tick()`` advances the shared clock one sync period and steps each
+    live replica in index order: election round, telemetry refresh
+    through its fault-tolerant client, one deschedule enforcement pass
+    (which drives its rebalancer);
+  * ``crash(i)`` stops a replica cold — no demotion courtesy, exactly
+    like SIGKILL: its lease grant simply stops renewing and a standby
+    takes over after the lease duration;
+  * ``restart(i)`` rebuilds the replica from nothing but the shared
+    cluster (and, in gang mode, the journal ConfigMap) — the
+    restart-recovery scenarios ride this;
+  * the shared ``FaultPlan`` scripts API faults fleet-wide (lease
+    flapping, metrics outages) with the usual determinism.
+
+Everything heavyweight (the TensorStateMirror) is imported lazily so
+this module stays importable without jax, like the rest of testing/.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from platform_aware_scheduling_tpu.kube.lease import LeaseElector
+from platform_aware_scheduling_tpu.kube.retry import (
+    CircuitBreakerRegistry,
+    FaultTolerantClient,
+    RetryPolicy,
+)
+from platform_aware_scheduling_tpu.testing.builders import (
+    make_node,
+    make_pod,
+    make_policy,
+    rule,
+)
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.testing.faults import (
+    FakeClock,
+    FakeMetricsClient,
+    FaultPlan,
+)
+
+POLICY_NAME = "ha-pol"
+METRIC = "node_load"
+THRESHOLD = 450
+POD_LOAD = 100
+LEASE_NAME = "pas-ha-test"
+
+
+class ReplicaStack:
+    """One replica's full TAS assembly over the harness's shared fakes:
+    the same pieces ``cmd.tas.assemble`` wires, clocks injected
+    throughout, stepped manually."""
+
+    def __init__(self, harness: "HAHarness", index: int):
+        from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+        from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+        from platform_aware_scheduling_tpu.rebalance import Rebalancer
+        from platform_aware_scheduling_tpu.tas.degraded import (
+            MODE_LAST_KNOWN_GOOD,
+            DegradedModeController,
+        )
+        from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import (
+            TASPolicy,
+            TASPolicyRule,
+        )
+        from platform_aware_scheduling_tpu.tas.strategies import (
+            core,
+            deschedule,
+        )
+        from platform_aware_scheduling_tpu.tas.telemetryscheduler import (
+            MetricsExtender,
+        )
+
+        self.harness = harness
+        self.index = index
+        self.identity = f"replica-{index}"
+        clock = harness.clock
+        # per-replica fault tolerance: each replica's breakers trip on
+        # ITS calls only, as in production
+        self.breakers = CircuitBreakerRegistry(
+            failure_threshold=3, reset_timeout_s=5.0, clock=clock.now
+        )
+        self.retry_policy = RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=0.5, deadline_s=10.0
+        )
+        self.ft_kube = FaultTolerantClient(
+            harness.fake,
+            policy=self.retry_policy,
+            breakers=self.breakers,
+            clock=clock.now,
+            sleep=clock.sleep,
+        )
+        self.ft_metrics = FaultTolerantClient(
+            harness.metrics,
+            policy=self.retry_policy,
+            breakers=self.breakers,
+            clock=clock.now,
+            sleep=clock.sleep,
+        )
+        self.elector = LeaseElector(
+            self.ft_kube,
+            identity=self.identity,
+            lease_name=LEASE_NAME,
+            lease_duration_s=harness.lease_duration_s,
+            clock=clock.now,
+        )
+        self.cache = AutoUpdatingCache(clock=clock.now)
+        self.cache._refresh_period = harness.period_s  # stepped by tick()
+        self.mirror = TensorStateMirror()
+        self.mirror.attach(self.cache)
+        self.cache.write_policy(
+            "default",
+            POLICY_NAME,
+            TASPolicy.from_obj(
+                make_policy(
+                    POLICY_NAME,
+                    strategies={
+                        "deschedule": [rule(METRIC, "GreaterThan", THRESHOLD)],
+                        "dontschedule": [
+                            rule(METRIC, "GreaterThan", THRESHOLD)
+                        ],
+                        "scheduleonmetric": [rule(METRIC, "LessThan", 0)],
+                    },
+                )
+            ),
+        )
+        self.cache.write_metric(METRIC, None)
+        self.extender = MetricsExtender(
+            self.cache, mirror=self.mirror, node_cache_capable=True
+        )
+        self.extender.leadership = self.elector
+        self.enforcer = core.MetricEnforcer(self.ft_kube, mirror=self.mirror)
+        self.enforcer.leadership = self.elector
+        self.strategy = deschedule.Strategy(
+            policy_name=POLICY_NAME,
+            rules=[TASPolicyRule(METRIC, "GreaterThan", THRESHOLD)],
+        )
+        self.enforcer.register_strategy_type(self.strategy)
+        self.enforcer.add_strategy(self.strategy, "deschedule")
+        self.degraded = DegradedModeController(
+            self.cache, breakers=self.breakers, mode=MODE_LAST_KNOWN_GOOD
+        )
+        self.extender.degraded = self.degraded
+        self.enforcer.degraded = self.degraded
+        self.rebalancer = Rebalancer(
+            self.ft_kube,
+            self.mirror,
+            mode=harness.rebalance_mode,
+            hysteresis_cycles=harness.hysteresis_cycles,
+            max_moves=harness.max_moves,
+            rate_per_s=1000.0,
+            burst=100,
+            cooldown_s=0.0,
+            min_available=0,
+            clock=clock.now,
+        )
+        self.rebalancer.degraded = self.degraded
+        self.rebalancer.leadership = self.elector
+        self.rebalancer.actuator.leadership = self.elector
+        self.rebalancer.attach(self.enforcer)
+        self.extender.rebalancer = self.rebalancer
+        self.gangs = None
+        if harness.gang:
+            from platform_aware_scheduling_tpu.gang import (
+                GangJournal,
+                GangTracker,
+            )
+
+            # per-replica journal name, as common.build_gang_journal
+            # derives under --leaderElect: the ledger is replica-local,
+            # and a shared ConfigMap would last-writer-wins clobber the
+            # other replicas' reservations.  restart() reuses the same
+            # identity, so recovery finds this replica's own journal.
+            journal = GangJournal(
+                self.ft_kube,
+                name=f"{harness.journal_name}-{self.identity}",
+                breakers=self.breakers,
+            )
+            self.gangs = GangTracker(
+                nodes_provider=self.ft_kube.list_nodes,
+                pods_provider=self.ft_kube.list_pods,
+                ttl_s=harness.gang_ttl_s,
+                clock=clock.now,
+            )
+            self.gangs.leadership = self.elector
+            self.gangs.journal = journal
+            # the assemble() recovery step: journaled reservations come
+            # back reconciled against live pods before any verb runs
+            self.gangs.recover()
+            self.extender.gangs = self.gangs
+            self.rebalancer.actuator.gang_tracker = self.gangs
+
+    def step(self) -> None:
+        """This replica's slice of one fleet tick: election round, then
+        telemetry refresh, then one deschedule enforcement pass (the
+        rebalance cycle rides it, exactly as in production)."""
+        self.elector.tick()
+        self.cache.update_all_metrics(self.ft_metrics)
+        try:
+            self.strategy.enforce(self.enforcer, self.cache)
+        except Exception:
+            pass  # a failed label pass is part of the chaos under test
+
+    def is_leader(self) -> bool:
+        return self.elector.is_leader()
+
+
+class HAHarness:
+    """The fleet: shared cluster + clock + fault plan, N replica stacks."""
+
+    def __init__(
+        self,
+        replicas: int = 3,
+        num_nodes: int = 6,
+        hot_pods: int = 6,
+        period_s: float = 1.0,
+        hysteresis_cycles: int = 1,
+        max_moves: int = 4,
+        lease_duration_s: float = 3.0,
+        rebalance_mode: str = "active",
+        seed: int = 7,
+        gang: bool = False,
+        mesh: Optional[tuple] = None,
+        gang_ttl_s: float = 30.0,
+        journal_name: str = "pas-ha-journal",
+    ):
+        self.clock = FakeClock()
+        self.plan = FaultPlan(seed=seed)
+        self.period_s = period_s
+        self.hysteresis_cycles = hysteresis_cycles
+        self.max_moves = max_moves
+        self.lease_duration_s = lease_duration_s
+        self.rebalance_mode = rebalance_mode
+        self.gang = gang
+        self.gang_ttl_s = gang_ttl_s
+        self.journal_name = journal_name
+        self.fake = FakeKubeClient()
+        self.fake.fault_plan = self.plan
+        self.fake.fault_clock = self.clock
+        self.num_nodes = num_nodes
+        if gang and mesh is not None:
+            rows, cols = mesh
+            self.mesh_nodes = self.fake.add_mesh(rows, cols)
+            self.num_nodes = rows * cols
+        else:
+            for i in range(num_nodes):
+                self.fake.add_node(
+                    make_node(f"node-{i}", allocatable={"pods": "8"})
+                )
+            for i in range(hot_pods):
+                self.fake.add_pod(
+                    make_pod(
+                        f"pod-{i}",
+                        labels={
+                            "telemetry-policy": POLICY_NAME,
+                            "pas-workload-group": f"g-{i}",
+                        },
+                        node_name="node-0",
+                        phase="Running",
+                    )
+                )
+        self.metrics = FakeMetricsClient(plan=self.plan, clock=self.clock)
+        self.replicas: List[Optional[ReplicaStack]] = [
+            ReplicaStack(self, i) for i in range(replicas)
+        ]
+        self.crashed: Set[int] = set()
+        self.ticks = 0
+
+    # -- fleet stepping --------------------------------------------------------
+
+    def publish_loads(self) -> None:
+        """Refresh the fake metrics API from actual pod placement (the
+        external telemetry pipeline; consumes no replica's fault
+        budget).  Gang-mode meshes publish nothing — those scenarios
+        drive reservations, not evictions."""
+        if self.gang:
+            return
+        counts: Dict[str, int] = {}
+        with self.fake._lock:
+            for raw in self.fake._pods.values():
+                if (raw.get("status") or {}).get("phase") in (
+                    "Succeeded",
+                    "Failed",
+                ):
+                    continue
+                node = (raw.get("spec") or {}).get("nodeName", "")
+                counts[node] = counts.get(node, 0) + 1
+        self.metrics.set_all(
+            METRIC,
+            {
+                f"node-{i}": counts.get(f"node-{i}", 0) * POD_LOAD
+                for i in range(self.num_nodes)
+            },
+        )
+
+    def tick(self) -> None:
+        """One fleet sync period: the clock advances ONCE, then every
+        live replica steps in index order (a deterministic stand-in for
+        the real world's arbitrary interleaving)."""
+        self.ticks += 1
+        self.clock.advance(self.period_s)
+        self.publish_loads()
+        for i, stack in enumerate(self.replicas):
+            if stack is not None and i not in self.crashed:
+                stack.step()
+
+    def run(self, ticks: int) -> None:
+        for _ in range(ticks):
+            self.tick()
+
+    # -- chaos verbs -----------------------------------------------------------
+
+    def crash(self, index: int) -> None:
+        """SIGKILL semantics: the replica stops mid-everything — no
+        demotion, no cleanup; its lease grant just stops renewing."""
+        self.crashed.add(index)
+
+    def restart(self, index: int) -> ReplicaStack:
+        """Rebuild the replica from scratch: fresh in-memory state, same
+        shared cluster (and journal ConfigMap in gang mode)."""
+        stack = ReplicaStack(self, index)
+        self.replicas[index] = stack
+        self.crashed.discard(index)
+        return stack
+
+    # -- observations ----------------------------------------------------------
+
+    def live(self) -> List[ReplicaStack]:
+        return [
+            stack
+            for i, stack in enumerate(self.replicas)
+            if stack is not None and i not in self.crashed
+        ]
+
+    def leaders(self) -> List[str]:
+        """Identities currently CLAIMING leadership — the invariant
+        under test is len <= 1 at every observation point."""
+        return [s.identity for s in self.live() if s.is_leader()]
+
+    def lease_holder(self) -> Optional[str]:
+        """The authoritative holder straight from the fake's store."""
+        with self.fake._lock:
+            lease = self.fake._leases.get(("default", LEASE_NAME))
+            if lease is None:
+                return None
+            return (lease.get("spec") or {}).get("holderIdentity")
+
+    def evictions(self) -> List[Dict]:
+        return list(self.fake.evictions)
+
+    def duplicate_evictions(self) -> List[tuple]:
+        """(namespace, pod) pairs evicted more than once — must be []."""
+        seen: Set[tuple] = set()
+        dups: List[tuple] = []
+        for ev in self.fake.evictions:
+            key = (ev["namespace"], ev["pod"])
+            if key in seen:
+                dups.append(key)
+            seen.add(key)
+        return dups
+
+    def hot_node_load(self) -> int:
+        with self.fake._lock:
+            return sum(
+                1
+                for raw in self.fake._pods.values()
+                if (raw.get("spec") or {}).get("nodeName") == "node-0"
+            ) * POD_LOAD
+
+
+def leader_kill(
+    replicas: int = 3,
+    kill_tick: int = 1,
+    max_ticks: int = 24,
+    max_moves: int = 1,
+    probe=None,
+) -> Dict:
+    """The canonical leader-kill scenario, shared by the chaos and HA
+    benches (one implementation, two reporters): crash the leader at
+    ``kill_tick``, then measure failover latency and the exactly-one-
+    actuator eviction accounting against a single-replica baseline.
+
+    ``probe``: optional per-replica availability callable
+    ``(ReplicaStack) -> bool`` run for every live replica every tick
+    after the kill; its success ratio lands in ``availability`` (None
+    when no probe is given)."""
+    baseline = HAHarness(replicas=1, max_moves=max_moves)
+    baseline.run(max_ticks)
+    harness = HAHarness(replicas=replicas, max_moves=max_moves)
+    harness.run(kill_tick)
+    leader_idx = next(
+        (i for i, s in enumerate(harness.replicas) if s.is_leader()), 0
+    )
+    harness.crash(leader_idx)
+    served = attempts = 0
+    failover_ticks = None
+    for t in range(max_ticks - kill_tick):
+        harness.tick()
+        if probe is not None:
+            for stack in harness.live():
+                attempts += 1
+                if probe(stack):
+                    served += 1
+        if failover_ticks is None and harness.leaders():
+            failover_ticks = t + 1
+    return {
+        "replicas": replicas,
+        "kill_tick": kill_tick,
+        "lease_duration_ticks": int(
+            harness.lease_duration_s / harness.period_s
+        ),
+        "failover_ticks": failover_ticks,
+        "availability": (
+            round(served / max(1, attempts), 4) if probe is not None else None
+        ),
+        "evictions": len(harness.evictions()),
+        "evictions_baseline": len(baseline.evictions()),
+        "duplicate_evictions": len(harness.duplicate_evictions()),
+        "converged": harness.hot_node_load() == baseline.hot_node_load(),
+    }

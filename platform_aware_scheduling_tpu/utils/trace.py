@@ -132,7 +132,7 @@ declare("pas_profile_captures_total", "counter", "Bounded jax.profiler traces ca
 declare("pas_rebalance_plans_total", "counter", "Rebalance cycles that produced a plan (including empty plans).")
 declare("pas_rebalance_moves_planned_total", "counter", "Pod moves proposed by rebalance plans (within the churn budget).")
 declare("pas_rebalance_moves_executed_total", "counter", "Pod evictions actually executed by the rebalance actuator.")
-declare("pas_rebalance_moves_skipped_total", "counter", "Planned moves not executed (label: reason in dry_run/rate_limit/cooldown/min_available/pdb/gang_partial/error).")
+declare("pas_rebalance_moves_skipped_total", "counter", "Planned moves not executed (label: reason in dry_run/rate_limit/cooldown/min_available/pdb/gang_partial/fenced/error).")
 declare("pas_rebalance_candidate_nodes", "gauge", "Nodes currently past the deschedule hysteresis threshold (eviction candidates).")
 declare("pas_rebalance_convergence_cycles", "gauge", "Enforcement cycles the most recent violation episode took from first violation back to zero.")
 declare("pas_rebalance_plan_latency_seconds", "gauge", "Wall latency of the most recent incremental replan solve.")
@@ -169,6 +169,15 @@ declare("pas_forecast_fit_passes_total", "counter", "Batched forecast fit passes
 declare("pas_forecast_extrapolated_serves_total", "counter", "Degraded-mode requests served past the frozen-LKG window under forecast confidence: Prioritize ranks on the extrapolated predictions, Filter keeps the last-known-good verdicts alive.")
 declare("pas_forecast_suppressed_evictions_total", "counter", "Eviction escalations held back because every violated metric was trending down (transient spike) when snapshot hysteresis would have escalated.")
 declare("pas_forecast_metric_slope", "gauge", "Mean per-node forecast slope in metric units per second (label: metric).")
+# HA control plane (kube/lease.py leader election + gang/journal.py
+# crash-safe reservation journal; docs/robustness.md "HA & leader
+# election")
+declare("pas_leader", "gauge", "1 while this replica holds the leadership lease and runs the singleton actuation loops (label: replica).")
+declare("pas_leader_transitions_total", "counter", "Local leadership role changes (gained or lost) observed by this replica's elector.")
+declare("pas_gang_journal_writes_total", "counter", "Gang reservation journal snapshots committed to the ConfigMap backend.")
+declare("pas_gang_journal_skipped_total", "counter", "Journal writes not attempted or failed, leaving the tracker in-memory-only (label: reason in circuit_open/error).")
+declare("pas_gang_journal_recovered_total", "counter", "Gang reservations restored from the journal at startup after reconciling against live pods.")
+declare("pas_gang_journal_discarded_total", "counter", "Journal entries discarded at recovery because live pods contradicted them (stale journal must not admit a straddling gang).")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where

@@ -1,0 +1,624 @@
+"""End-to-end HA control-plane suite (docs/robustness.md "HA & leader
+election"): the multi-replica harness (testing/ha.py) proving the
+exactly-one-actuator invariant, fenced actuation, the rebalance idle
+reasons, and crash-safe gang reservation recovery.
+
+Everything runs on one shared fake clock and one shared FakeKubeClient;
+nothing sleeps and nothing is random.
+"""
+
+import json
+
+import pytest
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.kube.retry import CircuitBreakerRegistry
+from platform_aware_scheduling_tpu.testing.builders import make_gang_pod
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.testing.faults import FakeClock
+from platform_aware_scheduling_tpu.testing.ha import (
+    HAHarness,
+    LEASE_NAME,
+    POLICY_NAME,
+)
+from platform_aware_scheduling_tpu.utils import trace
+
+
+def _prioritize(stack, num_nodes):
+    body = json.dumps(
+        {
+            "Pod": {
+                "metadata": {
+                    "name": "probe",
+                    "namespace": "default",
+                    "labels": {"telemetry-policy": POLICY_NAME},
+                }
+            },
+            "NodeNames": [f"node-{i}" for i in range(num_nodes)],
+        }
+    ).encode()
+    return stack.extender.prioritize(
+        HTTPRequest(
+            method="POST",
+            path="/scheduler/prioritize",
+            headers={"Content-Type": "application/json"},
+            body=body,
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# the exactly-one-actuator invariant (ACCEPTANCE)
+# ---------------------------------------------------------------------------
+
+
+class TestExactlyOneActuator:
+    def test_leader_crash_failover_zero_duplicates(self):
+        """ACCEPTANCE: leader crash mid-convergence -> a standby holds
+        the lease within the lease duration, the fleet's total
+        evictions equal the single-replica baseline, and the eviction
+        log holds zero duplicates."""
+        ticks = 24
+        baseline = HAHarness(replicas=1, max_moves=1)
+        baseline.run(ticks)
+        assert len(baseline.evictions()) > 0
+
+        h = HAHarness(replicas=3, max_moves=1)
+        h.tick()  # leader elected, first eviction in flight
+        assert h.leaders() == ["replica-0"]
+        h.crash(0)
+        failover = None
+        for t in range(ticks - 1):
+            h.tick()
+            assert len(h.leaders()) <= 1  # never two leaders
+            if failover is None and h.leaders():
+                failover = t + 1
+        # takeover is legal after lease_duration; +1 tick of slack for
+        # the tick that observes the expiry
+        bound = int(h.lease_duration_s / h.period_s) + 1
+        assert failover is not None and failover <= bound
+        assert h.leaders() == ["replica-1"]
+        assert len(h.evictions()) == len(baseline.evictions())
+        assert h.duplicate_evictions() == []
+        assert h.hot_node_load() == baseline.hot_node_load()
+
+    def test_lease_flapping_matches_baseline_actuation(self):
+        """Lease-API outage mid-episode: nobody holds the lease (the
+        old leader self-expires), actuation pauses, and after recovery
+        the fleet still lands on exactly the baseline eviction count."""
+        ticks = 30
+        baseline = HAHarness(replicas=1, max_moves=1)
+        baseline.run(ticks)
+
+        h = HAHarness(replicas=3, max_moves=1)
+        h.tick()
+        for verb in ("get_lease", "update_lease", "create_lease"):
+            h.plan.outage(verb, status=503)
+        h.run(6)
+        assert h.leaders() == []  # local expiry demoted the old leader
+        for verb in ("get_lease", "update_lease", "create_lease"):
+            h.plan.clear(verb)
+        h.run(ticks - 7)
+        assert len(h.leaders()) == 1
+        assert len(h.evictions()) == len(baseline.evictions())
+        assert h.duplicate_evictions() == []
+        assert h.hot_node_load() == baseline.hot_node_load()
+
+    def test_deposed_leader_in_flight_eviction_is_fenced(self):
+        """ACCEPTANCE: a leader deposed mid-cycle (locally still
+        convinced; the lease has moved) reaches the actuator and is
+        refused by the per-eviction fencing check — the move lands as
+        skipped reason=fenced, and the cluster sees no eviction."""
+        h = HAHarness(replicas=2, max_moves=1, lease_duration_s=1000.0)
+        h.tick()
+        a, b = h.replicas[0], h.replicas[1]
+        assert a.is_leader()
+        evictions_before = len(h.evictions())
+        # depose a on the SERVER only: force-expire its grant, let b
+        # take over (token bumps); a's local deadline is 1000 s out
+        with h.fake._lock:
+            h.fake._leases[("default", LEASE_NAME)]["spec"][
+                "renewTime"
+            ] = -1e9
+        assert b.elector.tick() is True
+        assert a.elector.is_leader() is True  # locally unaware
+        # a's in-flight cycle: refresh + enforce exactly as a tick would
+        h.publish_loads()
+        a.cache.update_all_metrics(a.ft_metrics)
+        a.strategy.enforce(a.enforcer, a.cache)
+        assert len(h.evictions()) == evictions_before  # nothing evicted
+        last = a.rebalancer.status()["last_plan"]
+        assert "fenced" in last["skipped"], last
+        # the refused fencing check also demoted a
+        assert a.elector.is_leader() is False
+
+    def test_followers_keep_serving_verbs(self):
+        h = HAHarness(replicas=3)
+        h.run(2)
+        followers = [s for s in h.live() if not s.is_leader()]
+        assert len(followers) == 2
+        for stack in followers:
+            response = _prioritize(stack, h.num_nodes)
+            assert response.status == 200
+            assert json.loads(response.body)  # real ranked payload
+
+    def test_follower_never_patches_labels(self):
+        """The deschedule label pass is leader-only: every node patch in
+        the shared fake must have been written while its author held
+        the lease — with one stable leader, followers write nothing."""
+        h = HAHarness(replicas=3, rebalance_mode="off")
+        h.run(4)
+        # patches happened (the leader's pass) ...
+        assert len(h.fake.node_patches) > 0
+        # ... and only one replica ever held the lease in this run
+        assert h.leaders() == ["replica-0"]
+        # crash every replica but a follower: with no leader, NO new
+        # patches appear even as enforcement keeps running
+        h.crash(0)
+        patches_at_crash = len(h.fake.node_patches)
+        h.tick()  # follower ticks before takeover is legal
+        assert len(h.fake.node_patches) == patches_at_crash
+
+
+# ---------------------------------------------------------------------------
+# rebalance idle reasons (/debug/rebalance; satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRebalanceIdleReasons:
+    def test_follower_reason(self):
+        h = HAHarness(replicas=2)
+        h.run(2)
+        follower = next(s for s in h.live() if not s.is_leader())
+        status = follower.rebalancer.status()
+        assert status["actuation"] == {"idle": True, "reason": "follower"}
+        assert status["role"] == "follower"
+        assert status["last_plan"]["idle_reason"] == "follower"
+        leader = next(s for s in h.live() if s.is_leader())
+        assert leader.rebalancer.status()["actuation"] == {
+            "idle": False,
+            "reason": None,
+        }
+
+    def test_degraded_reason_wins_on_leader(self):
+        h = HAHarness(replicas=1)
+        h.run(2)
+        leader = h.live()[0]
+        h.plan.outage("get_node_metric", status=503)
+        h.run(6)  # telemetry goes stale -> evictions suspended
+        status = leader.rebalancer.status()
+        assert status["actuation"] == {"idle": True, "reason": "degraded"}
+        assert status["last_plan"]["idle_reason"] == "degraded"
+
+    def test_off_reason(self):
+        h = HAHarness(replicas=1, rebalance_mode="off")
+        h.run(2)
+        status = h.live()[0].rebalancer.status()
+        assert status["actuation"] == {"idle": True, "reason": "off"}
+
+    def test_served_on_debug_rebalance(self):
+        from wirehelpers import get_request, start_threaded
+
+        h = HAHarness(replicas=2)
+        h.run(2)
+        follower = next(s for s in h.live() if not s.is_leader())
+        server = start_threaded(follower.extender)
+        try:
+            status, _h, payload = get_request(server.port, "/debug/rebalance")
+            assert status == 200
+            snap = json.loads(payload)
+            assert snap["actuation"] == {"idle": True, "reason": "follower"}
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe gang reservations (ACCEPTANCE)
+# ---------------------------------------------------------------------------
+
+
+def _reserve(stack, harness, pod_name, group, size, topo):
+    pod = make_gang_pod(pod_name, group, size, topology=topo)
+    harness.fake.add_pod(pod)
+    failed, _codes = stack.gangs.filter_overlay(pod, list(harness.mesh_nodes))
+    return [n for n in harness.mesh_nodes if n not in failed]
+
+
+class TestGangJournalRecovery:
+    def test_restart_mid_reservation_recovers_the_slice(self):
+        """ACCEPTANCE: kill and re-assemble the extender mid-reservation
+        — the re-formed gang admits on the JOURNALED slice, members
+        Filter onto exactly those nodes, and a competing gang cannot
+        take them."""
+        h = HAHarness(replicas=2, gang=True, mesh=(4, 4))
+        h.run(1)
+        stack = h.live()[0]
+        reserved = _reserve(stack, h, "g1-m0", "job1", 4, "2x2")
+        assert len(reserved) == 4
+        # one member binds for real (nodeName lands in the fake)
+        h.fake.bind_pod("default", "g1-m0", "uid-0", reserved[0])
+        stack.gangs.observe_bind("default", "g1-m0", reserved[0])
+        # SIGKILL + re-assembly: fresh in-memory state, shared journal
+        h.crash(stack.index)
+        revived = h.restart(stack.index)
+        snap = revived.gangs.snapshot()
+        assert len(snap["gangs"]) == 1
+        entry = snap["gangs"][0]
+        assert entry["state"] == "reserved"
+        assert entry["reserved_nodes"] == reserved
+        assert entry["bound"] == 1  # the live on-slice bind survived
+        # a member Filter passes ONLY the recovered slice
+        member = make_gang_pod("g1-m1", "job1", 4, topology="2x2")
+        failed, _ = revived.gangs.filter_overlay(
+            member, list(h.mesh_nodes)
+        )
+        assert [n for n in h.mesh_nodes if n not in failed] == reserved
+        # a competing gang is pushed OFF the recovered slice
+        other = make_gang_pod("g2-m0", "job2", 4, topology="2x2")
+        failed2, _ = revived.gangs.filter_overlay(other, list(h.mesh_nodes))
+        other_slice = set(h.mesh_nodes) - set(failed2)
+        assert not (other_slice & set(reserved))
+        # remaining members Filter (onto the slice) then bind -> the
+        # recovered gang fully admits
+        admitted_before = trace.COUNTERS.get("pas_gang_admitted_total")
+        for i, node in enumerate(reserved):
+            name = f"g1-m{i}"
+            if i:
+                pod_i = make_gang_pod(name, "job1", 4, topology="2x2")
+                h.fake.add_pod(pod_i)
+                revived.gangs.filter_overlay(pod_i, list(h.mesh_nodes))
+                h.fake.bind_pod("default", name, f"uid-{i}", node)
+            revived.gangs.observe_bind("default", name, node)
+        assert (
+            trace.COUNTERS.get("pas_gang_admitted_total")
+            == admitted_before + 1
+        )
+
+    def test_contradicted_journal_is_discarded(self):
+        """ACCEPTANCE: a journal whose bound member now runs OUTSIDE the
+        journaled slice is discarded at recovery — replaying it is how
+        a gang would straddle two slices."""
+        h = HAHarness(replicas=1, gang=True, mesh=(4, 4))
+        h.run(1)
+        stack = h.live()[0]
+        reserved = _reserve(stack, h, "x-m0", "jobx", 4, "2x2")
+        h.fake.bind_pod("default", "x-m0", "uid", reserved[0])
+        stack.gangs.observe_bind("default", "x-m0", reserved[0])
+        # the cluster moves on while we are dead: the pod lands on a
+        # node OUTSIDE the journaled slice
+        off_slice = next(n for n in h.mesh_nodes if n not in reserved)
+        with h.fake._lock:
+            h.fake._pods[("default", "x-m0")]["spec"]["nodeName"] = off_slice
+        discarded_before = trace.COUNTERS.get(
+            "pas_gang_journal_discarded_total"
+        )
+        h.crash(0)
+        revived = h.restart(0)
+        assert revived.gangs.snapshot()["gangs"] == []
+        assert (
+            trace.COUNTERS.get("pas_gang_journal_discarded_total")
+            == discarded_before + 1
+        )
+
+    def test_unbound_member_drops_bind_but_keeps_reservation(self):
+        """A journaled bind whose pod never actually bound (the bind
+        raced the crash) drops the BIND only; the reservation survives
+        with a fresh TTL."""
+        h = HAHarness(replicas=1, gang=True, mesh=(4, 4))
+        h.run(1)
+        stack = h.live()[0]
+        reserved = _reserve(stack, h, "y-m0", "joby", 4, "2x2")
+        # observe_bind WITHOUT a real fake bind: journal says bound,
+        # cluster says the pod has no nodeName
+        stack.gangs.observe_bind("default", "y-m0", reserved[0])
+        h.crash(0)
+        revived = h.restart(0)
+        snap = revived.gangs.snapshot()
+        assert len(snap["gangs"]) == 1
+        assert snap["gangs"][0]["bound"] == 0
+        assert snap["gangs"][0]["reserved_nodes"] == reserved
+
+    def test_recovered_reservation_still_expires(self):
+        h = HAHarness(replicas=1, gang=True, mesh=(4, 4), gang_ttl_s=5.0)
+        h.run(1)
+        stack = h.live()[0]
+        _reserve(stack, h, "z-m0", "jobz", 4, "2x2")
+        h.crash(0)
+        revived = h.restart(0)
+        assert len(revived.gangs.snapshot()["gangs"]) == 1
+        h.clock.advance(6.0)  # past the re-armed TTL, nobody refreshes
+        revived.gangs.prune()
+        snap = revived.gangs.snapshot()
+        assert snap["gangs"][0]["state"] == "forming"
+        assert snap["gangs"][0]["reserved_nodes"] == []
+
+    def test_recover_without_pods_provider_discards(self):
+        """No live view means no validation: a tracker with a journal
+        but no pods_provider must DISCARD journaled entries, not replay
+        them unreconciled (the documented recovery-matrix stance)."""
+        from platform_aware_scheduling_tpu.gang import GangJournal, GangTracker
+
+        fake = FakeKubeClient()
+        journal = GangJournal(fake)
+        journal.save(
+            {
+                "gangs": [
+                    {
+                        "gang": "default/stale",
+                        "state": "reserved",
+                        "size": 2,
+                        "topology": None,
+                        "reserved_nodes": ["n0", "n1"],
+                        "anchor": None,
+                        "bound": {},
+                        "members": [],
+                    }
+                ]
+            }
+        )
+        tracker = GangTracker(nodes_provider=fake.list_nodes)
+        tracker.journal = journal
+        discarded_before = trace.COUNTERS.get(
+            "pas_gang_journal_discarded_total"
+        )
+        assert tracker.recover() == 0
+        assert tracker.snapshot()["gangs"] == []
+        assert (
+            trace.COUNTERS.get("pas_gang_journal_discarded_total")
+            == discarded_before + 1
+        )
+
+    def test_journal_write_behind_and_breaker_gating(self):
+        """Reservation changes journal write-behind; with the kube
+        circuit open the write is SKIPPED (counted) and the tracker
+        keeps working in memory — then heals on the next durable
+        mutation after the circuit closes."""
+        h = HAHarness(replicas=1, gang=True, mesh=(4, 4))
+        h.run(1)
+        stack = h.live()[0]
+        writes_before = trace.COUNTERS.get("pas_gang_journal_writes_total")
+        _reserve(stack, h, "a-m0", "joba", 4, "2x2")
+        assert (
+            trace.COUNTERS.get("pas_gang_journal_writes_total")
+            == writes_before + 1
+        )
+        # TTL refreshes are not durable: another member Filter (same
+        # reservation) writes nothing
+        member = make_gang_pod("a-m1", "joba", 4, topology="2x2")
+        stack.gangs.filter_overlay(member, list(h.mesh_nodes))
+        assert (
+            trace.COUNTERS.get("pas_gang_journal_writes_total")
+            == writes_before + 1
+        )
+        # open the kube circuit: the next durable mutation skips
+        kube_breaker = stack.breakers.breaker("kube")
+        for _ in range(kube_breaker.failure_threshold):
+            kube_breaker.record_failure()
+        skipped_before = trace.COUNTERS.get(
+            "pas_gang_journal_skipped_total",
+            labels={"reason": "circuit_open"},
+        )
+        reserved_b = _reserve(stack, h, "b-m0", "jobb", 4, "2x2")
+        assert reserved_b  # in-memory reservation still works
+        assert (
+            trace.COUNTERS.get(
+                "pas_gang_journal_skipped_total",
+                labels={"reason": "circuit_open"},
+            )
+            == skipped_before + 1
+        )
+        # circuit closes -> the next durable mutation persists BOTH
+        kube_breaker.record_success()
+        stack.gangs.release("default/jobb")
+        snap = stack.gangs.journal.load()
+        assert snap is not None
+        assert [g["gang"] for g in snap["gangs"]] == ["default/joba"]
+
+    def test_gang_sweep_is_leader_only(self):
+        calls = []
+        clock = FakeClock()
+        from platform_aware_scheduling_tpu.gang import GangSpec, GangTracker
+        from platform_aware_scheduling_tpu.gang.group import (
+            STATE_BOUND,
+            _Gang,
+        )
+
+        class NotLeader:
+            def is_leader(self):
+                return False
+
+        tracker = GangTracker(
+            nodes_provider=lambda: [],
+            pods_provider=lambda: calls.append(1) or [],
+            mesh_max_age_s=0.0,
+            clock=clock.now,
+        )
+        # a bound gang whose members are all gone: sweep bait
+        gang = _Gang(GangSpec("default/dead", 1, None), 0.0)
+        gang.state = STATE_BOUND
+        gang.reserved_nodes = ["n0"]
+        gang.bound = {"default/ghost": "n0"}
+        tracker._gangs["default/dead"] = gang
+        tracker.leadership = NotLeader()
+        clock.advance(10.0)
+        tracker.prune()  # inline sweep path
+        assert calls == []  # follower never lists cluster pods
+        tracker.leadership = None
+        clock.advance(10.0)
+        tracker.prune()
+        assert calls == [1]  # ungated (single-replica) sweeps as before
+
+
+# ---------------------------------------------------------------------------
+# assembly wiring + off-path
+# ---------------------------------------------------------------------------
+
+
+class TestAssemblyWiring:
+    def test_assemble_attaches_leadership_everywhere(self):
+        from platform_aware_scheduling_tpu.cmd.tas import assemble
+        from platform_aware_scheduling_tpu.gang import GangJournal, GangTracker
+        from platform_aware_scheduling_tpu.kube.lease import LeaseElector
+        from platform_aware_scheduling_tpu.tas.metrics import (
+            DummyMetricsClient,
+        )
+
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        elector = LeaseElector(fake, "r0", lease_name="l", clock=clock.now)
+        tracker = GangTracker(
+            nodes_provider=fake.list_nodes, pods_provider=fake.list_pods
+        )
+        journal = GangJournal(fake)
+        pieces = assemble(
+            fake,
+            DummyMetricsClient({}),
+            sync_period_s=3600.0,
+            rebalance_mode="dry-run",
+            gang_tracker=tracker,
+            leadership=elector,
+            gang_journal=journal,
+        )
+        _cache, _mirror, extender, _controller, enforcer, stop = pieces
+        try:
+            assert extender.leadership is elector
+            assert enforcer.leadership is elector
+            assert extender.rebalancer.leadership is elector
+            assert extender.rebalancer.actuator.leadership is elector
+            assert tracker.leadership is elector
+            assert tracker.journal is journal
+            names = [n for n, _ in extender.readiness_conditions()]
+            assert "leadership" in names
+        finally:
+            stop.set()
+
+    def test_assemble_recovers_journal_before_serving(self):
+        from platform_aware_scheduling_tpu.cmd.tas import assemble
+        from platform_aware_scheduling_tpu.gang import GangJournal, GangTracker
+        from platform_aware_scheduling_tpu.tas.metrics import (
+            DummyMetricsClient,
+        )
+
+        fake = FakeKubeClient()
+        fake.add_mesh(2, 2)
+        # a journal written by a previous life
+        journal = GangJournal(fake)
+        journal.save(
+            {
+                "gangs": [
+                    {
+                        "gang": "default/old",
+                        "state": "reserved",
+                        "size": 2,
+                        "topology": [1, 2],
+                        "reserved_nodes": ["mesh-0-0", "mesh-0-1"],
+                        "anchor": [0, 0, 1, 2],
+                        "bound": {},
+                        "members": [],
+                    }
+                ]
+            }
+        )
+        tracker = GangTracker(
+            nodes_provider=fake.list_nodes, pods_provider=fake.list_pods
+        )
+        pieces = assemble(
+            fake,
+            DummyMetricsClient({}),
+            sync_period_s=3600.0,
+            gang_tracker=tracker,
+            gang_journal=journal,
+        )
+        stop = pieces[-1]
+        try:
+            snap = tracker.snapshot()
+            assert [g["gang"] for g in snap["gangs"]] == ["default/old"]
+            assert snap["gangs"][0]["reserved_nodes"] == [
+                "mesh-0-0",
+                "mesh-0-1",
+            ]
+        finally:
+            stop.set()
+
+    def test_off_path_untouched(self):
+        """Single-replica assembly without --leaderElect: no leadership
+        anywhere, the actuator unfenced, the enforcer ungated — and the
+        flags parse with HA off by default."""
+        from platform_aware_scheduling_tpu.cmd import gas, tas
+        from platform_aware_scheduling_tpu.cmd.tas import assemble
+        from platform_aware_scheduling_tpu.tas.metrics import (
+            DummyMetricsClient,
+        )
+
+        args = tas.build_arg_parser().parse_args([])
+        assert args.leaderElect is False
+        assert args.gangJournal == "off"
+        from platform_aware_scheduling_tpu.cmd import common
+
+        assert common.build_lease_elector(args, FakeKubeClient()) is None
+        assert common.build_gang_journal(args, FakeKubeClient()) is None
+        # GAS has no HA machinery: the flags must not exist there
+        gas_args = gas.build_arg_parser().parse_args([])
+        assert not hasattr(gas_args, "leaderElect")
+        with pytest.raises(SystemExit):
+            gas.build_arg_parser().parse_args(["--leaderElect"])
+
+        pieces = assemble(
+            FakeKubeClient(),
+            DummyMetricsClient({}),
+            sync_period_s=3600.0,
+            rebalance_mode="dry-run",
+        )
+        _cache, _mirror, extender, _controller, enforcer, stop = pieces
+        try:
+            assert extender.leadership is None
+            assert enforcer.leadership is None
+            assert extender.rebalancer.leadership is None
+            assert extender.rebalancer.actuator.leadership is None
+            names = [n for n, _ in extender.readiness_conditions()]
+            assert "leadership" not in names
+            status = extender.rebalancer.status()
+            assert status["role"] is None
+            assert status["actuation"]["reason"] is None
+        finally:
+            stop.set()
+
+    def test_ha_flags_parse_and_build(self):
+        from platform_aware_scheduling_tpu.cmd import common, tas
+
+        args = tas.build_arg_parser().parse_args(
+            [
+                "--leaderElect",
+                "--leaseName", "my-lease",
+                "--leaseDuration", "30s",
+                "--leaseRenewPeriod", "7s",
+                "--replicaId", "pod-3",
+                "--gang", "on",
+                "--gangJournal", "on",
+                "--gangJournalName", "my-journal",
+            ]
+        )
+        elector = common.build_lease_elector(args, FakeKubeClient())
+        assert elector is not None
+        assert elector.identity == "pod-3"
+        assert elector.lease_name == "my-lease"
+        assert elector.lease_duration_s == 30.0
+        assert elector.renew_period_s == 7.0
+        journal = common.build_gang_journal(
+            args, FakeKubeClient(), CircuitBreakerRegistry()
+        )
+        assert journal is not None
+        # the ledger is replica-local: under --leaderElect the journal
+        # name carries the replica identity so N replicas can never
+        # last-writer-wins clobber each other's reservations
+        assert journal.name == "my-journal-pod-3"
+        # without leader election (single replica) the bare name serves
+        args_single = tas.build_arg_parser().parse_args(
+            ["--gang", "on", "--gangJournal", "on",
+             "--gangJournalName", "solo-journal"]
+        )
+        solo = common.build_gang_journal(args_single, FakeKubeClient())
+        assert solo is not None and solo.name == "solo-journal"
+        # journal without --gang=on is pointless: explicitly None
+        args2 = tas.build_arg_parser().parse_args(["--gangJournal", "on"])
+        assert common.build_gang_journal(args2, FakeKubeClient()) is None

@@ -1,0 +1,450 @@
+"""Leader election over the Lease subresource (kube/lease.py) and the
+FakeKubeClient lease conflict semantics it builds on
+(docs/robustness.md "HA & leader election").
+
+Everything runs on fake clocks; nothing sleeps.  The contract under
+test, bottom up: the fake's optimistic concurrency (stale
+resourceVersion -> 409, concurrent acquirers -> exactly one winner),
+the elector's lifecycle (acquire, renew, takeover with a bumped fencing
+token, local expiry, fencing checks), the retry stack's
+idempotent-by-fencing classification of the lease verbs, and the
+/debug/leader surface on both front-ends.
+"""
+
+import json
+
+import pytest
+
+from platform_aware_scheduling_tpu.kube.client import (
+    ConflictError,
+    NotFoundError,
+)
+from platform_aware_scheduling_tpu.kube.lease import LeaseElector
+from platform_aware_scheduling_tpu.kube.retry import (
+    CircuitBreakerRegistry,
+    FENCED_WRITE_VERBS,
+    FaultTolerantClient,
+    READ_VERBS,
+    RetryPolicy,
+    WRITE_VERBS,
+    backoff_delay,
+    stable_hash,
+)
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.testing.faults import FakeClock, FaultPlan
+from platform_aware_scheduling_tpu.utils import trace
+from wirehelpers import get_request, post_bytes, raw_request, start_async, start_threaded
+
+
+def _lease(name="l", holder="x", rv=None, transitions=1):
+    obj = {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "holderIdentity": holder,
+            "leaseDurationSeconds": 10.0,
+            "renewTime": 0.0,
+            "leaseTransitions": transitions,
+        },
+    }
+    if rv is not None:
+        obj["metadata"]["resourceVersion"] = rv
+    return obj
+
+
+class TestFakeLeaseSemantics:
+    def test_get_missing_is_404(self):
+        fake = FakeKubeClient()
+        with pytest.raises(NotFoundError):
+            fake.get_lease("default", "nope")
+
+    def test_create_existing_is_409(self):
+        fake = FakeKubeClient()
+        fake.create_lease(_lease())
+        with pytest.raises(ConflictError):
+            fake.create_lease(_lease())
+
+    def test_update_with_stale_resource_version_is_409(self):
+        fake = FakeKubeClient()
+        created = fake.create_lease(_lease())
+        stale_rv = created["metadata"]["resourceVersion"]
+        # a first update commits and bumps the RV...
+        fresh = fake.update_lease(_lease(rv=stale_rv, holder="y"))
+        assert fresh["metadata"]["resourceVersion"] != stale_rv
+        # ...so replaying the old RV is the classic lost-update conflict
+        with pytest.raises(ConflictError):
+            fake.update_lease(_lease(rv=stale_rv, holder="z"))
+
+    def test_update_missing_is_404(self):
+        fake = FakeKubeClient()
+        with pytest.raises(NotFoundError):
+            fake.update_lease(_lease(rv="1"))
+
+    def test_concurrent_acquirers_exactly_one_winner(self):
+        """N electors racing an empty lease: exactly one create commits;
+        the rest observe the conflict and follow."""
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        electors = [
+            LeaseElector(fake, f"r{i}", lease_name="l", clock=clock.now)
+            for i in range(5)
+        ]
+        outcomes = [e.tick() for e in electors]
+        assert sum(outcomes) == 1
+        assert [e.is_leader() for e in electors].count(True) == 1
+        # and the race for a takeover of an EXPIRED lease is just as
+        # exclusive: both contenders observed the same stale RV
+        clock.advance(1000.0)
+        followers = [e for e in electors if not e.is_leader()]
+        winners = [e.tick() for e in followers]
+        assert sum(winners) == 1
+
+    def test_configmap_conflict_semantics_match(self):
+        fake = FakeKubeClient()
+        cm = {
+            "metadata": {"name": "j", "namespace": "default"},
+            "data": {"state": "{}"},
+        }
+        created = fake.create_configmap(dict(cm))
+        with pytest.raises(ConflictError):
+            fake.create_configmap(dict(cm))
+        stale = created["metadata"]["resourceVersion"]
+        fake.update_configmap(
+            {"metadata": {"name": "j", "namespace": "default",
+                          "resourceVersion": stale}, "data": {"state": "1"}}
+        )
+        with pytest.raises(ConflictError):
+            fake.update_configmap(
+                {"metadata": {"name": "j", "namespace": "default",
+                              "resourceVersion": stale}, "data": {}}
+            )
+
+
+class TestLeaseElector:
+    def test_acquire_renew_keeps_token(self):
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        elector = LeaseElector(
+            fake, "a", lease_name="l", lease_duration_s=10.0, clock=clock.now
+        )
+        assert elector.tick() is True
+        assert elector.fencing_token() == 1
+        for _ in range(5):
+            clock.advance(3.0)
+            assert elector.tick() is True
+        # renewing is not a transition: the token is stable
+        assert elector.fencing_token() == 1
+
+    def test_takeover_after_expiry_bumps_fencing_token(self):
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        a = LeaseElector(fake, "a", lease_name="l", lease_duration_s=10.0,
+                         clock=clock.now)
+        b = LeaseElector(fake, "b", lease_name="l", lease_duration_s=10.0,
+                         clock=clock.now)
+        a.tick()
+        assert b.tick() is False  # live holder: follow
+        clock.advance(10.0)  # a's grant lapses un-renewed
+        assert b.tick() is True
+        assert b.fencing_token() == 2
+        # a demoted itself locally the moment its own deadline passed
+        assert a.is_leader() is False
+        assert a.fencing_token() is None
+
+    def test_local_expiry_during_api_outage(self):
+        """An unrenewable leader steps down by ITSELF: no API contact is
+        needed for is_leader() to go false once its grant would have
+        lapsed — the singleton loops stop before a takeover is legal."""
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        plan = FaultPlan()
+        fake.fault_plan = plan
+        fake.fault_clock = clock
+        elector = LeaseElector(
+            fake, "a", lease_name="l", lease_duration_s=10.0, clock=clock.now
+        )
+        elector.tick()
+        plan.outage("get_lease", status=503)
+        plan.outage("update_lease", status=503)
+        clock.advance(5.0)
+        elector.tick()  # renew fails; grant still within duration
+        assert elector.is_leader() is True
+        clock.advance(5.0)  # ...now the grant has lapsed
+        assert elector.is_leader() is False
+
+    def test_check_fencing_rejects_deposed_leader(self):
+        """The deposed-mid-cycle case: a's local deadline still holds,
+        but the lease has moved on — the fencing re-read must refuse,
+        and demote a on the spot."""
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        a = LeaseElector(fake, "a", lease_name="l", lease_duration_s=100.0,
+                         clock=clock.now)
+        b = LeaseElector(fake, "b", lease_name="l", lease_duration_s=100.0,
+                         clock=clock.now)
+        a.tick()
+        assert a.check_fencing() is True
+        # force-expire on the server only (a's local deadline is 100 s
+        # out), then b takes over with token 2
+        with fake._lock:
+            fake._leases[("default", "l")]["spec"]["renewTime"] = -1e9
+        assert b.tick() is True
+        assert a.is_leader() is True  # locally still convinced...
+        assert a.check_fencing() is False  # ...but the lease knows better
+        assert a.is_leader() is False  # and the refusal demotes it
+
+    def test_check_fencing_fails_safe_on_api_error(self):
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        plan = FaultPlan()
+        elector = LeaseElector(fake, "a", lease_name="l", clock=clock.now)
+        elector.tick()
+        fake.fault_plan = plan
+        fake.fault_clock = clock
+        plan.outage("get_lease", status=503)
+        assert elector.check_fencing() is False
+
+    def test_renew_conflict_demotes(self):
+        """A renew that answers 409 means a takeover already committed
+        somewhere: the old leader must not keep acting on a stale
+        token."""
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        a = LeaseElector(fake, "a", lease_name="l", lease_duration_s=10.0,
+                         clock=clock.now)
+        a.tick()
+        # move the lease under a's feet (fresh RV, new holder)
+        current = fake.get_lease("default", "l")
+        current["spec"]["holderIdentity"] = "b"
+        current["spec"]["leaseTransitions"] = 2
+        fake.update_lease(current)
+        # a's next tick observes the foreign holder and follows
+        assert a.tick() is False
+        assert a.fencing_token() is None
+
+    def test_leader_gauge_and_transition_counter(self):
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        before = trace.COUNTERS.get("pas_leader_transitions_total")
+        elector = LeaseElector(fake, "gauge-rep", lease_name="l",
+                               lease_duration_s=10.0, clock=clock.now)
+        elector.tick()
+        assert trace.COUNTERS.get(
+            "pas_leader", labels={"replica": "gauge-rep"}, kind="gauge"
+        ) == 1
+        assert trace.COUNTERS.get("pas_leader_transitions_total") == before + 1
+        clock.advance(20.0)  # lapse without renew -> self-demotion
+        assert elector.is_leader() is False
+        assert trace.COUNTERS.get(
+            "pas_leader", labels={"replica": "gauge-rep"}, kind="gauge"
+        ) == 0
+        assert trace.COUNTERS.get("pas_leader_transitions_total") == before + 2
+
+    def test_lease_spec_uses_real_api_wire_types(self):
+        """The real API server rejects float times / float durations:
+        acquireTime/renewTime must be RFC3339 MicroTime strings and
+        leaseDurationSeconds an int — and both directions round-trip
+        through the parser (a lease written by kubectl/client-go, with
+        or without fractional seconds, reads the same way)."""
+        from platform_aware_scheduling_tpu.kube.lease import (
+            format_micro_time,
+            parse_lease_time,
+        )
+
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        elector = LeaseElector(fake, "a", lease_name="l",
+                               lease_duration_s=10.0, clock=clock.now)
+        elector.tick()
+        spec = fake.get_lease("default", "l")["spec"]
+        assert isinstance(spec["leaseDurationSeconds"], int)
+        assert isinstance(spec["acquireTime"], str)
+        assert isinstance(spec["renewTime"], str)
+        assert parse_lease_time(spec["renewTime"]) == pytest.approx(
+            clock.now(), abs=1e-5
+        )
+        # round-trip + foreign spellings + garbage fails safe to 0
+        assert parse_lease_time(format_micro_time(1234.5)) == pytest.approx(
+            1234.5, abs=1e-5
+        )
+        assert parse_lease_time("2026-08-04T12:00:00Z") > 0
+        assert parse_lease_time("2026-08-04T12:00:00.123456Z") > 0
+        assert parse_lease_time(42) == 42.0
+        assert parse_lease_time("not-a-time") == 0.0
+        assert parse_lease_time(None) == 0.0
+        # a foreign-written lease (string MicroTime) renews cleanly
+        clock.advance(3.0)
+        assert elector.tick() is True
+
+    def test_status_payload(self):
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        elector = LeaseElector(fake, "a", lease_name="l", clock=clock.now)
+        elector.tick()
+        status = elector.status()
+        assert status["role"] == "leader"
+        assert status["fencing_token"] == 1
+        assert status["lease"]["holder"] == "a"
+        ok, reason = elector.readiness_condition()
+        assert ok is True and "leader" in reason
+
+
+class TestLeaseVerbRetryClassification:
+    """Satellite: lease verbs are idempotent-by-fencing — they retry
+    like reads under the policy (with their own pas_kube_retry_total
+    verb labels), while 409 stays deterministic and un-retried."""
+
+    def test_verb_classes(self):
+        assert "get_lease" in READ_VERBS
+        assert "get_configmap" in READ_VERBS
+        assert FENCED_WRITE_VERBS == {"create_lease", "update_lease"}
+        assert "create_configmap" in WRITE_VERBS
+        assert "update_configmap" in WRITE_VERBS
+        assert FENCED_WRITE_VERBS.isdisjoint(WRITE_VERBS)
+
+    def test_update_lease_retries_on_deterministic_schedule(self):
+        """Two scripted 503s then success: the exact jittered backoff
+        schedule (seed ^ stable_hash(verb)) is slept, the retry counter
+        moves under verb=update_lease, and the call commits."""
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        plan = FaultPlan()
+        fake.fault_plan = plan
+        fake.fault_clock = clock
+        created = fake.create_lease(_lease())
+        plan.fail("update_lease", 2, status=503)
+        slept = []
+        policy = RetryPolicy(
+            max_attempts=4, base_delay_s=0.1, max_delay_s=5.0,
+            deadline_s=30.0, seed=11,
+        )
+        client = FaultTolerantClient(
+            fake,
+            policy=policy,
+            breakers=CircuitBreakerRegistry(clock=clock.now),
+            clock=clock.now,
+            sleep=lambda s: (slept.append(s), clock.advance(s)),
+        )
+        before = trace.COUNTERS.get(
+            "pas_kube_retry_total",
+            labels={"verb": "update_lease", "reason": "server_error"},
+        )
+        updated = client.update_lease(
+            _lease(rv=created["metadata"]["resourceVersion"], holder="y")
+        )
+        assert updated["spec"]["holderIdentity"] == "y"
+        expected = [
+            backoff_delay(n, 0.1, 5.0, seed=11 ^ stable_hash("update_lease"))
+            for n in (1, 2)
+        ]
+        assert slept == pytest.approx(expected)
+        assert trace.COUNTERS.get(
+            "pas_kube_retry_total",
+            labels={"verb": "update_lease", "reason": "server_error"},
+        ) == before + 2
+
+    def test_conflict_is_never_retried(self):
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        plan = FaultPlan()
+        fake.fault_plan = plan
+        fake.fault_clock = clock
+        fake.create_lease(_lease())
+        slept = []
+        client = FaultTolerantClient(
+            fake,
+            policy=RetryPolicy(max_attempts=4),
+            breakers=CircuitBreakerRegistry(clock=clock.now),
+            clock=clock.now,
+            sleep=slept.append,
+        )
+        with pytest.raises(ConflictError):
+            client.update_lease(_lease(rv="stale-rv"))
+        assert slept == []  # deterministic answer: one attempt, no sleep
+        assert plan.call_count("update_lease") == 1
+
+    def test_elector_caps_lease_verb_deadlines_at_lease_duration(self):
+        """A retry schedule outliving the lease is worthless: building
+        an elector over the FT client tightens the lease verbs' retry
+        deadline to the lease duration (operator-set LOWER deadlines
+        stand; other verbs untouched)."""
+        clock = FakeClock()
+        policy = RetryPolicy(
+            deadline_s=30.0, verb_deadlines={"create_lease": 2.0}
+        )
+        client = FaultTolerantClient(
+            FakeKubeClient(),
+            policy=policy,
+            breakers=CircuitBreakerRegistry(clock=clock.now),
+            clock=clock.now,
+            sleep=clock.sleep,
+        )
+        LeaseElector(client, "a", lease_name="l", lease_duration_s=10.0,
+                     clock=clock.now)
+        assert policy.deadline_for("get_lease") == 10.0
+        assert policy.deadline_for("update_lease") == 10.0
+        assert policy.deadline_for("create_lease") == 2.0  # already tighter
+        assert policy.deadline_for("list_nodes") == 30.0  # untouched
+
+    def test_elector_rides_the_fault_tolerant_client(self):
+        """A transient 503 on renew is absorbed by the retry layer: the
+        elector's tick succeeds without ever observing the fault."""
+        fake = FakeKubeClient()
+        clock = FakeClock()
+        plan = FaultPlan()
+        fake.fault_plan = plan
+        fake.fault_clock = clock
+        client = FaultTolerantClient(
+            fake,
+            policy=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                               max_delay_s=0.1),
+            breakers=CircuitBreakerRegistry(clock=clock.now),
+            clock=clock.now,
+            sleep=clock.sleep,
+        )
+        elector = LeaseElector(client, "a", lease_name="l",
+                               lease_duration_s=10.0, clock=clock.now)
+        elector.tick()
+        plan.fail("update_lease", 1, status=503)
+        clock.advance(3.0)
+        assert elector.tick() is True  # retried through the blip
+        assert elector.fencing_token() == 1
+
+
+@pytest.mark.parametrize("serving", ["threaded", "async"])
+class TestDebugLeaderEndpoint:
+    def test_codes_and_payload(self, serving):
+        from benchmarks.http_load import build_extender
+
+        ext, _names = build_extender(8, device=True)
+        server = (
+            start_async(ext) if serving == "async" else start_threaded(ext)
+        )
+        try:
+            # unwired: 404, but discoverable in the /debug index
+            status, _h, _p = get_request(server.port, "/debug/leader")
+            assert status == 404
+            status, _h, payload = get_request(server.port, "/debug")
+            assert status == 200
+            paths = [e["path"] for e in json.loads(payload)["endpoints"]]
+            assert "/debug/leader" in paths
+            # wired: 200 + role/token; non-GET 405
+            clock = FakeClock()
+            elector = LeaseElector(
+                FakeKubeClient(), "r0", lease_name="l", clock=clock.now
+            )
+            elector.tick()
+            ext.leadership = elector
+            status, _h, payload = get_request(server.port, "/debug/leader")
+            assert status == 200
+            snap = json.loads(payload)
+            assert snap["role"] == "leader"
+            assert snap["fencing_token"] == 1
+            assert snap["lease"]["holder"] == "r0"
+            status, _h, _p = raw_request(
+                server.port, post_bytes("/debug/leader", b"{}")
+            )
+            assert status == 405
+        finally:
+            server.shutdown()

@@ -133,6 +133,22 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_ha_families_declared(self):
+        """ISSUE 9: the leader-election + gang-journal metric families
+        are part of the declared inventory (docs/robustness.md "HA &
+        leader election")."""
+        expected = {
+            "pas_leader": "gauge",
+            "pas_leader_transitions_total": "counter",
+            "pas_gang_journal_writes_total": "counter",
+            "pas_gang_journal_skipped_total": "counter",
+            "pas_gang_journal_recovered_total": "counter",
+            "pas_gang_journal_discarded_total": "counter",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
     def test_fault_tolerance_families_declared(self):
         """ISSUE 5: the retry/circuit/degraded families are part of the
         declared inventory (docs/robustness.md)."""
