@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -102,6 +102,18 @@ test-ha:
 # HA A/B alone: c=8 spread over 3 replicas vs 1 + leader-kill failover
 bench-ha:
 	python -m benchmarks.ha_load
+
+# SLO engine + digital-twin suite (docs/observability.md "SLOs & error
+# budgets"): burn-rate window math on fake clocks, bucket quantile
+# interpolation, /debug/slo + off-path pins, and the scenario matrix
+# incl. the metric-storm page -> recover acceptance over real sockets
+test-slo:
+	python -m pytest tests/test_slo.py tests/test_twin.py -q -m 'not slow'
+
+# digital-twin scenario matrix alone: every default scenario at 10k
+# nodes, verdicts = the SLO engine's judgment (testing/twin.py)
+bench-twin:
+	python -m benchmarks.twin_load
 
 # metric-name convention gate (docs/observability.md): every emitted
 # metric is declared in trace.METRICS, pas_-prefixed snake_case, no

@@ -170,6 +170,7 @@ def _detail_path(round_override=None) -> str:
 def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
     chaos=None, decisions=None, gang=None, forecast=None, ha=None,
+    twin=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -334,6 +335,17 @@ def assemble_line(
                 f"{fo.get('evictions')}/{fo.get('evictions_baseline')}"
             ),
             "duplicate_evictions": fo.get("duplicate_evictions"),
+        }
+    if twin is not None:
+        # full per-scenario verdicts (checks + SLO judgments) to disk;
+        # the line keeps the compact scenario matrix — the per-scenario
+        # regression surface every future PR's BENCH_DETAIL must show
+        # (testing/twin.py; docs/observability.md "SLOs & error budgets")
+        detail["twin"] = twin
+        result["twin"] = {
+            "num_nodes": twin.get("num_nodes"),
+            "all_passed": twin.get("all_passed"),
+            "matrix": twin.get("matrix"),
         }
     if load is not None:
         # structural note: the filter MISS tier is ratio-capped independent
@@ -587,6 +599,26 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"ha bench failed: {exc}", file=sys.stderr)
 
+    # --- digital twin: the SLO-gated scenario matrix at 10k nodes
+    # (benchmarks/twin_load.py; docs/observability.md "SLOs & error
+    # budgets") ---
+    twin_out = None
+    try:
+        from benchmarks import twin_load
+
+        twin_out = twin_load.run(num_nodes=NUM_NODES)
+        compact = ", ".join(
+            f"{name}={'pass' if entry['passed'] else 'FAIL'}"
+            for name, entry in sorted(twin_out["matrix"].items())
+        )
+        print(
+            f"twin: {twin_out['num_nodes']} nodes, "
+            f"{twin_out['wall_s']}s wall — {compact}",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"twin bench failed: {exc}", file=sys.stderr)
+
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
     try:
@@ -598,7 +630,7 @@ def main():
 
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
-        decisions_out, gang, forecast_out, ha_out,
+        decisions_out, gang, forecast_out, ha_out, twin_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

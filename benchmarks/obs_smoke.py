@@ -121,6 +121,37 @@ def check_front_end(serving: str) -> str:
         leader = json.loads(payload)
         assert leader["enabled"] is True
         assert leader["role"] == "leader", leader
+        # slo endpoint: 404 while off (--slo=off), then 200 with the
+        # compliance payload once an engine is wired — and its gauges
+        # must appear on /metrics only from that moment
+        assert "/debug/slo" in paths, f"{serving}: index missing slo"
+        status, _payload = _get(port, "/debug/slo")
+        assert status == 404, (
+            f"{serving}: /debug/slo must 404 while off -> {status}"
+        )
+        from platform_aware_scheduling_tpu.utils.slo import (
+            SLOEngine,
+            default_slos,
+        )
+
+        engine = SLOEngine(
+            default_slos(), recorders=[server.scheduler.recorder]
+        )
+        engine.tick()
+        server.scheduler.slo = engine
+        status, payload = _get(port, "/debug/slo")
+        assert status == 200, f"{serving}: /debug/slo -> {status}"
+        slo_snap = json.loads(payload)
+        assert slo_snap["enabled"] is True
+        assert any(
+            "compliance" in row for row in slo_snap["slos"]
+        ), f"{serving}: /debug/slo payload without compliance rows"
+        status, payload = _get(port, "/metrics")
+        assert status == 200
+        families = trace.parse_prometheus_text(payload.decode())
+        assert "pas_slo_compliance" in families, (
+            f"{serving}: wired engine's gauges missing from /metrics"
+        )
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "
@@ -130,6 +161,70 @@ def check_front_end(serving: str) -> str:
         server.shutdown()
 
 
+def check_scrape_under_load(
+    writers: int = 8, requests_per_writer: int = 40, scrapes: int = 20
+) -> str:
+    """The "observability survives saturation" invariant: while the
+    digital twin's service takes c=8 verb load through the async
+    front-end (deliberately tiny admission queue so some of it sheds),
+    /metrics and /debug/slo — which bypass the queue — answer 200 with
+    parseable payloads on every single scrape."""
+    import threading
+
+    from platform_aware_scheduling_tpu.serving import AsyncServer
+    from platform_aware_scheduling_tpu.testing.twin import (
+        TwinCluster,
+        _prioritize_body,
+    )
+    from platform_aware_scheduling_tpu.utils import trace
+
+    twin = TwinCluster(num_nodes=64, pods=64, requests_per_tick=0, gas=False)
+    server = AsyncServer(
+        twin.live()[0].extender, max_queue_depth=2, window_s=0.002
+    )
+    server.start_server(port="0", unsafe=True, host="127.0.0.1", block=False)
+    server.wait_ready()
+    try:
+        twin.tick()  # telemetry + one SLO evaluation before load
+        port = server.port
+        body = _prioritize_body("smoke-pod", twin.live_node_names())
+        shed = [0]
+
+        def writer() -> None:
+            for _ in range(requests_per_writer):
+                status, _ = _post(port, "/scheduler/prioritize", body)
+                if status == 503:
+                    shed[0] += 1
+
+        threads = [
+            threading.Thread(target=writer) for _ in range(writers)
+        ]
+        for t in threads:
+            t.start()
+        scraped = 0
+        while any(t.is_alive() for t in threads) or scraped < scrapes:
+            status, payload = _get(port, "/metrics")
+            assert status == 200, f"/metrics under load -> {status}"
+            trace.parse_prometheus_text(payload.decode())
+            status, payload = _get(port, "/debug/slo")
+            assert status == 200, f"/debug/slo under load -> {status}"
+            assert json.loads(payload)["enabled"] is True
+            scraped += 1
+            if scraped >= scrapes and not any(
+                t.is_alive() for t in threads
+            ):
+                break
+        for t in threads:
+            t.join()
+        return (
+            f"obs-smoke scrape-under-load: OK ({scraped} scrapes readable "
+            f"through c={writers} load, {shed[0]} requests shed 503)"
+        )
+    finally:
+        server.shutdown()
+        twin.close()
+
+
 def main() -> int:
     for serving in ("threaded", "async"):
         try:
@@ -137,6 +232,11 @@ def main() -> int:
         except AssertionError as exc:
             print(f"obs-smoke FAILED: {exc}", file=sys.stderr)
             return 1
+    try:
+        print(check_scrape_under_load(), flush=True)
+    except AssertionError as exc:
+        print(f"obs-smoke FAILED: {exc}", file=sys.stderr)
+        return 1
     return 0
 
 

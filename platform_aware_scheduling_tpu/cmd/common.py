@@ -189,6 +189,69 @@ def add_ha_flags(parser: argparse.ArgumentParser, ha: bool = True) -> None:
                         help="namespace of the journal ConfigMap")
 
 
+def add_slo_flags(parser: argparse.ArgumentParser) -> None:
+    """SLO engine flag surface shared by both mains
+    (docs/observability.md "SLOs & error budgets")."""
+    parser.add_argument("--slo", default="off", choices=["off", "on"],
+                        help="evaluate first-class SLOs over the process's "
+                        "own metrics: verb availability, Filter/Prioritize "
+                        "latency, telemetry freshness and eviction safety "
+                        "(TAS), with Google-SRE multi-window burn-rate "
+                        "alerting (page 5m/1h, warn 6h/3d) on "
+                        "pas_slo_burn_rate and GET /debug/slo.  Off (the "
+                        "default) registers no gauges and changes nothing "
+                        "on the wire — the engine never touches the "
+                        "request path")
+    parser.add_argument("--sloConfig", default="",
+                        help="JSON SLO overrides merged by name over the "
+                        "default set: a list (or {\"slos\": [...]}) of "
+                        "{name, sli, objective, verbs, threshold_ms, "
+                        "good, bad, page_burn, warn_burn} entries; "
+                        "{\"name\": ..., \"disabled\": true} removes a "
+                        "default.  Malformed input fails startup")
+    parser.add_argument("--sloPeriod", default="",
+                        help="SLO evaluation tick period (Go duration); "
+                        "empty = the sync period (TAS) or 5s (GAS)")
+
+
+def build_slo_engine(args, extender, cache=None, period_s: float = 5.0):
+    """The SLOEngine for --slo=on (None when off): the default SLO set
+    for this main (TAS when a telemetry cache is given, GAS otherwise)
+    merged with --sloConfig, reading the extender's recorder and — on
+    TAS — the cache's freshness signal.  Attached as ``extender.slo``
+    (the /debug/slo + /metrics + readiness wiring keys off that attr);
+    the caller starts the tick loop."""
+    if getattr(args, "slo", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.utils.slo import (
+        SLOEngine,
+        default_slos,
+        merge_config,
+    )
+
+    slos = merge_config(
+        default_slos(tas=cache is not None),
+        getattr(args, "sloConfig", ""),
+    )
+    engine = SLOEngine(
+        slos,
+        recorders=[extender.recorder],
+        freshness=cache.telemetry_freshness if cache is not None else None,
+    )
+    extender.slo = engine
+    return engine
+
+
+def slo_period(args, default_s: float) -> float:
+    """The --sloPeriod in seconds (default: the caller's sync period)."""
+    raw = getattr(args, "sloPeriod", "")
+    if not raw:
+        return default_s
+    from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+    return parse_duration(raw)
+
+
 def replica_identity(args) -> str:
     """The lease holder identity: --replicaId or hostname-pid."""
     explicit = getattr(args, "replicaId", "")

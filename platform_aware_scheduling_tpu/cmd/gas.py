@@ -54,6 +54,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_decision_flags(parser)
     common.add_forecast_flags(parser, forecast=False)
     common.add_ha_flags(parser, ha=False)
+    common.add_slo_flags(parser)
     return parser
 
 
@@ -78,6 +79,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     common.maybe_start_profiler(args.profilePort)
     watch_stop = threading.Event()
     common.start_device_watch(stop=watch_stop)
+    # SLO engine (--slo=on): GAS gets the verb-availability +
+    # gas_filter-latency defaults (no telemetry cache to judge freshness
+    # over); off builds nothing (docs/observability.md)
+    slo_engine = common.build_slo_engine(args, extender)
+    if slo_engine is not None:
+        slo_engine.start(common.slo_period(args, 5.0), stop=watch_stop)
 
     from platform_aware_scheduling_tpu.cmd.tas import build_server
     from platform_aware_scheduling_tpu.utils.duration import parse_duration

@@ -110,6 +110,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_gang_flags(parser)
     common.add_forecast_flags(parser)
     common.add_ha_flags(parser)
+    common.add_slo_flags(parser)
     return parser
 
 
@@ -323,7 +324,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # cost-analysis capture hangs off each kernel's FIRST compile, which
     # assemble's warm pass triggers — install before assembly
     common.install_cost_visibility()
-    _, _, extender, controller, _, stop = assemble(
+    cache, _, extender, controller, _, stop = assemble(
         kube_client,
         metrics_client,
         sync_period_s,
@@ -347,6 +348,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             "min_available": args.rebalanceMinAvailable,
         },
     )
+
+    # SLO engine (--slo=on; docs/observability.md "SLOs & error
+    # budgets"): judged over the extender's recorder + the cache's
+    # freshness signal, ticked on its own daemon loop; attaching it to
+    # the extender lights up /debug/slo, the pas_slo_* gauges, and the
+    # informational slo_burn readiness condition.  Off (the default)
+    # builds nothing — the wire stays byte-identical
+    slo_engine = common.build_slo_engine(args, extender, cache=cache)
+    if slo_engine is not None:
+        slo_engine.start(common.slo_period(args, sync_period_s), stop=stop)
 
     common.maybe_start_profiler(args.profilePort)
     common.start_device_watch(stop=stop)

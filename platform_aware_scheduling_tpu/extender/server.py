@@ -85,8 +85,21 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/gangs", "description": "gang reservations + lifecycle state (404 when --gang=off)"},
     {"path": "/debug/forecast", "description": "per-metric forecast fits: slopes, horizons, uncertainty bands (404 when --forecast=off)"},
     {"path": "/debug/leader", "description": "leader-election state: role, lease holder, fencing token (404 when --leaderElect is off)"},
+    {"path": "/debug/slo", "description": "SLO compliance, error budgets, and multi-window burn rates (404 when --slo=off)"},
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
 ]
+
+#: index paths that must stay readable when the async admission queue is
+#: saturated — every debug/observability endpoint (they exist to
+#: diagnose exactly that condition and never touch the device).  Derived
+#: from the index above so a new endpoint cannot be routed here but
+#: silently left queued (or unindexed) on the async front-end;
+#: /debug/profile is excluded because its bounded capture SLEEPS and the
+#: async front-end must run it off-loop (serving/http.py special-cases it).
+QUEUE_BYPASS_PATHS = frozenset(
+    entry["path"] for entry in DEBUG_ENDPOINTS
+    if entry["path"] != "/debug/profile"
+) | {"/debug", "/debug/"}
 
 
 def parse_query(path: str) -> Dict[str, str]:
@@ -410,8 +423,11 @@ class Server:
                 return HTTPResponse(status=405)
             rebalancer = getattr(self.scheduler, "rebalancer", None)
             if rebalancer is None:
+                # bytes, not a dict: a dict body renders fine through the
+                # in-process route but crashes render_response on a real
+                # socket (caught by the /debug index completeness gate)
                 return HTTPResponse.json(
-                    {"error": "rebalancer not configured"}, status=404
+                    b'{"error": "rebalancer not configured"}\n', status=404
                 )
             return HTTPResponse(
                 status=200,
@@ -465,6 +481,22 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=leadership.to_json(),
+            )
+        if bare_path == "/debug/slo":
+            # SLO compliance + burn rates (utils/slo.py); 404 when no
+            # engine is wired (--slo=off), the off-path convention
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            slo_engine = getattr(self.scheduler, "slo", None)
+            if slo_engine is None:
+                return HTTPResponse.json(
+                    b'{"error": "slo engine not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=slo_engine.to_json(),
             )
         if bare_path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of

@@ -118,6 +118,10 @@ class GASExtender:
         # pas_request_duration_seconds family (verb="workqueue_work")
         self.cache.work_queue.recorder = self.recorder
         self._rwmutex = threading.RLock()
+        # opt-in utils.slo.SLOEngine (--slo=on): judged over this
+        # extender's recorder; front-ends serve GET /debug/slo (404
+        # while None) and /metrics gains the pas_slo_* gauges
+        self.slo = None
         self._device = None
         if use_device:
             # deferred import: keeps the host layer importable without jax
@@ -128,15 +132,23 @@ class GASExtender:
     # -- verbs -----------------------------------------------------------------
 
     def metrics_text(self) -> str:
-        """The /metrics provider for this extender (utils/trace.py)."""
-        return trace.exposition(recorders=[self.recorder])
+        """The /metrics provider for this extender (utils/trace.py);
+        pas_slo_* gauges join only while an SLO engine is wired."""
+        counter_sets = [self.slo.counters] if self.slo is not None else []
+        return trace.exposition(
+            recorders=[self.recorder], counter_sets=counter_sets
+        )
 
     def readiness_conditions(self):
         """The /readyz conditions GAS contributes (utils/health.py):
         node + pod informer sync — GAS serves from its resource cache,
         so answering before the initial lists land would bind against
-        a fictional cluster."""
-        return [("informers_synced", self.cache.synced_condition)]
+        a fictional cluster — plus the informational slo_burn condition
+        while an SLO engine is wired."""
+        conditions = [("informers_synced", self.cache.synced_condition)]
+        if self.slo is not None:
+            conditions.append(("slo_burn", self.slo.readiness_condition))
+        return conditions
 
     def prioritize(self, request: HTTPRequest) -> HTTPResponse:
         # not implemented by GAS (scheduler.go:515-519)

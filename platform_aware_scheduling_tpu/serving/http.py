@@ -37,6 +37,7 @@ from platform_aware_scheduling_tpu.extender.server import (
     HTTPResponse,
     HeadParseError,
     MAX_HEAD_LENGTH,
+    QUEUE_BYPASS_PATHS,
     READ_HEADER_TIMEOUT_S,
     Server,
     WRITE_TIMEOUT_S,
@@ -79,6 +80,18 @@ class AsyncServer:
         scheduler_recorder = getattr(scheduler, "recorder", None)
         self.recorder = scheduler_recorder or LatencyRecorder()
         self.counters = CounterSet()
+        # the admission-shed counter (pas_serving_rejected_total) lives
+        # in THIS layer-local set — an SLO engine judging the scheduler's
+        # verb availability must read it, or a saturated queue shedding
+        # half the traffic would score compliance 1.0 (utils/slo.py; the
+        # mains attach the engine before building the server)
+        slo_engine = getattr(scheduler, "slo", None)
+        if (
+            slo_engine is not None
+            and hasattr(slo_engine, "counter_sets")
+            and self.counters not in slo_engine.counter_sets
+        ):
+            slo_engine.counter_sets.append(self.counters)
         trace.install_jax_hooks()
 
         if metrics_provider is not None:
@@ -98,9 +111,18 @@ class AsyncServer:
                 )
 
         else:
-            provider = trace.metrics_provider(
-                recorders=[self.recorder], counter_sets=[self.counters]
-            )
+
+            def provider() -> str:
+                # dynamic: the SLO engine may be wired after construction
+                # (assembly order, tests) and its families must appear on
+                # /metrics only while it is (utils/slo.py off-path rule)
+                sets = [self.counters]
+                slo_engine = getattr(self.scheduler, "slo", None)
+                if slo_engine is not None:
+                    sets.append(slo_engine.counters)
+                return trace.exposition(
+                    recorders=[self.recorder], counter_sets=sets
+                )
 
         # unstarted Server: routing + middleware + /metrics/health only
         self._router = Server(scheduler, metrics_provider=provider)
@@ -292,16 +314,13 @@ class AsyncServer:
                     span=span,
                 )
                 bare_path = path.partition("?")[0]
-                if bare_path in (
-                    "/metrics", "/debug", "/debug/", "/debug/traces",
-                    "/debug/decisions", "/debug/rebalance",
-                    "/debug/gangs", "/debug/forecast", "/debug/leader",
-                    "/healthz", "/readyz",
-                ):
+                if bare_path in QUEUE_BYPASS_PATHS:
                     # observability endpoints bypass the admission queue:
                     # they must stay readable precisely when the queue is
                     # saturated (the condition they exist to diagnose),
-                    # and they never touch the device
+                    # and they never touch the device.  The set derives
+                    # from the DEBUG_ENDPOINTS index (extender/server.py)
+                    # so a new debug route cannot silently queue here
                     try:
                         response = self._router.route(request)
                     except Exception as exc:

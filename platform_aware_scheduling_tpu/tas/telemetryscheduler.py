@@ -120,6 +120,15 @@ class MetricsExtender:
         # front-ends serve GET /debug/forecast (404 while this is None).
         # Off (None) keeps snapshot ranking byte-identical to before.
         self.forecaster = None
+        # opt-in utils.slo.SLOEngine, set by assembly when --slo=on: the
+        # engine reads this extender's recorder + the counter families
+        # and judges the declared SLOs over sliding windows; the
+        # front-ends serve GET /debug/slo (404 while this is None) and
+        # /metrics gains the pas_slo_* gauges.  Off (None) registers no
+        # gauges and leaves the wire byte-identical — the engine never
+        # touches the request path either way (docs/observability.md
+        # "SLOs & error budgets")
+        self.slo = None
         # opt-in tas.degraded.DegradedModeController, set by assembly:
         # when telemetry goes stale or a circuit opens, Filter fails
         # open/closed per --degradedMode and Prioritize degrades to
@@ -244,6 +253,11 @@ class MetricsExtender:
             conditions.append(
                 ("leadership", self.leadership.readiness_condition)
             )
+        if self.slo is not None:
+            # informational: always ok — a burning SLO pages an operator
+            # via pas_slo_burn_rate; yanking the replica from the Service
+            # would only burn the availability SLO faster
+            conditions.append(("slo_burn", self.slo.readiness_condition))
         return conditions
 
     def _warm_status(self):
@@ -328,9 +342,14 @@ class MetricsExtender:
 
     def metrics_text(self) -> str:
         """The /metrics provider for this extender: verb latency
-        histograms + serving counters + the process-wide path-attribution
-        and JAX compile counters (utils/trace.py exposition)."""
-        return trace.exposition(recorders=[self.recorder])
+        histograms + the process-wide path-attribution and JAX compile
+        counters (utils/trace.py exposition), plus — only while an SLO
+        engine is wired — its pas_slo_* gauges (the engine owns its own
+        CounterSet precisely so --slo=off emits nothing)."""
+        counter_sets = [self.slo.counters] if self.slo is not None else []
+        return trace.exposition(
+            recorders=[self.recorder], counter_sets=counter_sets
+        )
 
     def prioritize(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()

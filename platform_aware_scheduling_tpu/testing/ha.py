@@ -234,6 +234,7 @@ class HAHarness:
         mesh: Optional[tuple] = None,
         gang_ttl_s: float = 30.0,
         journal_name: str = "pas-ha-journal",
+        node_cap: int = 8,
     ):
         self.clock = FakeClock()
         self.plan = FaultPlan(seed=seed)
@@ -254,9 +255,18 @@ class HAHarness:
             self.mesh_nodes = self.fake.add_mesh(rows, cols)
             self.num_nodes = rows * cols
         else:
+            # ``node_cap``: allocatable pod slots per node.  The digital
+            # twin (testing/twin.py) sets it BELOW the violation
+            # threshold (cap x POD_LOAD <= THRESHOLD) so the replan's
+            # capacity constraint also bounds telemetry load — a
+            # capacity-legal plan can then never manufacture the next
+            # violating node, which is the physical model real clusters
+            # are sized to (the churn bench uses the same relation)
             for i in range(num_nodes):
                 self.fake.add_node(
-                    make_node(f"node-{i}", allocatable={"pods": "8"})
+                    make_node(
+                        f"node-{i}", allocatable={"pods": str(node_cap)}
+                    )
                 )
             for i in range(hot_pods):
                 self.fake.add_pod(

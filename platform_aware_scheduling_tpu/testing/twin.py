@@ -1,0 +1,1106 @@
+"""Cluster-scale digital twin: replayable scenario programs over the
+fully assembled scheduling stack, judged by the SLO engine
+(docs/observability.md "SLOs & error budgets"; ROADMAP item 5).
+
+The fault plan (testing/faults.py), the chaos scenario
+(benchmarks/chaos_load.ChaosScenario), the churn harness
+(benchmarks/rebalance_load.ChurnHarness) and the HA fleet (testing/ha.py)
+each proved one slice of the system on fakes.  This module generalizes
+them into ONE replayable simulator:
+
+  * :class:`TwinCluster` — an :class:`~platform_aware_scheduling_tpu.
+    testing.ha.HAHarness` fleet (N fully assembled TAS replicas: cache +
+    mirror + extender + enforcer + rebalancer + breakers + elector, one
+    shared FakeKubeClient/FakeClock/FaultPlan) grown with: pods SPREAD
+    across a configurable node count (up to 100k nodes / 1M pods — every
+    structure is dict/ring-bounded, scale is a constructor argument, not
+    a code path), a scenario-controlled per-node base-load model on top
+    of placement-derived load, synthetic verb traffic driven through the
+    REAL Prioritize/Filter handlers each tick (so the latency histograms
+    and availability counters the SLOs read are measurements, not
+    mocks), a GAS extender lane over the same fake cluster, and an
+    :class:`~platform_aware_scheduling_tpu.utils.slo.SLOEngine` ticking
+    on the same fake clock;
+  * :class:`Scenario` programs — diurnal load, deployment wave,
+    node-failure wave, metric storm, the leader-kill composite, and a
+    gang deployment wave — each builds its own twin, steps it tick by
+    tick, and renders a verdict whose checks are EXACTLY the SLO
+    engine's judgment (plus scenario-specific invariants like "zero
+    evictions while telemetry was stale");
+  * :func:`run_matrix` — the scenario matrix the bench's ``twin``
+    section reports (benchmarks/twin_load.py): every future PR's
+    BENCH_DETAIL shows the regression surface per scenario.
+
+Everything is deterministic: one fake clock, seeded fault plans, no real
+sleeping.  Heavy imports (jax via the mirror) stay lazy so this module
+remains importable without jax, like the rest of testing/.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional, Set, Tuple
+
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.testing.builders import make_pod
+from platform_aware_scheduling_tpu.testing.ha import (
+    HAHarness,
+    METRIC,
+    POD_LOAD,
+    POLICY_NAME,
+    THRESHOLD,
+)
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
+from platform_aware_scheduling_tpu.utils.slo import (
+    ALERT_PAGE,
+    SLO,
+    SLOEngine,
+    default_slos,
+)
+
+GAS_NODES = 4  # the GAS lane's GPU nodes, constant across scales
+
+
+def _prioritize_body(pod_name: str, names: List[str]) -> bytes:
+    return json.dumps(
+        {
+            "Pod": {
+                "metadata": {
+                    "name": pod_name,
+                    "namespace": "default",
+                    "labels": {"telemetry-policy": POLICY_NAME},
+                }
+            },
+            "NodeNames": names,
+        }
+    ).encode()
+
+
+def _gas_filter_body(pod_name: str, names: List[str]) -> bytes:
+    return json.dumps(
+        {
+            "Pod": {
+                "metadata": {"name": pod_name, "namespace": "default"},
+                "spec": {
+                    "containers": [
+                        {
+                            "resources": {
+                                "requests": {
+                                    "gpu.intel.com/i915": "1",
+                                    "gpu.intel.com/millicores": "100",
+                                }
+                            }
+                        }
+                    ]
+                },
+            },
+            "NodeNames": names,
+        }
+    ).encode()
+
+
+def _request(path: str, body: bytes) -> HTTPRequest:
+    return HTTPRequest(
+        method="POST",
+        path=path,
+        headers={"Content-Type": "application/json"},
+        body=body,
+    )
+
+
+class TwinCluster(HAHarness):
+    """The digital twin: an HA fleet with a scenario-controlled load
+    model, synthetic verb traffic, a GAS lane, and the SLO engine —
+    everything on the shared fake clock.
+
+    ``num_nodes``/``pods`` set the scale (pods spread round-robin);
+    ``base_load`` is the scenario's knob (published ON TOP of the
+    placement-derived pod load, so rebalancing remains visible in the
+    telemetry the way it is in production); ``fail_nodes`` models a
+    node-failure wave (telemetry source dies, pods reschedule onto
+    survivors, verb traffic stops naming the dead nodes)."""
+
+    def __init__(
+        self,
+        num_nodes: int = 16,
+        pods: Optional[int] = None,
+        replicas: int = 1,
+        period_s: float = 5.0,
+        requests_per_tick: int = 2,
+        latency_threshold_ms: float = 25.0,
+        hysteresis_cycles: int = 2,
+        max_moves: int = 8,
+        groups: int = 8,
+        gas: bool = True,
+        slo: bool = True,
+        slo_windows: Optional[Dict[str, float]] = None,
+        seed: int = 7,
+        gang: bool = False,
+        mesh: Optional[Tuple[int, int]] = None,
+        lease_duration_s: float = 15.0,
+    ):
+        super().__init__(
+            replicas=replicas,
+            num_nodes=num_nodes,
+            hot_pods=0,  # the twin spreads its own pods below
+            period_s=period_s,
+            hysteresis_cycles=hysteresis_cycles,
+            max_moves=max_moves,
+            lease_duration_s=lease_duration_s,
+            rebalance_mode="active",
+            seed=seed,
+            gang=gang,
+            mesh=mesh,
+            # capacity below the violation threshold (4 x POD_LOAD=400
+            # <= THRESHOLD=450): a capacity-legal rebalance plan can
+            # never manufacture the next violating node, so scenarios
+            # converge instead of thrashing — the sizing relation real
+            # clusters are operated under
+            node_cap=4,
+        )
+        self.requests_per_tick = requests_per_tick
+        self.base_load: Dict[str, int] = {}
+        self.failed_nodes: Set[str] = set()
+        self._pod_labels: Dict[str, Dict[str, str]] = {}
+        self._seen_evictions = 0
+        self._bodies: Optional[List[bytes]] = None
+        self.traffic = {"requests": 0, "errors": 0}
+        self.storm_evictions: Optional[int] = None
+        if not gang and pods:
+            for i in range(pods):
+                name = f"pod-{i}"
+                labels = {
+                    "telemetry-policy": POLICY_NAME,
+                    shared_labels.GROUP_LABEL: f"g-{i % groups}",
+                }
+                self._pod_labels[name] = labels
+                self.fake.add_pod(
+                    make_pod(
+                        name,
+                        labels=labels,
+                        node_name=f"node-{i % num_nodes}",
+                        phase="Running",
+                    )
+                )
+        # -- GAS lane: a small GPU pool on the same fake cluster, its
+        # informer-fed cache serving the real gas_filter verb
+        self.gas = None
+        self._gas_names: List[str] = []
+        if gas:
+            from platform_aware_scheduling_tpu.gas.cache import Cache
+            from platform_aware_scheduling_tpu.gas.scheduler import (
+                GASExtender,
+            )
+            from platform_aware_scheduling_tpu.testing.builders import (
+                make_node,
+            )
+
+            for i in range(GAS_NODES):
+                name = f"gpu-node-{i}"
+                self._gas_names.append(name)
+                self.fake.add_node(
+                    make_node(
+                        name,
+                        labels={"gpu.intel.com/cards": "card0.card1"},
+                        allocatable={
+                            "gpu.intel.com/i915": "2",
+                            "gpu.intel.com/millicores": "2000",
+                            "gpu.intel.com/memory.max": "8000000000",
+                        },
+                    )
+                )
+            gas_cache = Cache(self.fake, start=False)
+            self.gas = GASExtender(
+                self.fake, cache=gas_cache, use_device=False
+            )
+            gas_cache.start()
+            gas_cache.wait_settled()
+        # -- the SLO engine, on the same fake clock; attached to every
+        # replica's extender so any mounted front-end serves /debug/slo
+        self.engine: Optional[SLOEngine] = None
+        if slo:
+            slos = default_slos(
+                tas=True,
+                prioritize_p99_ms=latency_threshold_ms,
+                filter_p99_ms=latency_threshold_ms,
+            )
+            if self.gas is not None:
+                slos.append(
+                    SLO(
+                        name="gas_filter_p99",
+                        sli="latency",
+                        objective=0.99,
+                        description="GAS Filter latency through the twin",
+                        verbs=("gas_filter",),
+                        threshold_s=latency_threshold_ms / 1e3,
+                    )
+                )
+            recorders = [s.extender.recorder for s in self.replicas if s]
+            if self.gas is not None:
+                recorders.append(self.gas.recorder)
+            self.engine = SLOEngine(
+                slos,
+                recorders=recorders,
+                freshness=self._freshness,
+                clock=self.clock.now,
+                windows=slo_windows,
+            )
+            for stack in self.replicas:
+                if stack is not None:
+                    stack.extender.slo = self.engine
+            if self.gas is not None:
+                self.gas.slo = self.engine
+
+    # -- signal plumbing -------------------------------------------------------
+
+    def _freshness(self) -> Tuple[bool, str]:
+        """The fleet's telemetry-freshness signal: the first LIVE
+        replica's cache (the replica a Service would be routing to)."""
+        live = self.live()
+        if not live:
+            return False, "no live replicas"
+        return live[0].cache.telemetry_freshness()
+
+    def live_node_names(self) -> List[str]:
+        if self.gang:
+            return [n for n in self.mesh_nodes if n not in self.failed_nodes]
+        return [
+            f"node-{i}"
+            for i in range(self.num_nodes)
+            if f"node-{i}" not in self.failed_nodes
+        ]
+
+    def pod_counts(self, live: Optional[List[str]] = None) -> Dict[str, int]:
+        """Running pods per live node — the ONE counting rule
+        (Succeeded/Failed excluded) shared by telemetry publication,
+        eviction rebinding, and failure-wave rescheduling, so the three
+        consumers can never drift on what 'load' means."""
+        nodes = live if live is not None else self.live_node_names()
+        counts: Dict[str, int] = {n: 0 for n in nodes}
+        with self.fake._lock:
+            for raw in self.fake._pods.values():
+                if (raw.get("status") or {}).get("phase") in (
+                    "Succeeded",
+                    "Failed",
+                ):
+                    continue
+                node = (raw.get("spec") or {}).get("nodeName", "")
+                if node in counts:
+                    counts[node] += 1
+        return counts
+
+    def publish_loads(self) -> None:
+        """Scenario-aware telemetry publication: placement-derived pod
+        load + the scenario's base load, for live nodes only (a failed
+        node's telemetry source dies with it).  Gang-mode meshes publish
+        a flat zero surface so freshness stays green while reservations
+        are the scenario's subject."""
+        live = self.live_node_names()
+        if self.gang:
+            self.metrics.set_all(METRIC, {n: 0 for n in live})
+            return
+        counts = self.pod_counts(live)
+        self.metrics.set_all(
+            METRIC,
+            {
+                n: counts[n] * POD_LOAD + self.base_load.get(n, 0)
+                for n in live
+            },
+        )
+
+    # -- the tick --------------------------------------------------------------
+
+    def tick(self) -> None:
+        """One twin tick: the fleet tick (clock + telemetry + election +
+        enforcement + rebalance), then the world's reaction (evicted
+        pods reschedule), then synthetic verb traffic through the real
+        handlers, then one SLO evaluation."""
+        super().tick()
+        self._rebind_evicted()
+        self._drive_traffic()
+        if self.engine is not None:
+            self.engine.tick()
+
+    def _rebind_evicted(self) -> None:
+        """The kube-controller + scheduler stand-in: an evicted pod is
+        re-created and lands on its planned target when the leader's
+        last plan names one, else on the least-loaded live node."""
+        new = self.fake.evictions[self._seen_evictions:]
+        if not new:
+            return
+        self._seen_evictions = len(self.fake.evictions)
+        targets: Dict[str, str] = {}
+        for stack in self.live():
+            record = stack.rebalancer.status().get("last_plan") or {}
+            for move in record.get("moves", []):
+                targets[move["pod_key"]] = move["to_node"]
+        live = self.live_node_names()
+        if not live:
+            return
+        counts = self.pod_counts(live)
+        for eviction in new:
+            key = f"{eviction['namespace']}&{eviction['pod']}"
+            target = targets.get(key)
+            if target is None or target not in counts:
+                target = min(counts, key=lambda n: (counts[n], n))
+            counts[target] += 1
+            self.fake.add_pod(
+                make_pod(
+                    eviction["pod"],
+                    namespace=eviction["namespace"],
+                    labels=self._pod_labels.get(
+                        eviction["pod"],
+                        {"telemetry-policy": POLICY_NAME},
+                    ),
+                    node_name=target,
+                    phase="Running",
+                )
+            )
+
+    def _drive_traffic(self) -> None:
+        """``requests_per_tick`` Prioritize + Filter pairs through the
+        first live replica's REAL verb handlers (what a Service would
+        route), plus one gas_filter when the GAS lane is on — the
+        latency/availability numbers the SLOs judge are measured off
+        these, end to end through decode/kernel/encode."""
+        live = self.live()
+        if not live or self.gang:
+            return
+        if self._bodies is None:
+            names = self.live_node_names()
+            self._bodies = [
+                _prioritize_body(f"twin-pod-{i}", names)
+                for i in range(max(1, self.requests_per_tick))
+            ]
+        extender = live[0].extender
+        for i in range(self.requests_per_tick):
+            body = self._bodies[i % len(self._bodies)]
+            for verb, path in (
+                ("prioritize", "/scheduler/prioritize"),
+                ("filter", "/scheduler/filter"),
+            ):
+                self.traffic["requests"] += 1
+                try:
+                    response = getattr(extender, verb)(
+                        _request(path, body)
+                    )
+                    if response.status != 200:
+                        self.traffic["errors"] += 1
+                except Exception:
+                    self.traffic["errors"] += 1
+        if self.gas is not None:
+            self.traffic["requests"] += 1
+            try:
+                response = self.gas.filter(
+                    _request(
+                        "/scheduler/filter",
+                        _gas_filter_body("twin-gas-pod", self._gas_names),
+                    )
+                )
+                if response.status != 200:
+                    self.traffic["errors"] += 1
+            except Exception:
+                self.traffic["errors"] += 1
+
+    # -- scenario verbs --------------------------------------------------------
+
+    def set_base_load(self, loads: Dict[str, int]) -> None:
+        self.base_load = dict(loads)
+
+    def fail_nodes(self, names: List[str]) -> None:
+        """A node-failure wave: the named nodes' telemetry sources die
+        and their pods are rescheduled onto the least-loaded survivors
+        (the controller re-create path, like an eviction's)."""
+        self.failed_nodes.update(names)
+        self._bodies = None  # verb traffic stops naming dead nodes
+        doomed: List[Tuple[str, str, str]] = []
+        with self.fake._lock:
+            for raw in self.fake._pods.values():
+                node = (raw.get("spec") or {}).get("nodeName", "")
+                if node in self.failed_nodes:
+                    meta = raw.get("metadata") or {}
+                    doomed.append(
+                        (meta.get("namespace", "default"), meta["name"], node)
+                    )
+        counts = self.pod_counts()
+        # round-robin over survivors ordered coldest-first: O(pods), not
+        # O(pods x nodes) — a 5%-of-100k failure wave reschedules 5k
+        # pods and a per-pod min() over 95k survivors would dwarf the
+        # simulated cluster's own work
+        order = sorted(counts, key=lambda n: (counts[n], n))
+        for i, (namespace, pod, _node) in enumerate(doomed):
+            self.fake.delete_pod(namespace, pod)
+            target = order[i % len(order)]
+            self.fake.add_pod(
+                make_pod(
+                    pod,
+                    namespace=namespace,
+                    labels=self._pod_labels.get(
+                        pod, {"telemetry-policy": POLICY_NAME}
+                    ),
+                    node_name=target,
+                    phase="Running",
+                )
+            )
+
+    def restart(self, index: int):
+        """Rebuild a replica (HAHarness semantics) and re-wire it into
+        the observability plane: the fresh extender's recorder joins the
+        engine's sources and /debug/slo serves on it — without this a
+        restarted replica's traffic would be invisible to the SLOs and
+        they would pass their gates on zero judged events."""
+        stack = super().restart(index)
+        if self.engine is not None:
+            self.engine.recorders.append(stack.extender.recorder)
+            stack.extender.slo = self.engine
+        return stack
+
+    def mark_storm(self) -> None:
+        """Remember the eviction count at storm start: the suspension
+        gate asserts it never moves until recovery."""
+        self.storm_evictions = len(self.fake.evictions)
+
+    def serve(self, serving: str = "threaded"):
+        """Mount the first live replica's extender behind a REAL HTTP
+        front-end (threaded or async) on an ephemeral port — the
+        acceptance tests curl /debug/slo and /metrics while the twin
+        ticks on the fake clock.  Caller shuts the server down."""
+        extender = self.live()[0].extender
+        if serving == "async":
+            from platform_aware_scheduling_tpu.serving import AsyncServer
+
+            server = AsyncServer(extender)
+        else:
+            from platform_aware_scheduling_tpu.extender.server import Server
+
+            server = Server(extender, metrics_provider=extender.metrics_text)
+        server.start_server(
+            port="0", unsafe=True, host="127.0.0.1", block=False
+        )
+        server.wait_ready()
+        return server
+
+    def close(self) -> None:
+        if self.gas is not None:
+            self.gas.cache.stop()
+
+    # -- judgment --------------------------------------------------------------
+
+    def violating_nodes(self) -> List[str]:
+        """The leader's latest view of violating nodes (convergence
+        gates read this)."""
+        for stack in self.live():
+            record = stack.rebalancer.status().get("last_plan") or {}
+            nodes = record.get("violating_nodes")
+            if nodes is not None:
+                return list(nodes)
+        return []
+
+    def judgment(self) -> Dict[str, Dict]:
+        return self.engine.judge() if self.engine is not None else {}
+
+
+# ---------------------------------------------------------------------------
+# scenario programs
+# ---------------------------------------------------------------------------
+
+
+class Scenario:
+    """One replayable scenario program.  ``run(scale)`` builds its own
+    twin, applies the program tick by tick, and returns a verdict whose
+    ``checks`` are the SLO engine's judgment plus scenario invariants.
+    ``build``/``ticks``/``apply`` are public so tests can drive the
+    identical program manually (e.g. with a live front-end mounted)."""
+
+    name = "scenario"
+
+    def build(self, scale: Dict) -> TwinCluster:
+        return TwinCluster(**scale)
+
+    def ticks(self, scale: Dict) -> int:
+        raise NotImplementedError
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        pass
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        raise NotImplementedError
+
+    # -- shared gate helpers ---------------------------------------------------
+
+    @staticmethod
+    def _check(name: str, ok: bool, detail: str = "") -> Dict:
+        return {"check": name, "ok": bool(ok), "detail": detail}
+
+    def slo_gates(
+        self,
+        twin: TwinCluster,
+        compliant: Tuple[str, ...] = (),
+        no_page: bool = True,
+    ) -> List[Dict]:
+        """The SLO engine's judgment as verdict checks: the named SLOs
+        must meet their objective over the budget window, and (default)
+        no SLO may sit in the page tier at scenario end."""
+        judgment = twin.judgment()
+        checks: List[Dict] = []
+        for name in compliant:
+            entry = judgment.get(name) or {}
+            objective = twin.engine.slos[name].objective
+            value = entry.get("compliance")
+            checks.append(
+                self._check(
+                    f"slo:{name}",
+                    value is not None and value >= objective,
+                    f"compliance {value} vs objective {objective}",
+                )
+            )
+        if no_page:
+            paging = sorted(
+                name
+                for name, entry in judgment.items()
+                if entry.get("alert") == ALERT_PAGE
+            )
+            checks.append(
+                self._check(
+                    "slo:no_page_tier",
+                    not paging,
+                    f"paging: {paging}" if paging else "no SLO paging",
+                )
+            )
+        return checks
+
+    def run(self, scale: Optional[Dict] = None) -> Dict:
+        scale = dict(scale or {})
+        twin = self.build(scale)
+        try:
+            total = self.ticks(scale)
+            for t in range(total):
+                self.apply(twin, t)
+                twin.tick()
+            checks = self.checks(twin)
+            return {
+                "name": self.name,
+                "passed": all(c["ok"] for c in checks),
+                "ticks": total,
+                "num_nodes": twin.num_nodes,
+                "traffic": dict(twin.traffic),
+                "checks": checks,
+                "judgment": twin.judgment(),
+            }
+        finally:
+            twin.close()
+
+
+_CORE_SLOS = (
+    "verb_availability",
+    "prioritize_p99",
+    "filter_p99",
+    "telemetry_freshness",
+    "eviction_safety",
+)
+
+
+class DiurnalLoad(Scenario):
+    """A day/night load curve: every node's base load swings
+    sinusoidally (phase-shifted across the cluster) while staying under
+    the deschedule threshold.  The null hypothesis scenario: nothing
+    should page, nothing should evict, every SLO should hold."""
+
+    name = "diurnal"
+    period_ticks = 24
+
+    def ticks(self, scale: Dict) -> int:
+        return 2 * self.period_ticks
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        amplitude = max(1, THRESHOLD - POD_LOAD * 2 - 50)
+        loads = {}
+        for i, node in enumerate(twin.live_node_names()):
+            phase = 2.0 * math.pi * (
+                t / self.period_ticks + i / max(1, twin.num_nodes)
+            )
+            loads[node] = int(amplitude * 0.5 * (1.0 + math.sin(phase)))
+        twin.set_base_load(loads)
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        checks = self.slo_gates(twin, compliant=_CORE_SLOS)
+        checks.append(
+            self._check(
+                "zero_evictions",
+                len(twin.evictions()) == 0,
+                f"{len(twin.evictions())} evictions under a healthy "
+                f"sub-threshold curve",
+            )
+        )
+        return checks
+
+
+class DeploymentWave(Scenario):
+    """A deployment lands on a narrow set of nodes and its workload's
+    load ramps up underneath them, pushing them over threshold; the
+    rebalancer must move pods off the hot nodes within the scenario
+    while the serving SLOs hold."""
+
+    name = "deployment_wave"
+    wave_start = 4
+    ramp_ticks = 6
+    peak_base = 350  # + 2 pods x POD_LOAD = 550 > THRESHOLD on hot nodes
+
+    def ticks(self, scale: Dict) -> int:
+        return 36
+
+    def _hot(self, twin: TwinCluster) -> List[str]:
+        # capped at 16 landing nodes: the wave must be drainable within
+        # the scenario under the actuator's churn budget (max_moves per
+        # cycle) — an uncapped width at 100k nodes would need thousands
+        # of moves and "fail" convergence for a reason that is a knob,
+        # not a regression
+        width = min(16, max(1, twin.num_nodes // 8))
+        return [f"node-{j}" for j in range(width)]
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.wave_start:
+            # the deployment: one new pod per landing node
+            for j, node in enumerate(self._hot(twin)):
+                name = f"wave-{j}"
+                labels = {
+                    "telemetry-policy": POLICY_NAME,
+                    shared_labels.GROUP_LABEL: f"wave-{j}",
+                }
+                twin._pod_labels[name] = labels
+                twin.fake.add_pod(
+                    make_pod(
+                        name, labels=labels, node_name=node, phase="Running"
+                    )
+                )
+        if t >= self.wave_start:
+            # its workload ramps to steady state over ramp_ticks
+            ramp = min(1.0, (t - self.wave_start + 1) / self.ramp_ticks)
+            twin.set_base_load(
+                {node: int(self.peak_base * ramp) for node in self._hot(twin)}
+            )
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        checks = self.slo_gates(twin, compliant=_CORE_SLOS)
+        residual = twin.violating_nodes()
+        checks.append(
+            self._check(
+                "wave_converged",
+                not residual,
+                f"violating nodes at end: {residual}",
+            )
+        )
+        checks.append(
+            self._check(
+                "rebalancer_engaged",
+                len(twin.evictions()) > 0,
+                f"{len(twin.evictions())} evictions spread the wave",
+            )
+        )
+        return checks
+
+
+class NodeFailureWave(Scenario):
+    """A rack dies: a slice of nodes stops reporting telemetry and its
+    pods reschedule onto the survivors.  The survivors absorb the load
+    (rebalancing if pushed over threshold) and the serving SLOs hold —
+    a dead rack is capacity loss, not a scheduler outage."""
+
+    name = "node_failure_wave"
+    fail_at = 8
+
+    def ticks(self, scale: Dict) -> int:
+        return 36
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.fail_at:
+            width = max(1, twin.num_nodes // 20)
+            doomed = [
+                f"node-{twin.num_nodes - 1 - i}" for i in range(width)
+            ]
+            twin.fail_nodes(doomed)
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        checks = self.slo_gates(twin, compliant=_CORE_SLOS)
+        residual = twin.violating_nodes()
+        checks.append(
+            self._check(
+                "absorbed_failures",
+                not residual,
+                f"violating nodes at end: {residual}",
+            )
+        )
+        orphaned = 0
+        with twin.fake._lock:
+            for raw in twin.fake._pods.values():
+                node = (raw.get("spec") or {}).get("nodeName", "")
+                if node in twin.failed_nodes:
+                    orphaned += 1
+        checks.append(
+            self._check(
+                "no_orphaned_pods",
+                orphaned == 0,
+                f"{orphaned} pods still bound to failed nodes",
+            )
+        )
+        return checks
+
+
+class MetricStorm(Scenario):
+    """The acceptance scenario: the metrics API hard-fails for a
+    stretch.  Telemetry goes stale, the freshness SLO burns through the
+    page tier (breach counted, /debug/slo names it), evictions stay
+    suspended for the whole storm, and after the API recovers the fast
+    windows drain, the page clears, and the error budget ledger shows
+    exactly the storm's seconds — consistent to the fake clock."""
+
+    name = "metric_storm"
+    healthy_ticks = 6
+    storm_ticks = 8
+
+    def ticks(self, scale: Dict) -> int:
+        # enough post-storm ticks to drain the 5m page window: the page
+        # must CLEAR, not just fire
+        twin_period = float(scale.get("period_s", 5.0))
+        drain = int(300.0 / twin_period) + 4
+        return self.healthy_ticks + self.storm_ticks + drain
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.healthy_ticks:
+            twin.mark_storm()
+            twin.plan.outage("get_node_metric", status=503)
+        if t == self.healthy_ticks + self.storm_ticks:
+            twin.plan.clear("get_node_metric")
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        judgment = twin.judgment()
+        fresh = judgment.get("telemetry_freshness") or {}
+        breaches = fresh.get("breaches") or {}
+        checks = [
+            self._check(
+                "freshness_paged",
+                breaches.get("page", 0) == 1,
+                f"page breaches {breaches.get('page')} (exactly one "
+                f"storm, exactly one page entry)",
+            ),
+            self._check(
+                # the page tier must CLEAR once the fast 5m window drains;
+                # the slow 6h/3d warn tier legitimately stays open — the
+                # storm really did eat a chunk of the long-window budget
+                "page_recovered",
+                fresh.get("alert") != ALERT_PAGE,
+                f"final alert {fresh.get('alert')!r} (warn acceptable: the "
+                f"slow windows still remember the storm)",
+            ),
+        ]
+        # eviction suspension: the count at storm start never moved
+        # while telemetry was stale (the degraded controller's HARD
+        # invariant, observed through the twin)
+        checks.append(
+            self._check(
+                "evictions_suspended_in_storm",
+                twin.storm_evictions is not None
+                and len(twin.evictions()) == twin.storm_evictions,
+                f"evictions {twin.storm_evictions} -> "
+                f"{len(twin.evictions())}",
+            )
+        )
+        # budget ledger consistency, on the fake clock: bad seconds ==
+        # storm wall time, within the staleness-detection lag (the
+        # freshness bound) and one recovery tick
+        state = None
+        if twin.engine is not None:
+            for row in twin.engine.snapshot()["slos"]:
+                if row["name"] == "telemetry_freshness":
+                    state = row
+        if state is None:
+            checks.append(self._check("budget_ledger", False, "no slo row"))
+        else:
+            bad_s = state["cumulative"]["total"] - state["cumulative"]["good"]
+            storm_s = self.storm_ticks * twin.period_s
+            bound_s = 3.0 * twin.period_s  # the cache freshness bound
+            ok = (
+                storm_s - bound_s - twin.period_s
+                <= bad_s
+                <= storm_s + 2 * twin.period_s
+            )
+            checks.append(
+                self._check(
+                    "budget_ledger",
+                    ok,
+                    f"{bad_s:.1f}s of staleness for a {storm_s:.0f}s storm "
+                    f"(detection lag {bound_s:.0f}s)",
+                )
+            )
+            checks.append(
+                self._check(
+                    "budget_spent",
+                    state["error_budget_remaining"] < 1.0,
+                    f"error budget remaining "
+                    f"{state['error_budget_remaining']}",
+                )
+            )
+        # the serving SLOs must have stayed healthy THROUGH the storm —
+        # degraded mode exists so staleness never becomes unavailability
+        checks += self.slo_gates(
+            twin,
+            compliant=("verb_availability", "prioritize_p99", "filter_p99"),
+            no_page=False,
+        )
+        return checks
+
+
+class LeaderKillComposite(Scenario):
+    """The composite: a 3-replica fleet takes a diurnal curve AND loses
+    its leader mid-run.  Failover happens within the lease duration,
+    no eviction is duplicated, and the serving SLOs never notice."""
+
+    name = "leader_kill"
+    kill_at = 6
+
+    def build(self, scale: Dict) -> TwinCluster:
+        scale = dict(scale)
+        scale["replicas"] = 3
+        return TwinCluster(**scale)
+
+    def ticks(self, scale: Dict) -> int:
+        return 24
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.kill_at:
+            leader = next(
+                (
+                    i
+                    for i, s in enumerate(twin.replicas)
+                    if s is not None and s.is_leader()
+                ),
+                0,
+            )
+            twin.crash(leader)
+            self.killed_tick = t
+        # a gentle diurnal curve keeps the telemetry moving
+        loads = {
+            node: 50 + 20 * ((t + i) % 5)
+            for i, node in enumerate(twin.live_node_names())
+        }
+        twin.set_base_load(loads)
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        checks = self.slo_gates(
+            twin,
+            compliant=(
+                "verb_availability",
+                "prioritize_p99",
+                "filter_p99",
+                "eviction_safety",
+            ),
+        )
+        lease_ticks = int(twin.lease_duration_s / twin.period_s) + 1
+        checks.append(
+            self._check(
+                "failover_within_lease",
+                len(twin.leaders()) == 1,
+                f"leaders at end: {twin.leaders()} (lease bound "
+                f"{lease_ticks} ticks)",
+            )
+        )
+        duplicates = twin.duplicate_evictions()
+        checks.append(
+            self._check(
+                "zero_duplicate_evictions",
+                not duplicates,
+                f"duplicates: {duplicates}",
+            )
+        )
+        return checks
+
+
+class GangWave(Scenario):
+    """A gang deployment wave on a TPU mesh: two competing multi-host
+    gangs arrive interleaved and must BOTH land as valid contiguous
+    slices (the all-or-nothing invariant) while the twin's SLO engine
+    watches the verbs that placed them."""
+
+    name = "gang_wave"
+    rows, cols = 4, 4
+    gang_rows, gang_cols = 2, 4
+
+    def build(self, scale: Dict) -> TwinCluster:
+        scale = dict(scale)
+        # the mesh IS the scale for this scenario; the matrix's node
+        # count does not apply (a 100k-node mesh reserve is the gang
+        # bench's subject, benchmarks/gang_load.py)
+        scale.pop("num_nodes", None)
+        scale.pop("pods", None)
+        twin = TwinCluster(
+            num_nodes=self.rows * self.cols,
+            gang=True,
+            mesh=(self.rows, self.cols),
+            gas=False,
+            **scale,
+        )
+        size = self.gang_rows * self.gang_cols
+        topo = f"{self.gang_rows}x{self.gang_cols}"
+        self.pending = []
+        for i in range(size):  # strict interleave: a0 b0 a1 b1 ...
+            for group in ("gang-a", "gang-b"):
+                self.pending.append(self._pod_obj(
+                    f"{group}-{i}", group, size, topo
+                ))
+        self.available = list(twin.mesh_nodes)
+        self.bound: Dict[str, List[str]] = {"gang-a": [], "gang-b": []}
+        return twin
+
+    @staticmethod
+    def _pod_obj(name: str, group: str, size: int, topo: str) -> Dict:
+        return {
+            "metadata": {
+                "name": name,
+                "namespace": "default",
+                "labels": {
+                    "telemetry-policy": POLICY_NAME,
+                    shared_labels.GROUP_LABEL: group,
+                    shared_labels.GANG_SIZE_LABEL: str(size),
+                    shared_labels.GANG_TOPOLOGY_LABEL: topo,
+                },
+            }
+        }
+
+    def ticks(self, scale: Dict) -> int:
+        return 12
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        """One admission round per tick: every still-pending member
+        tries Filter -> Prioritize -> Bind through the real verbs."""
+        extender = twin.live()[0].extender
+        progressed = []
+        for pod_obj in self.pending:
+            response = extender.filter(
+                _request(
+                    "/scheduler/filter",
+                    json.dumps(
+                        {"Pod": pod_obj, "NodeNames": self.available}
+                    ).encode(),
+                )
+            )
+            twin.traffic["requests"] += 1
+            if response.status != 200:
+                twin.traffic["errors"] += 1
+                continue
+            passing = list(
+                json.loads(response.body).get("NodeNames") or []
+            )
+            if not passing:
+                continue
+            ranked = json.loads(
+                extender.prioritize(
+                    _request(
+                        "/scheduler/prioritize",
+                        json.dumps(
+                            {"Pod": pod_obj, "NodeNames": passing}
+                        ).encode(),
+                    )
+                ).body
+                or b"[]"
+            )
+            node = (
+                max(ranked, key=lambda e: e["Score"])["Host"]
+                if ranked
+                else passing[0]
+            )
+            extender.bind(
+                _request(
+                    "/scheduler/bind",
+                    json.dumps(
+                        {
+                            "PodName": pod_obj["metadata"]["name"],
+                            "PodNamespace": "default",
+                            "PodUID": "uid",
+                            "Node": node,
+                        }
+                    ).encode(),
+                )
+            )
+            self.available.remove(node)
+            group = pod_obj["metadata"]["labels"][shared_labels.GROUP_LABEL]
+            self.bound[group].append(node)
+            progressed.append(pod_obj)
+        self.pending = [p for p in self.pending if p not in progressed]
+
+    def _forms_slice(self, twin: TwinCluster, nodes: List[str]) -> bool:
+        from platform_aware_scheduling_tpu.ops import topology
+
+        mesh = topology.MeshView(twin.fake.list_nodes())
+        mask = mesh.free_mask(nodes)
+        if int(mask.sum()) != self.gang_rows * self.gang_cols:
+            return False
+        for h, w in {
+            (self.gang_rows, self.gang_cols),
+            (self.gang_cols, self.gang_rows),
+        }:
+            if topology.topology_feasibility_host(mask, h, w).anchor_ok.any():
+                return True
+        return False
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        checks = []
+        size = self.gang_rows * self.gang_cols
+        for group, nodes in sorted(self.bound.items()):
+            checks.append(
+                self._check(
+                    f"{group}_admitted_as_slice",
+                    len(nodes) == size and self._forms_slice(twin, nodes),
+                    f"{len(nodes)}/{size} bound, contiguous="
+                    f"{self._forms_slice(twin, nodes)}",
+                )
+            )
+        checks.append(
+            self._check(
+                "zero_deadlock",
+                not self.pending,
+                f"{len(self.pending)} members unplaced",
+            )
+        )
+        return checks
+
+
+DEFAULT_SCENARIOS: Tuple[Scenario, ...] = (
+    DiurnalLoad(),
+    DeploymentWave(),
+    NodeFailureWave(),
+    MetricStorm(),
+    LeaderKillComposite(),
+    GangWave(),
+)
+
+
+def run_matrix(
+    num_nodes: int = 64,
+    pods: Optional[int] = None,
+    period_s: float = 5.0,
+    requests_per_tick: int = 2,
+    latency_threshold_ms: float = 25.0,
+    scenarios: Tuple[Scenario, ...] = DEFAULT_SCENARIOS,
+) -> Dict:
+    """Run every scenario at the given scale; the bench's ``twin``
+    section (benchmarks/twin_load.py) reports this matrix.  Fresh
+    scenario INSTANCES per run — scenario objects carry per-run state."""
+    scale = {
+        "num_nodes": num_nodes,
+        "pods": pods if pods is not None else num_nodes,
+        "period_s": period_s,
+        "requests_per_tick": requests_per_tick,
+        "latency_threshold_ms": latency_threshold_ms,
+    }
+    results = {}
+    for scenario in scenarios:
+        fresh = type(scenario)()
+        results[fresh.name] = fresh.run(scale)
+    return {
+        "num_nodes": num_nodes,
+        "pods": scale["pods"],
+        "period_s": period_s,
+        "scenarios": results,
+        "all_passed": all(r["passed"] for r in results.values()),
+    }

@@ -1,0 +1,750 @@
+"""Service-level objectives over the trace substrate: declarative SLIs,
+sliding windows, and Google-SRE multi-window multi-burn-rate alerting
+(docs/observability.md "SLOs & error budgets").
+
+The scheduler schedules on live telemetry but — like the reference PAS
+suite, which publishes no performance numbers at all — had no way to say
+whether IT is meeting its own service objectives.  This module closes
+that loop without touching the request path: the engine reads the
+metrics the process already emits (``LatencyRecorder`` histograms,
+``trace.COUNTERS`` families, the telemetry cache's freshness signal),
+snapshots them on a clock-driven tick, and judges each declared SLO over
+sliding windows.
+
+SLI kinds (:class:`SLO`):
+
+  * ``latency`` — fraction of requests at or under ``threshold_s``,
+    computed from histogram-bucket deltas with within-bucket
+    interpolation (utils/tracing.bucket_count_below — the reason the
+    bucket ladder grew sub-millisecond bounds);
+  * ``availability`` — served requests (histogram counts for the listed
+    verbs) against shed/errored ones (the listed ``bad`` counters, e.g.
+    ``pas_serving_rejected_total``);
+  * ``counter_ratio`` — good/bad drawn from arbitrary declared counter
+    families (the eviction-safety SLO: refused/failed eviction attempts
+    against executed moves, from ``pas_rebalance_*``);
+  * ``freshness`` — TIME-weighted: each tick contributes its wall-clock
+    span to ``total`` and, when the freshness provider reports fresh, to
+    ``good`` — so the error budget is literally seconds of staleness,
+    consistent to whatever clock drives the engine (the digital twin
+    drives it with a fake one, testing/twin.py).
+
+Burn rate = (bad fraction over a window) / (1 - objective): 1.0 means
+spending the error budget exactly at the rate that exhausts it at the
+window's end.  Alerting follows the SRE workbook's multi-window
+multi-burn-rate shape: PAGE when both the fast windows (5m AND 1h) burn
+at >= ``page_burn`` (default 14.4 — 2%% of a 30-day budget in one hour);
+WARN when both slow windows (6h AND 3d) burn at >= ``warn_burn``
+(default 1.0).  The short window is what lets an alert CLEAR promptly
+after recovery; the long window is what keeps a slow steady bleed from
+hiding below the paging threshold.  Transitions INTO a tier increment
+``pas_slo_breaches_total{slo=,tier=}`` once (edge-triggered).
+
+Exposition rides the engine's own CounterSet — merged into /metrics only
+where an engine is actually wired — so ``--slo=off`` (the default)
+registers ZERO new gauges and leaves the wire byte-identical, the
+repo's off-path convention.  Surfaces: ``pas_slo_compliance{slo=}``,
+``pas_slo_error_budget_remaining{slo=}``,
+``pas_slo_burn_rate{slo=,window=}``, ``pas_slo_breaches_total``,
+``GET /debug/slo`` on both front-ends, and an INFORMATIONAL ``slo_burn``
+readiness condition (a burning SLO must page an operator, not yank the
+pod from the Service and make the availability SLO worse).
+
+This module must stay importable without jax (the host layer's rule).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils.tracing import (
+    CounterSet,
+    LatencyRecorder,
+    _BUCKETS,
+    bucket_count_below,
+    quantile_from_buckets,
+)
+
+# ---------------------------------------------------------------------------
+# windows and tiers
+# ---------------------------------------------------------------------------
+
+#: the sliding windows every SLO is judged over, in seconds.  The 5m/1h
+#: pair is the page tier's fast signal, 6h/3d the warn tier's slow one;
+#: 3d doubles as the BUDGET window (compliance + error-budget-remaining).
+WINDOWS: Dict[str, float] = {
+    "5m": 300.0,
+    "1h": 3_600.0,
+    "6h": 21_600.0,
+    "3d": 259_200.0,
+}
+
+PAGE_WINDOWS: Tuple[str, str] = ("5m", "1h")
+WARN_WINDOWS: Tuple[str, str] = ("6h", "3d")
+BUDGET_WINDOW = "3d"
+
+ALERT_OK = "ok"
+ALERT_WARN = "warn"
+ALERT_PAGE = "page"
+
+SLI_KINDS = ("availability", "latency", "counter_ratio", "freshness")
+
+
+# ---------------------------------------------------------------------------
+# declarations
+# ---------------------------------------------------------------------------
+
+
+def _counter_specs(raw) -> Tuple[Tuple[str, Optional[Tuple]], ...]:
+    """Normalize counter specs: each entry is a bare family name or
+    ``{"name": ..., "labels": {...}}``; stored as hashable tuples."""
+    specs = []
+    for entry in raw or ():
+        if isinstance(entry, str):
+            specs.append((entry, None))
+        elif isinstance(entry, dict) and "name" in entry:
+            labels = entry.get("labels") or None
+            key = tuple(sorted(labels.items())) if labels else None
+            specs.append((str(entry["name"]), key))
+        else:
+            raise ValueError(f"bad counter spec {entry!r}")
+    return tuple(specs)
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One declarative service-level objective.
+
+    ``objective`` is the good-event fraction to hold (0 < objective < 1);
+    ``sli`` selects the measurement (see module docstring).  Latency and
+    availability SLOs name histogram ``verbs``; latency adds
+    ``threshold_s``; availability and counter_ratio name counter
+    families via ``good``/``bad`` specs (counter_ratio's total is
+    good + bad; availability's is verb counts + bad)."""
+
+    name: str
+    sli: str
+    objective: float
+    description: str = ""
+    verbs: Tuple[str, ...] = ()
+    threshold_s: float = 0.0
+    good: Tuple = ()
+    bad: Tuple = ()
+    page_burn: float = 14.4
+    warn_burn: float = 1.0
+
+    def __post_init__(self):
+        if self.sli not in SLI_KINDS:
+            raise ValueError(f"slo {self.name!r}: unknown sli {self.sli!r}")
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError(
+                f"slo {self.name!r}: objective must be in (0, 1), got "
+                f"{self.objective!r}"
+            )
+        if self.sli == "latency":
+            if not self.verbs or self.threshold_s <= 0:
+                raise ValueError(
+                    f"slo {self.name!r}: latency sli needs verbs and a "
+                    f"positive threshold_s"
+                )
+        if self.sli == "availability" and not self.verbs:
+            raise ValueError(
+                f"slo {self.name!r}: availability sli needs verbs"
+            )
+        if self.sli == "counter_ratio" and not (self.good or self.bad):
+            raise ValueError(
+                f"slo {self.name!r}: counter_ratio sli needs good and/or "
+                f"bad counter specs"
+            )
+
+
+def slo_from_dict(obj: Dict) -> SLO:
+    """An :class:`SLO` from one ``--sloConfig`` JSON entry.  Latency
+    thresholds are spelled ``threshold_ms`` on the wire (operators think
+    in milliseconds); unknown keys are rejected so a typo cannot
+    silently weaken an objective."""
+    known = {
+        "name", "sli", "objective", "description", "verbs", "threshold_ms",
+        "good", "bad", "page_burn", "warn_burn", "disabled",
+    }
+    unknown = sorted(set(obj) - known)
+    if unknown:
+        raise ValueError(f"slo config: unknown keys {unknown}")
+    for required in ("name", "objective"):
+        if required not in obj:
+            raise ValueError(
+                f"slo config entry {obj.get('name', obj)!r}: missing "
+                f"required key {required!r}"
+            )
+    return SLO(
+        name=str(obj["name"]),
+        sli=str(obj.get("sli", "counter_ratio")),
+        objective=float(obj["objective"]),
+        description=str(obj.get("description", "")),
+        verbs=tuple(obj.get("verbs") or ()),
+        threshold_s=float(obj.get("threshold_ms", 0.0)) / 1e3,
+        good=_counter_specs(obj.get("good")),
+        bad=_counter_specs(obj.get("bad")),
+        page_burn=float(obj.get("page_burn", 14.4)),
+        warn_burn=float(obj.get("warn_burn", 1.0)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+class _WindowRing:
+    """Spaced snapshots covering one sliding window.
+
+    Appends are thinned to at most ``slots`` entries per window span
+    (one every ``window_s / slots`` seconds), so a 3-day window at a
+    5-second tick keeps ~64 snapshots, not 50k.  Lookup returns the
+    newest snapshot at or before the target time — or the OLDEST held
+    one when the ring does not reach back that far yet (early in the
+    process's life every window measures "since start")."""
+
+    __slots__ = ("window_s", "_min_gap", "_entries")
+
+    def __init__(self, window_s: float, slots: int = 64):
+        self.window_s = float(window_s)
+        self._min_gap = self.window_s / max(1, slots)
+        self._entries: List[Tuple[float, Dict]] = []
+
+    def append(self, t: float, snapshot: Dict) -> None:
+        if self._entries and t - self._entries[-1][0] < self._min_gap:
+            return
+        self._entries.append((t, snapshot))
+        # prune anything older than one window + one gap of slack: the
+        # lookup target never reaches further back
+        horizon = t - self.window_s - self._min_gap
+        while len(self._entries) > 1 and self._entries[1][0] <= horizon:
+            self._entries.pop(0)
+
+    def lookup(self, target_t: float) -> Optional[Tuple[float, Dict]]:
+        best = None
+        for entry in self._entries:
+            if entry[0] <= target_t:
+                best = entry
+            else:
+                break
+        if best is None and self._entries:
+            best = self._entries[0]
+        return best
+
+
+@dataclass
+class _Measurement:
+    """One SLO's cumulative raw state at a point in time."""
+
+    good: float = 0.0
+    total: float = 0.0
+    # latency SLIs carry the merged cumulative bucket array so windowed
+    # p99 estimates (quantile over bucket DELTAS) stay possible
+    buckets: Optional[List[float]] = None
+
+
+@dataclass
+class _State:
+    """One SLO's mutable evaluation state.  The warn and page tiers are
+    INDEPENDENT alerts (each pair of windows is its own condition, as in
+    the SRE workbook); ``alert`` reports the most severe active one."""
+
+    alert: str = ALERT_OK
+    warn_active: bool = False
+    page_active: bool = False
+    breaches: Dict[str, int] = field(
+        default_factory=lambda: {ALERT_WARN: 0, ALERT_PAGE: 0}
+    )
+    last: Optional[Dict] = None  # last evaluation, for /debug/slo
+
+
+class SLOEngine:
+    """Evaluates declared SLOs over sliding windows on an injectable
+    clock.  ``tick()`` is the only mutation: production runs it on a
+    daemon loop (:meth:`start`); the digital twin and the tests call it
+    directly with a fake clock.  Reading the sources is lock-free on
+    their side (recorder snapshots, counter reads); the engine's own
+    state is guarded by one lock."""
+
+    def __init__(
+        self,
+        slos: Iterable[SLO],
+        recorders: Iterable[LatencyRecorder] = (),
+        counter_sets: Iterable[CounterSet] = (),
+        freshness: Optional[Callable[[], Tuple[bool, str]]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        windows: Optional[Dict[str, float]] = None,
+        window_slots: int = 64,
+    ):
+        self.slos: Dict[str, SLO] = {}
+        for slo in slos:
+            if slo.name in self.slos:
+                raise ValueError(f"duplicate slo {slo.name!r}")
+            self.slos[slo.name] = slo
+        self.recorders = list(recorders)
+        # counter sources: the process-wide COUNTERS (rebalance, serving
+        # and path-attribution families live there) plus any layer-local
+        # sets the caller wires in (the async dispatcher's)
+        self.counter_sets = [trace.COUNTERS] + list(counter_sets)
+        self.freshness = freshness
+        self.clock = clock
+        self.windows = dict(windows or WINDOWS)
+        missing = sorted(
+            (set(PAGE_WINDOWS) | set(WARN_WINDOWS)) - set(self.windows)
+        )
+        if missing:
+            raise ValueError(
+                f"windows must include the alert tiers' labels; missing "
+                f"{missing}"
+            )
+        #: the engine's OWN exposition surface: merged into /metrics only
+        #: where an engine is wired, so --slo=off emits nothing
+        self.counters = CounterSet()
+        self._lock = threading.Lock()
+        self._states: Dict[str, _State] = {
+            name: _State() for name in self.slos
+        }
+        self._rings: Dict[str, _WindowRing] = {
+            label: _WindowRing(seconds, slots=window_slots)
+            for label, seconds in self.windows.items()
+        }
+        self._budget_window = max(self.windows, key=self.windows.get)
+        # freshness accounting (time-weighted): cumulative good/total
+        # seconds, advanced per tick from the engine's clock
+        self._fresh_good_s = 0.0
+        self._fresh_total_s = 0.0
+        self._last_tick_t: Optional[float] = None
+        self._ticks = 0
+
+    # -- measurement -----------------------------------------------------------
+
+    def _read_counter(self, spec: Tuple[str, Optional[Tuple]]) -> float:
+        name, label_key = spec
+        labels = dict(label_key) if label_key else None
+        value = 0.0
+        for cs in self.counter_sets:
+            value += cs.get(name, kind="counter", labels=labels)
+        return value
+
+    @staticmethod
+    def _verb_histograms(
+        verbs: Tuple[str, ...], recorder_snaps: List[Dict]
+    ) -> Tuple[float, List[float]]:
+        """(total count, merged cumulative bucket array) across every
+        recorder snapshot for the listed verb labels.  Snapshots are
+        taken ONCE per tick (each copies every verb's buckets under the
+        recorder lock the hot path's observe() contends on) and shared
+        by all histogram-reading SLOs."""
+        total = 0.0
+        merged = [0.0] * (len(_BUCKETS) + 1)
+        for snap in recorder_snaps:
+            for verb in verbs:
+                entry = snap.get(verb)
+                if entry is None:
+                    continue
+                buckets, count, _sum = entry
+                total += count
+                for i, n in enumerate(buckets):
+                    merged[i] += n
+        return total, merged
+
+    def _measure(
+        self, slo: SLO, recorder_snaps: List[Dict]
+    ) -> _Measurement:
+        """The SLO's CUMULATIVE raw good/total state right now.  Windowed
+        rates come from deltas between two of these, so pre-existing
+        counter values (a long-lived process, another test's traffic)
+        cancel out."""
+        if slo.sli == "latency":
+            total, buckets = self._verb_histograms(slo.verbs, recorder_snaps)
+            good = bucket_count_below(buckets, slo.threshold_s)
+            return _Measurement(good=good, total=total, buckets=buckets)
+        if slo.sli == "availability":
+            served, _ = self._verb_histograms(slo.verbs, recorder_snaps)
+            bad = sum(self._read_counter(s) for s in slo.bad)
+            return _Measurement(good=served, total=served + bad)
+        if slo.sli == "counter_ratio":
+            good = sum(self._read_counter(s) for s in slo.good)
+            bad = sum(self._read_counter(s) for s in slo.bad)
+            return _Measurement(good=good, total=good + bad)
+        # freshness: the engine's own time-weighted accumulators
+        return _Measurement(
+            good=self._fresh_good_s, total=self._fresh_total_s
+        )
+
+    # -- evaluation ------------------------------------------------------------
+
+    @staticmethod
+    def _window_rate(
+        now_m: _Measurement, then_m: Optional[_Measurement]
+    ) -> Tuple[float, float, float]:
+        """(good delta, total delta, bad fraction) between two cumulative
+        measurements; no events in the window means no errors (bad
+        fraction 0 — an idle service is not violating its SLO)."""
+        then_good = then_m.good if then_m is not None else 0.0
+        then_total = then_m.total if then_m is not None else 0.0
+        good_d = max(0.0, now_m.good - then_good)
+        total_d = max(0.0, now_m.total - then_total)
+        if total_d <= 0.0:
+            return good_d, total_d, 0.0
+        bad_frac = min(1.0, max(0.0, (total_d - good_d) / total_d))
+        return good_d, total_d, bad_frac
+
+    def tick(self) -> Dict[str, Dict]:
+        """One evaluation pass: measure every SLO, append to the window
+        rings, compute burn rates, update gauges and alert states.
+        Returns {slo: evaluation dict} (the /debug/slo payload rows)."""
+        with self._lock:
+            now = self.clock()
+            # advance the time-weighted freshness accumulators first so
+            # this tick's measurement sees the span just elapsed
+            if self.freshness is not None and self._last_tick_t is not None:
+                dt = max(0.0, now - self._last_tick_t)
+                fresh = False
+                try:
+                    result = self.freshness()
+                    fresh = bool(
+                        result[0] if isinstance(result, tuple) else result
+                    )
+                except Exception:
+                    fresh = False  # an unreadable signal is not fresh
+                self._fresh_total_s += dt
+                if fresh:
+                    self._fresh_good_s += dt
+            self._last_tick_t = now
+            self._ticks += 1
+
+            recorder_snaps = [r.snapshot() for r in self.recorders]
+            snapshot = {
+                name: self._measure(slo, recorder_snaps)
+                for name, slo in self.slos.items()
+            }
+            results: Dict[str, Dict] = {}
+            for name, slo in self.slos.items():
+                results[name] = self._evaluate(slo, now, snapshot[name])
+            # append AFTER evaluating: the window lookup must never see
+            # this very tick as its own "then" point
+            for ring in self._rings.values():
+                ring.append(now, snapshot)
+            return results
+
+    def _evaluate(self, slo: SLO, now: float, now_m: _Measurement) -> Dict:
+        burn: Dict[str, float] = {}
+        deltas: Dict[str, Tuple[float, float]] = {}
+        p99_s: Optional[float] = None
+        budget_slack = 1.0 - slo.objective
+        for label, ring in self._rings.items():
+            then = ring.lookup(now - ring.window_s)
+            if then is None:
+                # first tick: no baseline snapshot yet.  Measuring "since
+                # zero" would sweep in whatever cumulative history the
+                # process-wide counters carried before this engine
+                # existed — no window data means no judged events
+                good_d = total_d = bad_frac = 0.0
+                then_m = None
+            else:
+                then_m = then[1].get(slo.name)
+                good_d, total_d, bad_frac = self._window_rate(now_m, then_m)
+            burn[label] = bad_frac / budget_slack
+            deltas[label] = (good_d, total_d)
+            if (
+                slo.sli == "latency"
+                and label == self._budget_window
+                and now_m.buckets is not None
+            ):
+                then_buckets = (
+                    then_m.buckets
+                    if then_m is not None and then_m.buckets is not None
+                    else [0.0] * len(now_m.buckets)
+                )
+                window_buckets = [
+                    max(0.0, a - b)
+                    for a, b in zip(now_m.buckets, then_buckets)
+                ]
+                p99_s = quantile_from_buckets(window_buckets, 0.99)
+
+        good_d, total_d = deltas[self._budget_window]
+        compliance = (good_d / total_d) if total_d > 0 else 1.0
+        budget_remaining = 1.0 - burn[self._budget_window]
+
+        warn_now = all(burn[w] >= slo.warn_burn for w in WARN_WINDOWS)
+        page_now = all(burn[w] >= slo.page_burn for w in PAGE_WINDOWS)
+
+        state = self._states[slo.name]
+        # the tiers are independent alerts: each counts its own rising
+        # edge, so a page that de-escalates into a still-burning warn
+        # does not hide the warn episode from breach-counter consumers
+        for tier, now_active, was_active in (
+            (ALERT_WARN, warn_now, state.warn_active),
+            (ALERT_PAGE, page_now, state.page_active),
+        ):
+            if now_active and not was_active:
+                state.breaches[tier] += 1
+                self.counters.inc(
+                    "pas_slo_breaches_total",
+                    labels={"slo": slo.name, "tier": tier},
+                )
+                klog.v(1).info_s(
+                    f"SLO {slo.name} entered {tier} (burn "
+                    f"{', '.join(f'{w}={burn[w]:.1f}' for w in burn)})",
+                    component="slo",
+                )
+        state.warn_active = warn_now
+        state.page_active = page_now
+        alert = (
+            ALERT_PAGE if page_now
+            else ALERT_WARN if warn_now
+            else ALERT_OK
+        )
+        state.alert = alert
+
+        labels = {"slo": slo.name}
+        self.counters.set_gauge(
+            "pas_slo_compliance", round(compliance, 6), labels=labels
+        )
+        self.counters.set_gauge(
+            "pas_slo_error_budget_remaining",
+            round(budget_remaining, 6),
+            labels=labels,
+        )
+        for label, rate in burn.items():
+            self.counters.set_gauge(
+                "pas_slo_burn_rate",
+                round(rate, 6),
+                labels={"slo": slo.name, "window": label},
+            )
+
+        evaluation = {
+            "name": slo.name,
+            "sli": slo.sli,
+            "objective": slo.objective,
+            "description": slo.description,
+            "compliance": round(compliance, 6),
+            "error_budget_remaining": round(budget_remaining, 6),
+            "burn_rate": {w: round(r, 6) for w, r in burn.items()},
+            "alert": alert,
+            "breaches": dict(state.breaches),
+            "events": {
+                "good": round(good_d, 3),
+                "total": round(total_d, 3),
+            },
+            "cumulative": {
+                "good": round(now_m.good, 3),
+                "total": round(now_m.total, 3),
+            },
+        }
+        if slo.sli == "latency":
+            evaluation["threshold_ms"] = round(slo.threshold_s * 1e3, 3)
+            if p99_s is not None:
+                evaluation["p99_ms"] = round(p99_s * 1e3, 4)
+        state.last = evaluation
+        return evaluation
+
+    # -- surfaces --------------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """The /debug/slo payload: every SLO's latest evaluation (ticked
+        lazily if none has happened yet, so the endpoint is readable the
+        moment the engine is wired)."""
+        with self._lock:
+            never_ticked = self._ticks == 0
+        if never_ticked:
+            self.tick()
+        with self._lock:
+            rows = [
+                self._states[name].last
+                for name in self.slos
+                if self._states[name].last is not None
+            ]
+            return {
+                "enabled": True,
+                "now": self.clock(),
+                "ticks": self._ticks,
+                "windows": {k: v for k, v in sorted(self.windows.items())},
+                "budget_window": self._budget_window,
+                "slos": rows,
+            }
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.snapshot()).encode() + b"\n"
+
+    def judge(self) -> Dict[str, Dict]:
+        """{slo: {alert, compliance, error_budget_remaining, breaches}}
+        from the latest evaluations — the digital twin's per-scenario
+        verdict source (testing/twin.py)."""
+        with self._lock:
+            out = {}
+            for name, state in self._states.items():
+                last = state.last or {}
+                out[name] = {
+                    "alert": state.alert,
+                    "compliance": last.get("compliance"),
+                    "error_budget_remaining": last.get(
+                        "error_budget_remaining"
+                    ),
+                    "breaches": dict(state.breaches),
+                }
+            return out
+
+    def readiness_condition(self) -> Tuple[bool, str]:
+        """The INFORMATIONAL ``slo_burn`` /readyz condition: always ok
+        (pulling a burning replica out of the Service would hurt the
+        availability SLO it is burning), reason names what burns."""
+        with self._lock:
+            burning = [
+                f"{name}({state.alert})"
+                for name, state in sorted(self._states.items())
+                if state.alert != ALERT_OK
+            ]
+            count = len(self.slos)
+        if burning:
+            return True, f"burning: {', '.join(burning)}"
+        return True, f"{count} SLOs within budget"
+
+    # -- production loop -------------------------------------------------------
+
+    def start(
+        self, period_s: float, stop: Optional[threading.Event] = None
+    ) -> threading.Event:
+        """Tick on a daemon thread every ``period_s`` seconds until
+        ``stop`` is set (one is created when absent; returned either
+        way).  A tick that raises logs and the loop continues — SLO
+        evaluation must never take the service down."""
+        stop = stop if stop is not None else threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(period_s):
+                try:
+                    self.tick()
+                except Exception as exc:
+                    klog.error("slo tick failed: %s", exc)
+
+        threading.Thread(target=loop, daemon=True).start()
+        return stop
+
+
+# ---------------------------------------------------------------------------
+# the default SLO set (--slo=on)
+# ---------------------------------------------------------------------------
+
+
+def default_slos(
+    tas: bool = True,
+    prioritize_p99_ms: float = 10.0,
+    filter_p99_ms: float = 10.0,
+) -> List[SLO]:
+    """The shipped defaults (cmd/common.py ``--slo=on``): verb
+    availability, Filter/Prioritize latency, and — on TAS, which owns a
+    telemetry cache and a rebalancer — telemetry freshness and eviction
+    safety.  ``--sloConfig`` merges over these by name."""
+    verbs = ("prioritize", "filter") if tas else ("gas_filter", "gas_bind")
+    slos = [
+        SLO(
+            name="verb_availability",
+            sli="availability",
+            objective=0.999,
+            description=(
+                "scheduler verbs answered vs shed at a saturated "
+                "admission queue"
+            ),
+            verbs=verbs,
+            bad=_counter_specs(["pas_serving_rejected_total"]),
+        ),
+    ]
+    if tas:
+        slos += [
+            SLO(
+                name="prioritize_p99",
+                sli="latency",
+                objective=0.99,
+                description=(
+                    f"Prioritize requests under {prioritize_p99_ms:g} ms"
+                ),
+                verbs=("prioritize",),
+                threshold_s=prioritize_p99_ms / 1e3,
+            ),
+            SLO(
+                name="filter_p99",
+                sli="latency",
+                objective=0.99,
+                description=f"Filter requests under {filter_p99_ms:g} ms",
+                verbs=("filter",),
+                threshold_s=filter_p99_ms / 1e3,
+            ),
+            SLO(
+                name="telemetry_freshness",
+                sli="freshness",
+                objective=0.999,
+                description=(
+                    "fraction of time the telemetry cache was fresh "
+                    "(time-weighted; the error budget is seconds of "
+                    "staleness)"
+                ),
+            ),
+            SLO(
+                name="eviction_safety",
+                sli="counter_ratio",
+                objective=0.999,
+                description=(
+                    "eviction attempts that were safe: executed moves vs "
+                    "attempts the API refused (pdb) or that errored — the "
+                    "zero-bad-eviction objective"
+                ),
+                good=_counter_specs(["pas_rebalance_moves_executed_total"]),
+                bad=_counter_specs(
+                    [
+                        {
+                            "name": "pas_rebalance_moves_skipped_total",
+                            "labels": {"reason": "pdb"},
+                        },
+                        {
+                            "name": "pas_rebalance_moves_skipped_total",
+                            "labels": {"reason": "error"},
+                        },
+                    ]
+                ),
+            ),
+        ]
+    else:
+        slos.append(
+            SLO(
+                name="gas_filter_p99",
+                sli="latency",
+                objective=0.99,
+                description=f"GAS Filter requests under {filter_p99_ms:g} ms",
+                verbs=("gas_filter",),
+                threshold_s=filter_p99_ms / 1e3,
+            )
+        )
+    return slos
+
+
+def merge_config(slos: List[SLO], config_json: str) -> List[SLO]:
+    """Apply a ``--sloConfig`` JSON override: ``{"slos": [...]}`` (or a
+    bare list) merged by name over the defaults — a full entry replaces,
+    ``{"name": ..., "disabled": true}`` removes, a new name appends.
+    Raises ValueError on malformed input (the mains fail fast at
+    startup; a typo must not silently run with weakened objectives)."""
+    if not config_json:
+        return slos
+    obj = json.loads(config_json)
+    entries = obj.get("slos") if isinstance(obj, dict) else obj
+    if not isinstance(entries, list):
+        raise ValueError('sloConfig must be a list or {"slos": [...]}')
+    merged = {slo.name: slo for slo in slos}
+    for entry in entries:
+        if not isinstance(entry, dict) or "name" not in entry:
+            raise ValueError(f"sloConfig entry needs a name: {entry!r}")
+        name = str(entry["name"])
+        if entry.get("disabled"):
+            merged.pop(name, None)
+            continue
+        merged[name] = slo_from_dict(entry)
+    return list(merged.values())

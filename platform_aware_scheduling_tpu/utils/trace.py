@@ -178,6 +178,15 @@ declare("pas_gang_journal_writes_total", "counter", "Gang reservation journal sn
 declare("pas_gang_journal_skipped_total", "counter", "Journal writes not attempted or failed, leaving the tracker in-memory-only (label: reason in circuit_open/error).")
 declare("pas_gang_journal_recovered_total", "counter", "Gang reservations restored from the journal at startup after reconciling against live pods.")
 declare("pas_gang_journal_discarded_total", "counter", "Journal entries discarded at recovery because live pods contradicted them (stale journal must not admit a straddling gang).")
+# service-level objectives (utils/slo.py: declarative SLIs over the
+# recorders/counters, multi-window multi-burn-rate alerting;
+# docs/observability.md "SLOs & error budgets").  These families live in
+# the SLO engine's own CounterSet and appear on /metrics only where an
+# engine is wired (--slo=on) — the off path registers nothing.
+declare("pas_slo_compliance", "gauge", "Good-event fraction over the budget window per SLO; 1.0 when the window saw no events (label: slo).")
+declare("pas_slo_error_budget_remaining", "gauge", "Fraction of the error budget left over the budget window: 1 - burn_rate(budget window); negative means overspent (label: slo).")
+declare("pas_slo_burn_rate", "gauge", "Error-budget burn rate per sliding window: bad fraction / (1 - objective); 1.0 spends the budget exactly by window end (labels: slo, window).")
+declare("pas_slo_breaches_total", "counter", "Alert-tier entries per SLO, edge-triggered: page when both fast windows burn past page_burn, warn when both slow windows burn past warn_burn (labels: slo, tier).")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where

@@ -19,8 +19,94 @@ import threading
 from collections import deque
 from typing import Deque, Dict, Iterable, List, Optional, Tuple
 
-# exponential bucket bounds in seconds: 100us .. ~105s
-_BUCKETS: List[float] = [0.0001 * (2**i) for i in range(21)]
+# bucket bounds in seconds: a doubling ladder from 100 µs to ~105 s,
+# densified below 1 ms (250/500/750 µs).  The serving floor at 10k nodes
+# is ~755 µs host-side (ROADMAP item 1), and with the bare 2x ladder the
+# whole sub-millisecond story — and every latency SLO computed from these
+# buckets (utils/slo.py) — collapsed into the 400 µs -> 800 µs step; the
+# extra bounds resolve it.  Sorted and deduplicated by construction so
+# the exposition's cumulative-bucket invariant cannot be violated by a
+# misordered literal.
+_BUCKETS: List[float] = sorted(
+    {0.00025, 0.0005, 0.00075} | {0.0001 * (2**i) for i in range(21)}
+)
+
+
+def quantile_from_buckets(
+    buckets: List[int], q: float, bounds: Optional[List[float]] = None
+) -> float:
+    """Estimate the q-quantile in seconds from per-bucket counts.
+
+    ``buckets`` holds one count per bound in ``bounds`` (default: the
+    shared ``_BUCKETS`` ladder) plus a trailing +Inf overflow count —
+    exactly the shape :meth:`LatencyRecorder.snapshot` returns, and the
+    shape the SLO engine's windowed bucket deltas take (utils/slo.py).
+
+    The estimate interpolates LINEARLY WITHIN the bucket containing the
+    target rank (between the previous bound — 0 for the first bucket —
+    and the bucket's own bound), at the continuous rank ``q * total``
+    inside the bucket's samples — the Prometheus ``histogram_quantile``
+    convention, which assumes samples spread uniformly across the
+    bucket.  Returning the bucket's upper bound outright would overstate
+    sparse distributions by up to a whole bucket width, and an EMPTY
+    family would "estimate" the top bound of the ladder.  Edge cases,
+    each pinned in tests/test_slo.py:
+
+      * zero observations -> 0.0 (no data is not "as slow as possible");
+      * all samples in one bucket -> a value inside that bucket;
+      * samples in the +Inf overflow bucket -> the last finite bound
+        (there is no upper edge to interpolate toward — the estimate is
+        a floor, as for any +Inf-bucket quantile)."""
+    if bounds is None:
+        bounds = _BUCKETS
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    # continuous rank (histogram_quantile convention), clamped into
+    # (0, total] so q=0 and q=1 stay inside the observed range
+    rank = min(float(total), max(1e-9, q * total))
+    cumulative = 0.0
+    for i, count in enumerate(buckets):
+        if count <= 0:
+            continue
+        if cumulative + count >= rank:
+            if i >= len(bounds):
+                # +Inf bucket: no finite upper edge — floor estimate
+                return bounds[-1]
+            lower = bounds[i - 1] if i > 0 else 0.0
+            upper = bounds[i]
+            fraction = (rank - cumulative) / count
+            return lower + (upper - lower) * fraction
+        cumulative += count
+    return bounds[-1]  # unreachable when counts sum to total
+
+
+def bucket_count_below(
+    buckets: List[int],
+    threshold_s: float,
+    bounds: Optional[List[float]] = None,
+) -> float:
+    """How many of the bucketed samples fall at or under ``threshold_s``
+    — the latency-SLI "good event" count (utils/slo.py).  Whole buckets
+    whose bound is <= threshold count fully; the bucket straddling the
+    threshold contributes the linearly interpolated fraction of its
+    width below it (the same within-bucket model as
+    :func:`quantile_from_buckets`); +Inf samples never count."""
+    if bounds is None:
+        bounds = _BUCKETS
+    good = 0.0
+    for i, count in enumerate(buckets):
+        if count <= 0:
+            continue
+        if i >= len(bounds):
+            break  # +Inf bucket: all above any finite threshold
+        lower = bounds[i - 1] if i > 0 else 0.0
+        upper = bounds[i]
+        if upper <= threshold_s:
+            good += count
+        elif lower < threshold_s:
+            good += count * (threshold_s - lower) / (upper - lower)
+    return good
 
 
 def quantile(sorted_values: List[float], q: float) -> float:

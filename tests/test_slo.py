@@ -1,0 +1,740 @@
+"""SLO engine suite (utils/slo.py; docs/observability.md "SLOs & error
+budgets"): bucket-quantile interpolation + the sub-millisecond histogram
+bounds, SLI measurement per kind, multi-window burn-rate alerting on
+fake clocks, /debug/slo + the /debug index completeness gate on both
+front-ends, and the --slo=off off-path pins (zero gauges, byte-identical
+wire)."""
+
+import json
+
+import pytest
+
+from benchmarks.http_load import build_extender, make_bodies
+from platform_aware_scheduling_tpu.extender.server import (
+    DEBUG_ENDPOINTS,
+    HTTPRequest,
+    QUEUE_BYPASS_PATHS,
+    Server,
+)
+from platform_aware_scheduling_tpu.utils import trace
+from platform_aware_scheduling_tpu.utils.slo import (
+    ALERT_OK,
+    ALERT_PAGE,
+    ALERT_WARN,
+    SLO,
+    SLOEngine,
+    default_slos,
+    merge_config,
+    slo_from_dict,
+)
+from platform_aware_scheduling_tpu.utils.tracing import (
+    _BUCKETS,
+    CounterSet,
+    LatencyRecorder,
+    bucket_count_below,
+    histograms_text,
+    quantile_from_buckets,
+)
+from wirehelpers import get_request, post_bytes, raw_request, start_async, start_threaded
+
+
+def _buckets(**at):
+    """A per-bucket count array from {bound_index: count} (+Inf = -1)."""
+    out = [0] * (len(_BUCKETS) + 1)
+    for idx, count in at.items():
+        out[int(idx)] = count
+    return out
+
+
+class TestBucketQuantiles:
+    """Satellite: quantile-from-buckets must interpolate within the
+    bucket, with the edge cases pinned."""
+
+    def test_zero_observations_is_zero(self):
+        assert quantile_from_buckets([0] * (len(_BUCKETS) + 1), 0.99) == 0.0
+
+    def test_single_bucket_interpolates_inside(self):
+        # 10 samples all in the (0.0002, 0.00025] bucket (index 2): the
+        # median estimate must land INSIDE the bucket, not on its edge
+        buckets = _buckets(**{"2": 10})
+        p50 = quantile_from_buckets(buckets, 0.50)
+        assert _BUCKETS[1] < p50 < _BUCKETS[2]
+
+    def test_all_in_inf_returns_last_finite_bound(self):
+        buckets = [0] * (len(_BUCKETS) + 1)
+        buckets[-1] = 7
+        assert quantile_from_buckets(buckets, 0.5) == _BUCKETS[-1]
+        assert quantile_from_buckets(buckets, 0.99) == _BUCKETS[-1]
+
+    def test_interpolation_matches_uniform_assumption(self):
+        # 100 samples in the first bucket (0, 0.0001]: p50 -> ~50 µs
+        buckets = _buckets(**{"0": 100})
+        assert quantile_from_buckets(buckets, 0.50) == pytest.approx(
+            0.00005, rel=0.05
+        )
+
+    def test_sparse_buckets_skip_empties(self):
+        # 1 sample in bucket 0, 1 in bucket 8: p99 targets the second —
+        # interpolated within ITS bounds, ignoring the empty gap
+        buckets = _buckets(**{"0": 1, "8": 1})
+        p99 = quantile_from_buckets(buckets, 0.99)
+        assert _BUCKETS[7] < p99 <= _BUCKETS[8]
+
+    def test_count_below_whole_and_fractional(self):
+        # bucket 0 fully under 1 ms; bucket index of 0.0016 straddles a
+        # 1.2 ms threshold: fractional credit, linear within the bucket
+        i_16 = _BUCKETS.index(0.0016)
+        buckets = _buckets(**{"0": 4, str(i_16): 10})
+        lower = _BUCKETS[i_16 - 1]  # 0.0008
+        expected = 4 + 10 * (0.0012 - lower) / (0.0016 - lower)
+        assert bucket_count_below(buckets, 0.0012) == pytest.approx(expected)
+        # +Inf samples never count below any finite threshold
+        buckets[-1] = 5
+        assert bucket_count_below(buckets, 10_000.0) == pytest.approx(14.0)
+
+
+class TestSubMillisecondBounds:
+    """Satellite: the histogram ladder resolves the sub-ms serving
+    floor, and the new bounds round-trip through real exposition."""
+
+    def test_ladder_contains_sub_ms_bounds(self):
+        for bound in (0.0001, 0.0002, 0.00025, 0.0004, 0.0005, 0.00075):
+            assert bound in _BUCKETS, f"{bound} missing from the ladder"
+        assert _BUCKETS == sorted(set(_BUCKETS)), "ladder must be sorted"
+
+    def test_exposition_round_trip_resolves_755us(self):
+        recorder = LatencyRecorder()
+        for v in (0.0003, 0.0006, 0.000755, 0.002):
+            recorder.observe("prioritize", v)
+        text = histograms_text([recorder])
+        families = trace.parse_prometheus_text(text)
+        family = families["pas_request_duration_seconds"]
+        by_le = {
+            labels["le"]: value
+            for name, labels, value in family["samples"]
+            if name.endswith("_bucket")
+        }
+        # the new bounds are on the wire and the ladder separates the
+        # 300/600/755 µs samples instead of flattening them into 2x steps
+        assert by_le["0.00025"] == 0
+        assert by_le["0.0004"] == 1  # 300 µs
+        assert by_le["0.00075"] == 2  # + 600 µs
+        assert by_le["0.0008"] == 3  # + 755 µs
+        assert by_le["+Inf"] == 4
+
+
+class TestDeclarations:
+    def test_slo_validation(self):
+        with pytest.raises(ValueError):
+            SLO(name="x", sli="nope", objective=0.9)
+        with pytest.raises(ValueError):
+            SLO(name="x", sli="latency", objective=1.5, verbs=("a",),
+                threshold_s=0.1)
+        with pytest.raises(ValueError):
+            SLO(name="x", sli="latency", objective=0.9)  # no verbs
+        with pytest.raises(ValueError):
+            SLO(name="x", sli="availability", objective=0.9)  # no verbs
+        with pytest.raises(ValueError):
+            SLO(name="x", sli="counter_ratio", objective=0.9)  # no specs
+
+    def test_slo_from_dict_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown keys"):
+            slo_from_dict(
+                {"name": "x", "sli": "freshness", "objective": 0.9,
+                 "objectiv": 0.5}
+            )
+
+    def test_slo_from_dict_missing_required_keys_is_value_error(self):
+        # the documented fail-fast contract is ValueError, not a bare
+        # KeyError traceback naming no entry
+        with pytest.raises(ValueError, match="objective"):
+            slo_from_dict({"name": "filter_p99", "threshold_ms": 5})
+        with pytest.raises(ValueError, match="name"):
+            slo_from_dict({"sli": "freshness", "objective": 0.9})
+
+    def test_merge_config_replace_disable_append(self):
+        base = default_slos()
+        merged = merge_config(
+            base,
+            json.dumps(
+                {
+                    "slos": [
+                        {"name": "filter_p99", "disabled": True},
+                        {
+                            "name": "prioritize_p99",
+                            "sli": "latency",
+                            "objective": 0.95,
+                            "verbs": ["prioritize"],
+                            "threshold_ms": 50,
+                        },
+                        {
+                            "name": "custom_ratio",
+                            "sli": "counter_ratio",
+                            "objective": 0.9,
+                            "good": ["pas_rebalance_moves_executed_total"],
+                        },
+                    ]
+                }
+            ),
+        )
+        names = {slo.name for slo in merged}
+        assert "filter_p99" not in names
+        assert "custom_ratio" in names
+        prio = next(s for s in merged if s.name == "prioritize_p99")
+        assert prio.objective == 0.95
+        assert prio.threshold_s == pytest.approx(0.05)
+
+    def test_merge_config_malformed_fails_fast(self):
+        with pytest.raises(ValueError):
+            merge_config(default_slos(), '{"slos": {"not": "a list"}}')
+        with pytest.raises(ValueError):
+            merge_config(default_slos(), '[{"sli": "freshness"}]')
+
+    def test_duplicate_slo_names_rejected(self):
+        slo = SLO(name="dup", sli="freshness", objective=0.9)
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEngine([slo, slo])
+
+    def test_windows_must_cover_alert_tiers(self):
+        with pytest.raises(ValueError, match="alert tiers"):
+            SLOEngine(
+                [SLO(name="f", sli="freshness", objective=0.9)],
+                windows={"5m": 300.0},
+            )
+
+
+class _Clock:
+    def __init__(self, start=1000.0):
+        self.t = start
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class TestEngineMeasurement:
+    def test_latency_sli_counts_under_threshold(self):
+        clock = _Clock()
+        recorder = LatencyRecorder()
+        engine = SLOEngine(
+            [
+                SLO(
+                    name="lat",
+                    sli="latency",
+                    objective=0.5,
+                    verbs=("prioritize",),
+                    threshold_s=0.001,
+                )
+            ],
+            recorders=[recorder],
+            clock=clock,
+        )
+        engine.tick()  # baseline
+        for v in (0.0002, 0.0003, 0.002, 0.004):  # 2 good, 2 bad
+            recorder.observe("prioritize", v)
+        clock.advance(10)
+        out = engine.tick()["lat"]
+        assert out["events"]["total"] == pytest.approx(4.0)
+        assert out["compliance"] == pytest.approx(0.5, abs=0.01)
+        assert out["p99_ms"] is not None and out["p99_ms"] > 1.0
+
+    def test_availability_sli_counts_shed_requests(self):
+        clock = _Clock()
+        recorder = LatencyRecorder()
+        shed = CounterSet()
+        engine = SLOEngine(
+            [
+                SLO(
+                    name="avail",
+                    sli="availability",
+                    objective=0.9,
+                    verbs=("prioritize", "filter"),
+                    bad=(("pas_serving_rejected_total", None),),
+                )
+            ],
+            recorders=[recorder],
+            counter_sets=[shed],
+            clock=clock,
+        )
+        engine.tick()
+        for _ in range(8):
+            recorder.observe("prioritize", 0.001)
+        shed.inc("pas_serving_rejected_total", 2)
+        clock.advance(10)
+        out = engine.tick()["avail"]
+        assert out["events"]["total"] == pytest.approx(10.0)
+        assert out["compliance"] == pytest.approx(0.8)
+
+    def test_counter_ratio_with_labels(self):
+        clock = _Clock()
+        cs = CounterSet()
+        engine = SLOEngine(
+            [
+                SLO(
+                    name="evict",
+                    sli="counter_ratio",
+                    objective=0.9,
+                    good=(("pas_rebalance_moves_executed_total", None),),
+                    bad=(
+                        (
+                            "pas_rebalance_moves_skipped_total",
+                            (("reason", "pdb"),),
+                        ),
+                    ),
+                )
+            ],
+            counter_sets=[cs],
+            clock=clock,
+        )
+        engine.tick()
+        cs.inc("pas_rebalance_moves_executed_total", 9)
+        cs.inc(
+            "pas_rebalance_moves_skipped_total", 1,
+            labels={"reason": "pdb"},
+        )
+        # a skip reason OUTSIDE the spec's labels must not count as bad
+        cs.inc(
+            "pas_rebalance_moves_skipped_total", 5,
+            labels={"reason": "dry_run"},
+        )
+        clock.advance(10)
+        out = engine.tick()["evict"]
+        assert out["events"]["total"] == pytest.approx(10.0)
+        assert out["compliance"] == pytest.approx(0.9)
+
+    def test_freshness_is_time_weighted_on_the_clock(self):
+        clock = _Clock()
+        fresh = [True]
+        engine = SLOEngine(
+            [SLO(name="f", sli="freshness", objective=0.5)],
+            freshness=lambda: (fresh[0], ""),
+            clock=clock,
+        )
+        engine.tick()  # baseline (no dt yet)
+        for _ in range(4):  # 40 s fresh
+            clock.advance(10)
+            engine.tick()
+        fresh[0] = False
+        for _ in range(6):  # 60 s stale
+            clock.advance(10)
+            engine.tick()
+        out = engine.tick()["f"]
+        assert out["cumulative"]["total"] == pytest.approx(100.0)
+        assert out["cumulative"]["good"] == pytest.approx(40.0)
+
+    def test_first_tick_ignores_preexisting_counter_history(self):
+        clock = _Clock()
+        cs = CounterSet()
+        cs.inc("pas_rebalance_moves_executed_total", 3)
+        cs.inc(
+            "pas_rebalance_moves_skipped_total", 97,
+            labels={"reason": "pdb"},
+        )
+        engine = SLOEngine(
+            [
+                SLO(
+                    name="evict",
+                    sli="counter_ratio",
+                    objective=0.999,
+                    good=(("pas_rebalance_moves_executed_total", None),),
+                    bad=(
+                        (
+                            "pas_rebalance_moves_skipped_total",
+                            (("reason", "pdb"),),
+                        ),
+                    ),
+                )
+            ],
+            counter_sets=[cs],
+            clock=clock,
+        )
+        out = engine.tick()["evict"]
+        # the 97 historical bad events are NOT this engine's window
+        assert out["events"]["total"] == 0.0
+        assert out["compliance"] == 1.0
+        assert out["alert"] == ALERT_OK
+
+    def test_no_events_means_compliant(self):
+        clock = _Clock()
+        engine = SLOEngine(
+            [
+                SLO(
+                    name="lat",
+                    sli="latency",
+                    objective=0.99,
+                    verbs=("prioritize",),
+                    threshold_s=0.001,
+                )
+            ],
+            recorders=[LatencyRecorder()],
+            clock=clock,
+        )
+        engine.tick()
+        clock.advance(1000)
+        out = engine.tick()["lat"]
+        assert out["compliance"] == 1.0
+        assert out["error_budget_remaining"] == 1.0
+        assert all(rate == 0.0 for rate in out["burn_rate"].values())
+
+
+class TestBurnRateAlerting:
+    def _storm_engine(self, clock, fresh):
+        return SLOEngine(
+            [SLO(name="f", sli="freshness", objective=0.999)],
+            freshness=lambda: (fresh[0], ""),
+            clock=clock,
+        )
+
+    def test_page_fires_and_clears_with_budget_memory(self):
+        clock = _Clock()
+        fresh = [True]
+        engine = self._storm_engine(clock, fresh)
+        for _ in range(6):  # 30 s healthy
+            engine.tick()
+            clock.advance(5)
+        fresh[0] = False
+        paged_at = None
+        for i in range(8):  # 40 s storm
+            out = engine.tick()["f"]
+            if out["alert"] == ALERT_PAGE and paged_at is None:
+                paged_at = i
+            clock.advance(5)
+        assert paged_at is not None, "the storm must reach the page tier"
+        fresh[0] = True
+        # drain the 5m fast window; the page must clear while the slow
+        # 6h/3d windows legitimately still remember the storm (warn)
+        out = None
+        for _ in range(70):
+            clock.advance(5)
+            out = engine.tick()["f"]
+        assert out["alert"] in (ALERT_OK, ALERT_WARN)
+        assert out["alert"] != ALERT_PAGE
+        assert out["burn_rate"]["5m"] == 0.0
+        assert out["burn_rate"]["3d"] > 0.0
+        assert out["error_budget_remaining"] == pytest.approx(
+            1.0 - out["burn_rate"]["3d"], abs=1e-6
+        )
+        # edge-triggered per INDEPENDENT tier: one storm, one page
+        # breach — and the warn tier (whose slow windows also crossed
+        # during the storm) counted its own single rising edge instead
+        # of being shadowed by the concurrent page
+        assert out["breaches"]["page"] == 1
+        assert out["breaches"]["warn"] == 1
+
+    def test_burn_rate_math(self):
+        clock = _Clock()
+        fresh = [True]
+        engine = self._storm_engine(clock, fresh)
+        engine.tick()
+        fresh[0] = False
+        for _ in range(10):  # 100% bad for 100 s
+            clock.advance(10)
+            engine.tick()
+        out = engine.tick()["f"]
+        # all-bad window: bad fraction 1.0, burn = 1 / (1 - 0.999)
+        assert out["burn_rate"]["5m"] == pytest.approx(1000.0, rel=1e-6)
+
+    def test_gauges_live_in_engine_counters(self):
+        clock = _Clock()
+        engine = SLOEngine(
+            [SLO(name="f", sli="freshness", objective=0.9)],
+            freshness=lambda: (True, ""),
+            clock=clock,
+        )
+        engine.tick()
+        text = engine.counters.prometheus_text()
+        families = trace.parse_prometheus_text(text)
+        assert "pas_slo_compliance" in families
+        assert "pas_slo_burn_rate" in families
+        # window label per series
+        windows = {
+            labels["window"]
+            for _n, labels, _v in families["pas_slo_burn_rate"]["samples"]
+        }
+        assert windows == {"5m", "1h", "6h", "3d"}
+        # and NOT in the process-wide COUNTERS (the off-path guarantee
+        # rides on this separation)
+        assert trace.COUNTERS.get(
+            "pas_slo_compliance", kind="gauge", labels={"slo": "f"}
+        ) == 0
+
+    def test_readiness_condition_is_informational(self):
+        clock = _Clock()
+        fresh = [False]
+        engine = SLOEngine(
+            [SLO(name="f", sli="freshness", objective=0.999)],
+            freshness=lambda: (fresh[0], ""),
+            clock=clock,
+        )
+        engine.tick()
+        for _ in range(5):
+            clock.advance(10)
+            engine.tick()
+        ok, reason = engine.readiness_condition()
+        assert ok is True  # burning never yanks the replica
+        assert "f(" in reason
+
+    def test_window_rings_stay_bounded(self):
+        clock = _Clock()
+        engine = SLOEngine(
+            [SLO(name="f", sli="freshness", objective=0.9)],
+            freshness=lambda: (True, ""),
+            clock=clock,
+            window_slots=64,
+        )
+        for _ in range(5000):
+            clock.advance(1.0)
+            engine.tick()
+        for ring in engine._rings.values():
+            assert len(ring._entries) <= 66, (
+                f"{ring.window_s}s ring grew to {len(ring._entries)}"
+            )
+
+    def test_snapshot_is_readable_before_first_tick(self):
+        engine = SLOEngine(
+            [SLO(name="f", sli="freshness", objective=0.9)],
+            freshness=lambda: (True, ""),
+            clock=_Clock(),
+        )
+        snap = engine.snapshot()
+        assert snap["enabled"] is True
+        assert snap["slos"][0]["name"] == "f"
+
+
+def _extender_with_engine(num_nodes=32):
+    ext, names = build_extender(num_nodes, device=True)
+    engine = SLOEngine(default_slos(), recorders=[ext.recorder])
+    engine.tick()
+    return ext, names, engine
+
+
+class TestDebugSloEndpoint:
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_codes_and_payload(self, serving):
+        ext, _names, engine = _extender_with_engine()
+        server = (
+            start_async(ext) if serving == "async" else start_threaded(ext)
+        )
+        try:
+            # 404 while unwired (--slo=off)
+            status, _h, body = get_request(server.port, "/debug/slo")
+            assert status == 404
+            assert b"error" in body
+            # 405 on non-GET
+            ext.slo = engine
+            status, _h, _b = raw_request(
+                server.port, post_bytes("/debug/slo", b"{}")
+            )
+            assert status == 405
+            # 200 with the compliance payload once wired
+            status, _h, body = get_request(server.port, "/debug/slo")
+            assert status == 200
+            snap = json.loads(body)
+            assert snap["enabled"] is True
+            names = {row["name"] for row in snap["slos"]}
+            assert {"verb_availability", "prioritize_p99"} <= names
+            for row in snap["slos"]:
+                assert "compliance" in row
+                assert set(row["burn_rate"]) == {"5m", "1h", "6h", "3d"}
+        finally:
+            server.shutdown()
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_metrics_gains_slo_families_only_when_wired(self, serving):
+        ext, _names, engine = _extender_with_engine()
+        server = (
+            start_async(ext) if serving == "async" else start_threaded(ext)
+        )
+        try:
+            status, _h, body = get_request(server.port, "/metrics")
+            assert status == 200
+            assert b"pas_slo_" not in body, "--slo=off must emit nothing"
+            ext.slo = engine
+            status, _h, body = get_request(server.port, "/metrics")
+            families = trace.parse_prometheus_text(body.decode())
+            assert "pas_slo_compliance" in families
+            assert "pas_slo_error_budget_remaining" in families
+            assert "pas_slo_burn_rate" in families
+        finally:
+            server.shutdown()
+
+
+class TestShedVisibility:
+    def test_async_server_wires_its_counters_into_the_engine(self):
+        """The admission-shed counter lives in the AsyncServer's
+        layer-local CounterSet; an engine attached before the server is
+        built (the mains' order) must see it — otherwise a saturated
+        queue shedding half the traffic scores availability 1.0."""
+        clock = _Clock()
+        ext, _names = build_extender(8, device=True)
+        engine = SLOEngine(
+            default_slos(), recorders=[ext.recorder], clock=clock
+        )
+        ext.slo = engine
+        server = start_async(ext)
+        try:
+            assert server.counters in engine.counter_sets
+            engine.tick()
+            for _ in range(8):
+                ext.recorder.observe("prioritize", 0.001)
+            server.counters.inc("pas_serving_rejected_total", 2)
+            clock.advance(10)
+            out = engine.tick()["verb_availability"]
+            assert out["compliance"] == pytest.approx(0.8)
+            # idempotent: a second server for the same scheduler must
+            # not double-count the first one's set
+            assert engine.counter_sets.count(server.counters) == 1
+        finally:
+            server.shutdown()
+
+
+class TestOffPathPins:
+    def test_off_is_byte_identical_on_the_wire(self):
+        """ISSUE 10 acceptance: wiring (or not wiring) the engine never
+        changes a verb response byte — the engine reads passively."""
+        ext_off, names, _ = _extender_with_engine()
+        ext_on, _names2, engine = _extender_with_engine()
+        ext_on.slo = engine
+        body = make_bodies(names, "nodenames", count=1)[0]
+        for verb in ("prioritize", "filter"):
+            request = HTTPRequest(
+                method="POST",
+                path=f"/scheduler/{verb}",
+                headers={"Content-Type": "application/json"},
+                body=body,
+            )
+            off = getattr(ext_off, verb)(request)
+            on = getattr(ext_on, verb)(request)
+            assert off.status == on.status
+            assert off.body == on.body
+
+    def test_flag_default_builds_nothing(self):
+        from platform_aware_scheduling_tpu.cmd import common, gas, tas
+
+        args = tas.build_arg_parser().parse_args([])
+        assert args.slo == "off"
+        ext, _names = build_extender(8, device=True)
+        assert common.build_slo_engine(args, ext) is None
+        assert ext.slo is None
+        assert "pas_slo_" not in ext.metrics_text()
+        # GAS offers the same flags (shared helper; no drift)
+        gas_args = gas.build_arg_parser().parse_args([])
+        assert gas_args.slo == "off"
+
+    def test_flag_on_wires_tas_defaults(self):
+        from platform_aware_scheduling_tpu.cmd import common, tas
+
+        args = tas.build_arg_parser().parse_args(
+            [
+                "--slo", "on",
+                "--sloConfig",
+                '[{"name": "filter_p99", "disabled": true}]',
+            ]
+        )
+        ext, _names = build_extender(8, device=True)
+        engine = common.build_slo_engine(args, ext, cache=ext.cache)
+        assert engine is not None
+        assert ext.slo is engine
+        names = set(engine.slos)
+        assert "telemetry_freshness" in names  # TAS default set
+        assert "eviction_safety" in names
+        assert "filter_p99" not in names  # config disable applied
+        assert engine.freshness is not None
+        # readiness grows the informational condition
+        conditions = dict(ext.readiness_conditions())
+        assert "slo_burn" in conditions
+        ok, _reason = conditions["slo_burn"]()
+        assert ok is True
+
+    def test_gas_engine_defaults(self):
+        from platform_aware_scheduling_tpu.cmd import common, gas
+        from platform_aware_scheduling_tpu.gas.scheduler import GASExtender
+        from platform_aware_scheduling_tpu.testing.fake_kube import (
+            FakeKubeClient,
+        )
+
+        args = gas.build_arg_parser().parse_args(["--slo", "on"])
+        ext = GASExtender(FakeKubeClient(), use_device=False)
+        engine = common.build_slo_engine(args, ext)
+        assert engine is not None and ext.slo is engine
+        assert "gas_filter_p99" in engine.slos
+        assert "telemetry_freshness" not in engine.slos  # no cache
+        engine.tick()
+        assert "pas_slo_compliance" in ext.metrics_text()
+
+    def test_slo_period_flag(self):
+        from platform_aware_scheduling_tpu.cmd import common, tas
+
+        args = tas.build_arg_parser().parse_args(["--sloPeriod", "2s"])
+        assert common.slo_period(args, 5.0) == pytest.approx(2.0)
+        args = tas.build_arg_parser().parse_args([])
+        assert common.slo_period(args, 5.0) == pytest.approx(5.0)
+
+
+class TestDebugIndexCompleteness:
+    """Satellite: every registered debug route appears in the GET /debug
+    index on both front-ends, answers GET with a JSON payload (never the
+    bare catch-all 404), answers non-GET with 405, and the async
+    queue-bypass set is derived from the same index — new endpoints
+    cannot silently drop out of any of the three."""
+
+    EXPECTED = {
+        "/healthz", "/readyz", "/metrics", "/debug/traces",
+        "/debug/decisions", "/debug/rebalance", "/debug/gangs",
+        "/debug/forecast", "/debug/leader", "/debug/slo",
+        "/debug/profile",
+    }
+
+    def test_index_names_every_debug_route(self):
+        assert {e["path"] for e in DEBUG_ENDPOINTS} == self.EXPECTED
+
+    def test_bypass_set_derived_from_index(self):
+        assert QUEUE_BYPASS_PATHS == (
+            self.EXPECTED - {"/debug/profile"}
+        ) | {"/debug", "/debug/"}
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_every_indexed_route_is_served(self, serving):
+        ext, _names, _engine = _extender_with_engine(num_nodes=8)
+        server = (
+            start_async(ext) if serving == "async" else start_threaded(ext)
+        )
+        try:
+            status, _h, body = get_request(server.port, "/debug")
+            assert status == 200
+            index_paths = {
+                e["path"] for e in json.loads(body)["endpoints"]
+            }
+            assert index_paths == self.EXPECTED
+            for path in sorted(index_paths):
+                status, _h, body = get_request(server.port, path)
+                assert body, f"{path}: empty body is the catch-all 404"
+                if path != "/metrics":
+                    json.loads(body)  # every debug payload is JSON
+                assert status in (200, 400, 404, 503), (
+                    f"{path} -> {status}"
+                )
+                status, _h, _b = raw_request(
+                    server.port, post_bytes(path, b"{}")
+                )
+                assert status == 405, f"{path}: non-GET must 405"
+        finally:
+            server.shutdown()
+
+    def test_unknown_debug_path_is_catch_all(self):
+        """The distinguishability this gate relies on: an UNROUTED debug
+        path gets the bare empty-body 404, a routed-but-unwired one gets
+        a JSON error body."""
+        ext, _names, _engine = _extender_with_engine(num_nodes=8)
+        server = Server(ext, metrics_provider=ext.metrics_text)
+        request = HTTPRequest(
+            method="POST",
+            path="/debug/nonexistent",
+            headers={"Content-Type": "application/json"},
+            body=b"{}",
+        )
+        response = server.route(request)
+        assert response.status == 404
+        assert response.body == b""

@@ -149,6 +149,20 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_slo_families_declared(self):
+        """ISSUE 10: the SLO engine's metric families are part of the
+        declared inventory (docs/observability.md "SLOs & error
+        budgets")."""
+        expected = {
+            "pas_slo_compliance": "gauge",
+            "pas_slo_error_budget_remaining": "gauge",
+            "pas_slo_burn_rate": "gauge",
+            "pas_slo_breaches_total": "counter",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
     def test_fault_tolerance_families_declared(self):
         """ISSUE 5: the retry/circuit/degraded families are part of the
         declared inventory (docs/robustness.md)."""
