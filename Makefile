@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-wirec trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -114,6 +114,28 @@ test-slo:
 # nodes, verdicts = the SLO engine's judgment (testing/twin.py)
 bench-twin:
 	python -m benchmarks.twin_load
+
+# native wire-path sanitizer gate (docs/architecture.md "The wire
+# path"): compile _wirec with -fsanitize=address,undefined and run the
+# wire-path suites — scanner strictness, universe interning/refcounts,
+# the differential fuzzer — against the instrumented artifact via the
+# PAS_TPU_WIREC_SO loader hook.  libstdc++ rides LD_PRELOAD next to
+# libasan so XLA's C++ exceptions resolve real___cxa_throw before the
+# interceptor asserts on it; leak detection stays off (CPython itself
+# "leaks" interned state at exit) — ASan still reports heap overflows,
+# use-after-free, and double-free, UBSan everything undefined.
+WIREC_SAN_SO := $(abspath build/_wirec_sanitized.so)
+test-wirec:
+	mkdir -p build
+	$(CC) -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+		-shared -fPIC \
+		-I$$(python -c 'import sysconfig; print(sysconfig.get_paths()["include"])') \
+		platform_aware_scheduling_tpu/native/wirec.c -o $(WIREC_SAN_SO)
+	env LD_PRELOAD="$$($(CC) -print-file-name=libasan.so) $$($(CC) -print-file-name=libstdc++.so)" \
+		ASAN_OPTIONS=detect_leaks=0:halt_on_error=1 \
+		PAS_TPU_WIREC_SO=$(WIREC_SAN_SO) \
+		python -m pytest tests/test_wirec.py tests/test_wire_universe.py \
+		tests/test_wire_fuzz.py -q
 
 # metric-name convention gate (docs/observability.md): every emitted
 # metric is declared in trace.METRICS, pas_-prefixed snake_case, no

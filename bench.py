@@ -365,6 +365,18 @@ def assemble_line(
                 "speedup_p99"
             ):
                 result[key] = value
+    # the wire-path floor next to the filter-miss speedup it caps: the
+    # cold span-cache-miss verb total vs the intern-hit (warm-universe)
+    # splice floor (configs.filter_floor_breakdown; ISSUE 11 acceptance:
+    # warm < 250 us at 10k nodes)
+    floor = (configs_out or {}).get("filter_floor_breakdown") or {}
+    if floor.get("warm_verb_total_us"):
+        result["filter_floor_cold_us"] = floor.get("verb_total_us")
+        result["filter_floor_warm_us"] = floor.get("warm_verb_total_us")
+        result["filter_floor_warm_parse_us"] = floor.get("warm_parse_us")
+        result["filter_floor_warm_splice_us"] = floor.get(
+            "warm_partition_encode_us"
+        )
     result.update(headline)
     return result, detail
 
@@ -625,6 +637,18 @@ def main():
         from benchmarks import configs as config_benches
 
         configs_out = config_benches.run_all()
+        floor = configs_out.get("filter_floor_breakdown") or {}
+        if floor.get("warm_verb_total_us"):
+            # the wire-path floor behind the filter_nodenames_miss
+            # speedup tier: cold miss vs intern-hit splice
+            print(
+                f"filter floor: cold {floor.get('verb_total_us')} us -> "
+                f"warm-universe {floor.get('warm_verb_total_us')} us "
+                f"(parse {floor.get('warm_parse_us')} + splice "
+                f"{floor.get('warm_partition_encode_us')}; prioritize "
+                f"warm {floor.get('warm_prioritize_verb_us')} us)",
+                file=sys.stderr,
+            )
     except Exception as exc:  # config benches must never sink the headline
         print(f"config benches failed: {exc}", file=sys.stderr)
 

@@ -152,10 +152,37 @@ def check_front_end(serving: str) -> str:
         assert "pas_slo_compliance" in families, (
             f"{serving}: wired engine's gauges missing from /metrics"
         )
+        # wire-path caches: 200 with universe/skeleton state on a device
+        # extender (404 belongs to host-only assemblies, pinned in tests)
+        assert "/debug/wire" in paths, f"{serving}: index missing wire"
+        status, payload = _get(port, "/debug/wire")
+        assert status == 200, f"{serving}: /debug/wire -> {status}"
+        wire = json.loads(payload)
+        assert "counters" in wire and "skeletons" in wire, wire
+        from platform_aware_scheduling_tpu.native import get_wirec
+
+        wire_note = "wire interning unavailable (no C toolchain)"
+        if get_wirec() is not None and hasattr(get_wirec(), "UniverseCache"):
+            # repeat the same span so the intern path demonstrably
+            # engages (1st sighted above via prioritize, 2nd interns,
+            # 3rd hits); without a native toolchain the endpoint still
+            # answers (enabled=false) and the smoke stays green — every
+            # native surface here degrades, none hard-fails
+            for _ in range(3):
+                status, _ = _post(port, "/scheduler/prioritize", body)
+                assert status == 200
+            status, payload = _get(port, "/debug/wire")
+            wire = json.loads(payload)
+            assert wire["enabled"] is True, wire
+            assert wire["counters"]["hits"] >= 1, (
+                f"{serving}: repeated span never hit the universe cache: "
+                f"{wire['counters']}"
+            )
+            wire_note = f"wire intern hits={wire['counters']['hits']}"
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "
-            f"{len(families)} metric families)"
+            f"{len(families)} metric families, {wire_note})"
         )
     finally:
         server.shutdown()

@@ -86,6 +86,7 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/forecast", "description": "per-metric forecast fits: slopes, horizons, uncertainty bands (404 when --forecast=off)"},
     {"path": "/debug/leader", "description": "leader-election state: role, lease holder, fencing token (404 when --leaderElect is off)"},
     {"path": "/debug/slo", "description": "SLO compliance, error budgets, and multi-window burn rates (404 when --slo=off)"},
+    {"path": "/debug/wire", "description": "wire-path caches: interned node-name universes, intern hit/miss/eviction counts, response-skeleton keys (404 without a device fastpath)"},
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
 ]
 
@@ -497,6 +498,25 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=slo_engine.to_json(),
+            )
+        if bare_path == "/debug/wire":
+            # wire-path cache state (tas/fastpath.py wire_debug): interned
+            # universes, intern counters, skeleton keys; 404 when the
+            # scheduler has no device fastpath (host-only TAS, or GAS)
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            fastpath = getattr(self.scheduler, "fastpath", None)
+            if fastpath is None:
+                return HTTPResponse.json(
+                    b'{"error": "no device fastpath (host-only mode)"}\n',
+                    status=404,
+                )
+            import json
+
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=json.dumps(fastpath.wire_debug()).encode() + b"\n",
             )
         if bare_path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of

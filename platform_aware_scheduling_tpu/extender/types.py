@@ -234,6 +234,34 @@ class Args:
             node_names = fixed
         return cls(pod=pod, nodes=nodes, node_names=node_names)
 
+    @classmethod
+    def from_parsed(cls, parsed, node_names) -> "Args":
+        """Args from the native wire view of a NodeNames-mode request
+        (``_wirec.ParsedArgs``) plus an already-materialized candidate
+        list — typically an interned universe's shared name tuple
+        (native/wirec.c), so a repeat request builds ZERO per-name
+        Python objects.
+
+        Content parity with :meth:`from_json` holds for every field the
+        Filter path reads (pod name/namespace, the ``telemetry-policy``
+        label, the candidate names): the scanner captures them with the
+        same Go decode rules this decoder applies, and the scanner
+        REJECTS (ValueError -> exact path) every body where the two
+        could diverge.  Fields the wire view does not retain (other pod
+        labels, pod spec) are absent — callers gate on that (gang-
+        labeled bodies never take this path,
+        telemetryscheduler._host_filter_shortcut)."""
+        metadata: Dict[str, Any] = {}
+        if parsed.pod_name is not None:
+            metadata["name"] = parsed.pod_name
+        if parsed.pod_namespace is not None:
+            metadata["namespace"] = parsed.pod_namespace
+        label = parsed.policy_label
+        if label is not None:
+            metadata["labels"] = {"telemetry-policy": label}
+        pod = Pod({"metadata": metadata} if metadata else {})
+        return cls(pod=pod, nodes=None, node_names=node_names)
+
     def to_json(self) -> bytes:
         nodes = None
         if self.nodes is not None:

@@ -120,6 +120,22 @@ def get_wirec(allow_build: bool = True):
     with _lock:
         if _loaded:
             return _module
+        override = os.environ.get("PAS_TPU_WIREC_SO")
+        if override:
+            # dev/CI hook (make test-wirec): load EXACTLY this artifact,
+            # bypassing the content-hash gate — how the sanitizer build
+            # (-fsanitize=address,undefined) runs the wire-path tests
+            # against instrumented code.  Never set in production.  An
+            # EXPLICIT override that fails to import must raise, not
+            # degrade: swallowing it would turn the whole sanitizer CI
+            # gate green while the tests skip on get_wirec() is None,
+            # having exercised zero native code.
+            spec = importlib.util.spec_from_file_location("_wirec", override)
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+            _loaded = True
+            _module = module
+            return _module
         try:
             so = _so_path()
         except OSError:

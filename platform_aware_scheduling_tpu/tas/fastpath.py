@@ -75,6 +75,20 @@ def _response_cache_size(default: int = 32) -> int:
     return value if value >= 1 else default
 
 
+def _universe_cache_size(default: int = 8) -> int:
+    """PAS_TPU_UNIVERSE_CACHE: universes kept per fastpath (each holds
+    the raw candidate span + slices + encode metadata — ~0.5 MB at 10k
+    nodes).  ``0`` disables interning entirely (the wire then serves
+    exactly the pre-universe span-cache paths, byte-identical — pinned
+    by tests/test_wire_universe.py); malformed values fall back."""
+    raw = os.environ.get("PAS_TPU_UNIVERSE_CACHE", "")
+    try:
+        value = int(raw)
+    except ValueError:
+        return default
+    return value if value >= 0 else default
+
+
 class _ViewTable:
     """Per-interning-version request-time tables: name->row index,
     pre-rendered JSON fragments (Python path), and the native NameTable
@@ -130,10 +144,16 @@ class PrioritizeFastPath:
     # by more than 8 interleaved candidate sets; override via
     # PAS_TPU_RESPONSE_CACHE for constrained deployments.
     RESPONSE_CACHE_SIZE = _response_cache_size()
+    # interned node-name universes kept (bounded MRU, wirec.c; 0 = off)
+    UNIVERSE_CACHE_SIZE = _universe_cache_size()
 
     def __init__(self):
         self._lock = threading.Lock()
         self._table: Optional[_ViewTable] = None
+        # _wirec.UniverseCache, created lazily on the first probe (the
+        # native module may not be loadable at construction time); False
+        # marks "tried and unavailable" so the probe stays O(1)
+        self._universes = None
         # (row_content_version, metric_row, op) -> int64 np global order
         self._rank: Dict[Tuple[int, int, int], np.ndarray] = {}
         # (row-version tuple, rows, ruleset tensors) -> (frozenset of
@@ -163,6 +183,18 @@ class PrioritizeFastPath:
         # reservation state a gang-mode response encoded (None = no gang
         # tracker), so a reservation change can never serve stale bytes
         self._filter_responses: List[list] = []
+        # pre-rendered response skeletons, the universe-keyed layer UNDER
+        # the span caches: once a request's candidate span is interned
+        # (wirec.c UniverseCache), the full response body is keyed by
+        # OBJECT IDENTITY — (violation set, universe, gang reservation
+        # version) for Filter, (ranking, table, planned row, universe)
+        # for Prioritize — so a warm hit costs identity compares instead
+        # of a span memcmp, and any state change (new frozenset / new
+        # ranking / new reservation version) misses by construction.
+        # Entries: [violations, universe, gang_version, body, n_failed]
+        # and [ranked, table, planned_row, universe, body].
+        self._filter_skeletons: List[list] = []
+        self._prioritize_skeletons: List[list] = []
         # merged (telemetry + gang reservation) Filter verdicts, one per
         # (violation-set identity, reservation version, policy):
         # [violations, version, policy, merged frozenset, merged reasons,
@@ -290,6 +322,189 @@ class PrioritizeFastPath:
                 if k[0] == tuple(view.row_version(r) for r in k[1])
             }
 
+    # -- universe interning ----------------------------------------------------
+
+    def universe_probe(self, wirec, parsed, use_node_names: bool):
+        """The interned universe for this request's candidate span, or
+        None (cold span, interning disabled, or an old native artifact
+        without universe support).  A span is interned on its SECOND
+        sighting (the cache's once-seen digest ring), so one-shot
+        candidate lists never pay intern/evict churn.  Counters:
+        ``pas_wire_intern_{hits,misses,evictions}_total`` partition every
+        probe against an available cache into hit/miss (evictions ride
+        along).  Never raises into the verb."""
+        cache = self._universe_cache(wirec)
+        if cache is None:
+            return None
+        try:
+            # ONE digest pass covers hit lookup, the once-seen check, and
+            # a second-sighting intern (wirec.c UniverseCache.probe)
+            universe, interned, evicted = cache.probe(parsed, use_node_names)
+            if universe is not None and not interned:
+                trace.COUNTERS.inc("pas_wire_intern_hits_total")
+                return universe
+            trace.COUNTERS.inc("pas_wire_intern_misses_total")
+            if evicted:
+                trace.COUNTERS.inc("pas_wire_intern_evictions_total", evicted)
+            # freshly interned (or first sighting, None): the request
+            # itself still renders, but may promote a span-cache body
+            return universe
+        except Exception:
+            return None  # interning is an optimization, never a failure
+
+    def _universe_cache(self, wirec):
+        cache = self._universes
+        if cache is not None:
+            return cache or None  # False = tried, unavailable
+        if (
+            self.UNIVERSE_CACHE_SIZE <= 0
+            or wirec is None
+            or not hasattr(wirec, "UniverseCache")
+        ):
+            self._universes = False
+            return None
+        with self._lock:
+            if self._universes is None:
+                self._universes = wirec.UniverseCache(
+                    capacity=self.UNIVERSE_CACHE_SIZE
+                )
+            return self._universes or None
+
+    def warm_skeletons(
+        self,
+        wirec,
+        compiled: CompiledPolicy,
+        view: DeviceView,
+        policy_name: str,
+        filter_ok: bool = True,
+        prioritize_ok: bool = True,
+    ) -> int:
+        """Pre-render response skeletons for every interned NodeNames
+        universe at the CURRENT state — called from the state-refresh
+        warm pass (MetricsExtender.warm_fastpath), so a metric refresh
+        that mints a new violation set / ranking re-renders each live
+        universe's body ONCE off the request path and the first request
+        of the sync window still splices.  Only the no-gang keys are
+        warmed (gang reservation versions move between warm passes; a
+        gang-mode miss renders on demand as before).  Returns the number
+        of bodies rendered; never raises past the warm pass's guard."""
+        cache = self._universes
+        if (
+            not cache
+            or wirec is None
+            or not hasattr(wirec, "filter_respond")
+        ):
+            return 0
+        rendered = 0
+        table = self._table_for(view)
+        n_rows = len(table.node_names)
+        native = table.native(wirec)
+        violations = None
+        reasons = None
+        if filter_ok:
+            counted = self._violation_set_counted(compiled, view)
+            if counted is not None:
+                violations, rule_map = counted[0]
+                reasons = self.reason_table(
+                    compiled, view, policy_name, violations, rule_map,
+                    n_rows,
+                )
+        ranked = None
+        if prioritize_ok and compiled.scheduleonmetric_row >= 0:
+            ranked = self._ranking(
+                view,
+                compiled.scheduleonmetric_row,
+                compiled.scheduleonmetric_op,
+            )
+        for universe in cache.snapshot():
+            if not universe.use_node_names:
+                continue
+            if violations is not None:
+                with self._lock:
+                    have = any(
+                        entry[0] is violations
+                        and entry[1] is universe
+                        and entry[2] is None
+                        for entry in self._filter_skeletons
+                    )
+                if not have:
+                    mask = self._violation_mask(violations, n_rows)
+                    body, n_failed = wirec.filter_respond(
+                        universe, native, mask, reasons
+                    )
+                    self.filter_store(
+                        violations, True, None, body, n_failed, None,
+                        universe=universe,
+                    )
+                    rendered += 1
+            if ranked is not None:
+                with self._lock:
+                    have = any(
+                        entry[0] is ranked
+                        and entry[1] is table
+                        and entry[2] == -1
+                        and entry[3] is universe
+                        for entry in self._prioritize_skeletons
+                    )
+                if not have:
+                    body = wirec.select_encode_universe(
+                        universe, native, ranked, -1
+                    )
+                    with self._lock:
+                        self._prioritize_skeletons.insert(
+                            0, [ranked, table, -1, universe, body]
+                        )
+                        del self._prioritize_skeletons[
+                            self.RESPONSE_CACHE_SIZE :
+                        ]
+                    rendered += 1
+        return rendered
+
+    def wire_debug(self) -> Dict:
+        """The /debug/wire payload: universe-cache occupancy + interning
+        counters + the skeleton-cache keys (universe uid, violation-set
+        size, gang version / planned row) — the operator's view of why a
+        request was cold, interned, or spliced."""
+        out: Dict = {
+            "enabled": bool(self._universes),
+            "capacity": self.UNIVERSE_CACHE_SIZE,
+            "counters": {
+                "hits": trace.COUNTERS.get("pas_wire_intern_hits_total"),
+                "misses": trace.COUNTERS.get("pas_wire_intern_misses_total"),
+                "evictions": trace.COUNTERS.get(
+                    "pas_wire_intern_evictions_total"
+                ),
+            },
+        }
+        cache = self._universes
+        if not cache:
+            out["occupancy"] = 0
+            out["universes"] = []
+        else:
+            out["occupancy"] = cache.occupancy
+            out["universes"] = cache.universes()
+        with self._lock:
+            out["skeletons"] = {
+                "filter": [
+                    {
+                        "universe": entry[1].uid,
+                        "violating": len(entry[0]),
+                        "gang_version": entry[2],
+                        "bytes": len(entry[3]),
+                    }
+                    for entry in self._filter_skeletons
+                ],
+                "prioritize": [
+                    {
+                        "universe": entry[3].uid,
+                        "planned_row": entry[2],
+                        "bytes": len(entry[4]),
+                    }
+                    for entry in self._prioritize_skeletons
+                ],
+            }
+        return out
+
     # -- prioritize ------------------------------------------------------------
 
     def prioritize_parsed(
@@ -301,13 +516,18 @@ class PrioritizeFastPath:
         planned: Optional[str] = None,
         use_node_names: bool = False,
         span=trace.NULL_SPAN,
+        universe=None,
     ) -> bytes:
         """Native variant: candidate lookup + selection + byte assembly all
         happen in ``_wirec.select_encode`` over the parsed body's zero-copy
         name slices — no per-node Python objects at any point.  When the
         request's raw candidate span matches a cached one under the same
         ranking/table/plan, the stored response is returned without any
-        selection or encoding at all (see _responses)."""
+        selection or encoding at all (see _responses).  With an interned
+        ``universe`` the skeleton layer serves first — identity compares
+        only, no span memcmp — and a miss renders through the universe's
+        cached row map (``select_encode_universe``, zero hashing); either
+        way the bytes are identical to the span path's."""
         table = self._table_for(view)
         with span.stage("kernel"):
             ranked = self._ranking(
@@ -319,6 +539,20 @@ class PrioritizeFastPath:
         if planned is not None:
             planned_row = table.node_index.get(planned, -1)
         with self._lock:
+            if universe is not None:
+                skeletons = self._prioritize_skeletons
+                for idx, entry in enumerate(skeletons):
+                    if (
+                        entry[0] is ranked
+                        and entry[1] is table
+                        and entry[2] == planned_row
+                        and entry[3] is universe
+                    ):
+                        if idx:
+                            skeletons.insert(0, skeletons.pop(idx))
+                        span.set("fastpath", "hit")
+                        trace.COUNTERS.inc("pas_fastpath_response_hit_total")
+                        return entry[4]
             responses = self._responses
             for idx, entry in enumerate(responses):
                 if (
@@ -329,15 +563,40 @@ class PrioritizeFastPath:
                 ):
                     if idx:  # move to front (MRU)
                         responses.insert(0, responses.pop(idx))
+                    if universe is not None:
+                        # promote the span-cached body into the skeleton
+                        # layer so the next warm request skips the memcmp
+                        self._prioritize_skeletons.insert(
+                            0,
+                            [ranked, table, planned_row, universe, entry[4]],
+                        )
+                        del self._prioritize_skeletons[
+                            self.RESPONSE_CACHE_SIZE :
+                        ]
                     span.set("fastpath", "hit")
                     trace.COUNTERS.inc("pas_fastpath_response_hit_total")
                     return entry[4]
         span.set("fastpath", "miss")
         trace.COUNTERS.inc("pas_fastpath_response_miss_total")
         with span.stage("encode"):
-            response = wirec.select_encode(
-                parsed, table.native(wirec), ranked, planned_row, use_node_names
-            )
+            if universe is not None and hasattr(
+                wirec, "select_encode_universe"
+            ):
+                response = wirec.select_encode_universe(
+                    universe, table.native(wirec), ranked, planned_row
+                )
+            else:
+                response = wirec.select_encode(
+                    parsed, table.native(wirec), ranked, planned_row,
+                    use_node_names,
+                )
+        if universe is not None:
+            with self._lock:
+                self._prioritize_skeletons.insert(
+                    0, [ranked, table, planned_row, universe, response]
+                )
+                del self._prioritize_skeletons[self.RESPONSE_CACHE_SIZE :]
+            return response
         # cand_span: the request's raw candidate byte-span (the cache key)
         # — distinct from the trace `span` parameter above
         cand_span = (
@@ -603,12 +862,16 @@ class PrioritizeFastPath:
         compiled: Optional[CompiledPolicy] = None,
         policy_name: str = "",
         reason_table: Optional[list] = None,
+        universe=None,
     ) -> Tuple[bytes, int]:
         """Native NodeNames-mode Filter response: candidate row lookup,
         violation partition, and byte assembly all happen in
         ``_wirec.filter_encode`` over the parsed body's zero-copy name
         slices — the Filter analog of :meth:`prioritize_parsed` (byte
-        parity with the exact path pinned by tests/test_wirec.py).
+        parity with the exact path pinned by tests/test_wirec.py).  With
+        an interned ``universe``, ``_wirec.filter_respond`` partitions
+        over the universe's cached row map instead (one int32 read per
+        candidate, zero hashing) — identical bytes by construction.
 
         Returns ``(body, failed count)``.  With ``compiled`` given, the
         FailedNodes values carry the concrete per-rule reason strings
@@ -626,6 +889,10 @@ class PrioritizeFastPath:
                 reasons = self.reason_table(
                     compiled, view, policy_name, violations, rule_map, n_rows
                 )
+        if universe is not None and hasattr(wirec, "filter_respond"):
+            return wirec.filter_respond(
+                universe, table.native(wirec), mask, reasons
+            )
         return wirec.filter_encode(parsed, table.native(wirec), mask, reasons)
 
     def gang_merged(
@@ -702,11 +969,26 @@ class PrioritizeFastPath:
         use_node_names: bool,
         parsed,
         gang_version: Optional[int] = None,
+        universe=None,
     ) -> Optional[Tuple[bytes, int]]:
         """Cached (response bytes, failed count) for this exact candidate
         span under this exact violation set (and, in gang mode, this
-        exact reservation version), or None."""
+        exact reservation version), or None.  With an interned
+        ``universe`` the skeleton layer is probed first (identity
+        compares, no span memcmp); a span-layer hit is promoted into it
+        so the next warm request splices without touching the span."""
         with self._lock:
+            if universe is not None:
+                skeletons = self._filter_skeletons
+                for idx, entry in enumerate(skeletons):
+                    if (
+                        entry[0] is violations
+                        and entry[1] is universe
+                        and entry[2] == gang_version
+                    ):
+                        if idx:
+                            skeletons.insert(0, skeletons.pop(idx))
+                        return entry[3], entry[4]
             responses = self._filter_responses
             for idx, entry in enumerate(responses):
                 if (
@@ -717,6 +999,13 @@ class PrioritizeFastPath:
                 ):
                     if idx:
                         responses.insert(0, responses.pop(idx))
+                    if universe is not None:
+                        self._filter_skeletons.insert(
+                            0,
+                            [violations, universe, gang_version, entry[3],
+                             entry[4]],
+                        )
+                        del self._filter_skeletons[self.RESPONSE_CACHE_SIZE :]
                     return entry[3], entry[4]
         return None
 
@@ -728,7 +1017,15 @@ class PrioritizeFastPath:
         body: bytes,
         n_failed: int = 0,
         gang_version: Optional[int] = None,
+        universe=None,
     ) -> None:
+        if universe is not None:
+            with self._lock:
+                self._filter_skeletons.insert(
+                    0, [violations, universe, gang_version, body, n_failed]
+                )
+                del self._filter_skeletons[self.RESPONSE_CACHE_SIZE :]
+            return
         span = (
             parsed.node_names_span() if use_node_names else parsed.nodes_span()
         )

@@ -73,6 +73,21 @@ import jax.numpy as jnp
 TAS_POLICY_LABEL = "telemetry-policy"
 
 
+class _HostArgsShortcut:
+    """Probe result marking a host-only-policy request whose candidate
+    span is interned: the verb runs the EXACT Python filter flow over
+    these Args (built from the native wire view + the universe's
+    interned name tuple) instead of re-decoding the full body with
+    json.loads.  Wire bytes are identical by construction — the Args
+    content matches what the exact decode would produce for every field
+    the Filter path reads."""
+
+    __slots__ = ("args",)
+
+    def __init__(self, args: Args):
+        self.args = args
+
+
 class MetricsExtender:
     """extender.Scheduler implementation for TAS
     (reference telemetryscheduler.go:25-34)."""
@@ -181,12 +196,29 @@ class MetricsExtender:
                 for compiled in policies.values()
                 if self._prioritize_device_eligible(compiled, host_only)
             }
-            fastpath.precompute(view, pairs, wirec=get_wirec())
+            wirec = get_wirec()
+            fastpath.precompute(view, pairs, wirec=wirec)
             for (_ns, name), compiled in policies.items():
-                if self._filter_device_eligible(compiled, host_only):
+                filter_ok = self._filter_device_eligible(compiled, host_only)
+                if filter_ok:
                     # one call warms the violation set AND its decoded
                     # provenance (reason strings keyed by policy name)
                     fastpath.violation_reasons(compiled, view, name)
+                if self.gangs is None:
+                    # pre-render response skeletons for every interned
+                    # universe at the NEW state, so the first request of
+                    # the sync window still splices (a metric refresh
+                    # mints a new violation-set/ranking identity; without
+                    # this, one request per window pays the re-render).
+                    # Gang mode skips: the skeleton key carries the live
+                    # reservation version, which moves between passes.
+                    fastpath.warm_skeletons(
+                        wirec, compiled, view, name,
+                        filter_ok=filter_ok,
+                        prioritize_ok=self._prioritize_device_eligible(
+                            compiled, host_only
+                        ),
+                    )
             if self.forecaster is not None:
                 # forecast rankings warm AFTER precompute (whose pruning
                 # keeps only real-view entries); the forecast view's
@@ -466,11 +498,23 @@ class MetricsExtender:
             # is a bypass, so hit+miss+bypass counts each request once
             if isinstance(probe, HTTPResponse):
                 return probe
+            args_override = None
+            if isinstance(probe, _HostArgsShortcut):
+                # host-only policy over an interned span: the exact flow
+                # below runs on Args built from the wire view — same
+                # bytes out, no 10k-name json.loads in (still counted a
+                # bypass: the span caches cannot serve host verdicts)
+                args_override = probe.args
+                probe = None
             if probe is None:
                 span.set("filter_cache", "bypass")
                 trace.COUNTERS.inc("pas_filter_cache_bypass_total")
             with span.stage("decode"):
-                args = self._decode(request)
+                args = (
+                    args_override
+                    if args_override is not None
+                    else self._decode(request)
+                )
             if args is None:
                 return HTTPResponse()
             gang_codes: Dict[str, int] = {}
@@ -484,10 +528,13 @@ class MetricsExtender:
             with span.stage("encode"):
                 body = result.to_json()
             if probe is not None:
-                parsed, violations, use_node_names, gang_version = probe
+                parsed, violations, use_node_names, gang_version, universe = (
+                    probe
+                )
                 self.fastpath.filter_store(
                     violations, use_node_names, parsed, body,
                     len(result.failed_nodes), gang_version,
+                    universe=universe,
                 )
             if decisions.DECISIONS.enabled:
                 path = span.attrs.get("filter_cache", "exact")
@@ -592,7 +639,12 @@ class MetricsExtender:
                 return None
             compiled, view = self._device_policy(policy)
             if compiled is None or not self._device_filter_ok(compiled):
-                return None
+                # host-only policy: the span caches cannot serve (the
+                # verdict is host-computed), but an interned span still
+                # spares the exact path its full json.loads
+                return self._host_filter_shortcut(
+                    wirec, parsed, use_node_names, span
+                )
             # one call resolves the violation set AND its decoded per-node
             # provenance (the shared reason map the wire FailedNodes and
             # the decision records both reference)
@@ -602,6 +654,10 @@ class MetricsExtender:
             if explained is None:
                 return None
             violations, reasons, _indexes = explained
+            with span.stage("intern"):
+                universe = self.fastpath.universe_probe(
+                    wirec, parsed, use_node_names
+                )
             gang_version = None
             reason_table = None
             if gang_token is not None:
@@ -620,7 +676,8 @@ class MetricsExtender:
                 parsed.num_node_names if use_node_names else parsed.num_nodes
             )
             cached = self.fastpath.filter_lookup(
-                violations, use_node_names, parsed, gang_version
+                violations, use_node_names, parsed, gang_version,
+                universe=universe,
             )
             if cached is not None:
                 body, n_failed = cached
@@ -636,16 +693,20 @@ class MetricsExtender:
                 # natively (row lookup + violation partition + byte
                 # assembly in C) instead of paying the exact path's
                 # full Python decode; the result seeds the span cache.
-                # The miss counts ONLY once the encode succeeded — a
-                # raise here lands in the outer except -> None -> the
-                # caller counts it a bypass, never miss+bypass
+                # With an interned universe the partition runs over its
+                # cached row map (filter_respond — zero hashing) and the
+                # body seeds the skeleton layer instead.  The miss
+                # counts ONLY once the encode succeeded — a raise here
+                # lands in the outer except -> None -> the caller counts
+                # it a bypass, never miss+bypass
                 body, n_failed = self.fastpath.filter_parsed(
                     wirec, view, parsed, violations, compiled, policy.name,
                     reason_table=reason_table,
+                    universe=universe if use_node_names else None,
                 )
                 self.fastpath.filter_store(
                     violations, use_node_names, parsed, body, n_failed,
-                    gang_version,
+                    gang_version, universe=universe,
                 )
                 span.set("filter_cache", "miss")
                 trace.COUNTERS.inc("pas_filter_cache_miss_total")
@@ -658,7 +719,7 @@ class MetricsExtender:
             # response via the returned token — still a miss
             span.set("filter_cache", "miss")
             trace.COUNTERS.inc("pas_filter_cache_miss_total")
-            return parsed, violations, use_node_names, gang_version
+            return parsed, violations, use_node_names, gang_version, universe
         except (ValueError, TypeError):
             return None
         except Exception as exc:
@@ -667,6 +728,26 @@ class MetricsExtender:
             # owns the response — same invariant Prioritize keeps
             klog.error("filter cache probe failed, exact path: %s", exc)
             return None
+
+    def _host_filter_shortcut(
+        self, wirec, parsed, use_node_names: bool, span
+    ) -> Optional[_HostArgsShortcut]:
+        """Args for a host-only-policy Filter over an interned span, or
+        None (exact decode serves).  Only NodeNames-mode bodies qualify —
+        a Nodes-mode response echoes the request's node OBJECTS, which
+        the native wire view does not retain.  The returned Args feed
+        the unchanged exact flow (_filter_nodes, violated_details), so
+        bytes match the exact path by construction; the interned name
+        tuple replaces a per-request 10k-string json.loads."""
+        if not use_node_names or self.fastpath is None:
+            return None
+        with span.stage("intern"):
+            universe = self.fastpath.universe_probe(
+                wirec, parsed, use_node_names
+            )
+        if universe is None:
+            return None
+        return _HostArgsShortcut(Args.from_parsed(parsed, universe.names()))
 
     def _record_device_filter(
         self, span, parsed, policy_name, path, candidates, n_failed, reasons
@@ -801,12 +882,16 @@ class MetricsExtender:
         candidates = (
             parsed.num_node_names if use_node_names else parsed.num_nodes
         )
+        with span.stage("intern"):
+            universe = self.fastpath.universe_probe(
+                wirec, parsed, use_node_names
+            )
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:
                 rank_view = self._forecast_rank_view(compiled) or view
                 body = self.fastpath.prioritize_parsed(
                     wirec, compiled, rank_view, parsed, planned,
-                    use_node_names, span=span,
+                    use_node_names, span=span, universe=universe,
                 )
                 span.set("path", "native")
                 if rank_view is not view:
@@ -822,11 +907,18 @@ class MetricsExtender:
             except Exception as exc:
                 trace.COUNTERS.inc("pas_prioritize_host_fallback_total")
                 klog.error("native prioritize failed, host fallback: %s", exc)
-        # host-only policy/metric: exact host semantics over the parsed names
+        # host-only policy/metric: exact host semantics over the parsed
+        # names — served from the universe's interned tuple when warm
+        # (zero per-request unicode materialization)
         span.set("path", "native_host")
-        names = (
-            parsed.node_names_list() if use_node_names else parsed.node_names()
-        )
+        if universe is not None:
+            names = universe.names()
+        else:
+            names = (
+                parsed.node_names_list()
+                if use_node_names
+                else parsed.node_names()
+            )
         with span.stage("kernel"):
             result = self._apply_plan(pod, self._prioritize_host(rule, names))
         with span.stage("encode"):

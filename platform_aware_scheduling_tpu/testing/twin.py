@@ -129,6 +129,7 @@ class TwinCluster(HAHarness):
         period_s: float = 5.0,
         requests_per_tick: int = 2,
         latency_threshold_ms: float = 25.0,
+        wire_slo_us: float = 500.0,
         hysteresis_cycles: int = 2,
         max_moves: int = 8,
         groups: int = 8,
@@ -236,6 +237,52 @@ class TwinCluster(HAHarness):
                         threshold_s=latency_threshold_ms / 1e3,
                     )
                 )
+            if wire_slo_us > 0:
+                # the wire-path floor gate (ISSUE 11): the PR-10 sub-ms
+                # histogram bounds resolve 250/500/750 us, so a Filter
+                # verb regressing past the interned-universe floor fails
+                # the diurnal scenario (DiurnalLoad gates compliance on
+                # these).  Objective 0.9, not 0.99: in-process twin
+                # verbs jitter under test-runner load, and at 0.9 the
+                # page tier is unreachable (burn 14.4 x 0.1 > 1), so
+                # only the diurnal compliance gate — never a paging
+                # false alarm in other scenarios — enforces the floor.
+                slos.append(
+                    SLO(
+                        name="filter_wire",
+                        sli="latency",
+                        objective=0.9,
+                        description=(
+                            f"Filter wire floor: p90 under "
+                            f"{wire_slo_us:g} us"
+                        ),
+                        verbs=("filter",),
+                        threshold_s=wire_slo_us / 1e6,
+                    )
+                )
+                if self.gas is not None:
+                    # a MEDIAN gate (objective 0.5), not p90: the GAS
+                    # lane's host-loop verb idles at 250-400 us — within
+                    # a CPU-contended test runner's jitter of the 500 us
+                    # threshold (a full-suite tier-1 run measured p90
+                    # grazing it on a healthy build).  Tail noise cannot
+                    # move a median; a real wire-path regression shifts
+                    # the whole distribution past the threshold and
+                    # still fails.  The TAS filter_wire gate above keeps
+                    # p90 — the interned floor leaves it 3-5x headroom.
+                    slos.append(
+                        SLO(
+                            name="gas_filter_wire",
+                            sli="latency",
+                            objective=0.5,
+                            description=(
+                                f"GAS Filter wire floor: median under "
+                                f"{wire_slo_us:g} us"
+                            ),
+                            verbs=("gas_filter",),
+                            threshold_s=wire_slo_us / 1e6,
+                        )
+                    )
             recorders = [s.extender.recorder for s in self.replicas if s]
             if self.gas is not None:
                 recorders.append(self.gas.recorder)
@@ -624,7 +671,16 @@ class DiurnalLoad(Scenario):
         twin.set_base_load(loads)
 
     def checks(self, twin: TwinCluster) -> List[Dict]:
-        checks = self.slo_gates(twin, compliant=_CORE_SLOS)
+        # the wire-path floor SLOs gate HERE, in the null-hypothesis
+        # scenario: a healthy cluster's Filter verbs must sit under the
+        # interned-universe floor (500 us default), so a wire-path
+        # regression fails run_matrix() even when every other SLO holds
+        wire = tuple(
+            name
+            for name in ("filter_wire", "gas_filter_wire")
+            if twin.engine is not None and name in twin.engine.slos
+        )
+        checks = self.slo_gates(twin, compliant=_CORE_SLOS + wire)
         checks.append(
             self._check(
                 "zero_evictions",
@@ -1081,17 +1137,21 @@ def run_matrix(
     period_s: float = 5.0,
     requests_per_tick: int = 2,
     latency_threshold_ms: float = 25.0,
+    wire_slo_us: float = 500.0,
     scenarios: Tuple[Scenario, ...] = DEFAULT_SCENARIOS,
 ) -> Dict:
     """Run every scenario at the given scale; the bench's ``twin``
     section (benchmarks/twin_load.py) reports this matrix.  Fresh
-    scenario INSTANCES per run — scenario objects carry per-run state."""
+    scenario INSTANCES per run — scenario objects carry per-run state.
+    ``wire_slo_us`` tunes the diurnal wire-floor latency gate (0
+    disables it)."""
     scale = {
         "num_nodes": num_nodes,
         "pods": pods if pods is not None else num_nodes,
         "period_s": period_s,
         "requests_per_tick": requests_per_tick,
         "latency_threshold_ms": latency_threshold_ms,
+        "wire_slo_us": wire_slo_us,
     }
     results = {}
     for scenario in scenarios:

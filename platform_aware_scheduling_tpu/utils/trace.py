@@ -91,6 +91,13 @@ declare("pas_fastpath_response_miss_total", "counter", "Prioritize response-reus
 declare("pas_filter_cache_hit_total", "counter", "Filter response cache hits.")
 declare("pas_filter_cache_miss_total", "counter", "Filter cacheable requests that missed the response cache.")
 declare("pas_filter_cache_bypass_total", "counter", "Filter requests not cacheable (host-only policy, odd shapes, no native scanner).")
+# interned node-name universes (native/wirec.c UniverseCache via
+# tas/fastpath.py).  hits+misses partition every probe against an
+# available universe cache; evictions count universes dropped past the
+# MRU bound (PAS_TPU_UNIVERSE_CACHE).
+declare("pas_wire_intern_hits_total", "counter", "Candidate-span universe-cache hits (digest + memcmp-verified).")
+declare("pas_wire_intern_misses_total", "counter", "Candidate-span universe-cache misses (cold span, or first sighting before interning).")
+declare("pas_wire_intern_evictions_total", "counter", "Interned universes evicted past the MRU bound.")
 declare("pas_gas_filter_device_total", "counter", "GAS Filter requests served by the vmapped device binpack.")
 declare("pas_gas_filter_host_total", "counter", "GAS Filter requests served by the host loop.")
 # JAX compile visibility (watch_jit shim + jax.monitoring listeners)

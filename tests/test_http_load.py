@@ -60,6 +60,10 @@ class TestHttpLoadHarness:
             "partition_encode_us",
             "verb_total_us",
             "nodes_hit_verb_us",
+            "warm_parse_us",
+            "warm_partition_encode_us",
+            "warm_verb_total_us",
+            "warm_prioritize_verb_us",
             "control_filter_ms",
             "http_floor_us",
         ):

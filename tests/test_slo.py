@@ -684,7 +684,7 @@ class TestDebugIndexCompleteness:
         "/healthz", "/readyz", "/metrics", "/debug/traces",
         "/debug/decisions", "/debug/rebalance", "/debug/gangs",
         "/debug/forecast", "/debug/leader", "/debug/slo",
-        "/debug/profile",
+        "/debug/wire", "/debug/profile",
     }
 
     def test_index_names_every_debug_route(self):

@@ -163,6 +163,18 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_wire_intern_families_declared(self):
+        """ISSUE 11: the universe-interning counters are part of the
+        declared inventory (docs/architecture.md "The wire path")."""
+        expected = {
+            "pas_wire_intern_hits_total": "counter",
+            "pas_wire_intern_misses_total": "counter",
+            "pas_wire_intern_evictions_total": "counter",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
     def test_fault_tolerance_families_declared(self):
         """ISSUE 5: the retry/circuit/degraded families are part of the
         declared inventory (docs/robustness.md)."""
