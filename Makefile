@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-wirec trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record bench-replay test-wirec trace-lint obs-smoke lint image clean dryrun
 
 all: test
 
@@ -114,6 +114,19 @@ test-slo:
 # nodes, verdicts = the SLO engine's judgment (testing/twin.py)
 bench-twin:
 	python -m benchmarks.twin_load
+
+# flight recorder + trace replay + what-if suite (docs/observability.md
+# "Flight recorder & what-if"): anonymization sweep over real sockets,
+# /debug/record + /debug/whatif codes, off-path byte-identity, the
+# record->export->parse->replay round trip, and the hermetic overhead pin
+test-record:
+	python -m pytest tests/test_record.py -q -m 'not slow'
+
+# replay throughput (legacy vs vectorized twin load model) + the
+# what-if demo: 2x load must degrade the availability verdict a 1x
+# replay keeps green (testing/replay.py)
+bench-replay:
+	python -c "import json; from benchmarks.twin_load import replay_report; print(json.dumps(replay_report(), indent=2))"
 
 # native wire-path sanitizer gate (docs/architecture.md "The wire
 # path"): compile _wirec with -fsanitize=address,undefined and run the

@@ -170,7 +170,7 @@ def _detail_path(round_override=None) -> str:
 def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
     chaos=None, decisions=None, gang=None, forecast=None, ha=None,
-    twin=None,
+    twin=None, record=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -346,6 +346,38 @@ def assemble_line(
             "num_nodes": twin.get("num_nodes"),
             "all_passed": twin.get("all_passed"),
             "matrix": twin.get("matrix"),
+        }
+        replay = twin.get("replay")
+        if replay:
+            # the ISSUE 13 headline: round-trip fidelity rides the
+            # matrix (replayed_diurnal); the line adds replay throughput
+            # before/after vectorization and the 2x what-if verdict
+            result["twin"]["replay"] = {
+                "num_nodes": replay.get("num_nodes"),
+                "ticks_per_s_legacy": replay.get("ticks_per_s_legacy"),
+                "ticks_per_s_vectorized": replay.get(
+                    "ticks_per_s_vectorized"
+                ),
+                "vectorized_speedup": replay.get("vectorized_speedup"),
+                "whatif_degraded_at_2x": (
+                    replay.get("whatif") or {}
+                ).get("degraded_at_2x"),
+            }
+    if record is not None:
+        # full pair-ratio lists + capture scrape to disk; the line keeps
+        # the hermetic per-request delta (the stable number) next to the
+        # wire A/B p99 percentages (the ISSUE 13 acceptance bar: <= 5%)
+        detail["record"] = record
+        inproc = record.get("inprocess") or {}
+        result["record"] = {
+            "prioritize_delta_us": inproc.get("prioritize_delta_us"),
+            "filter_delta_us": inproc.get("filter_delta_us"),
+            "overhead_pct_prioritize_p99": record.get(
+                "overhead_pct_prioritize_p99"
+            ),
+            "overhead_pct_filter_p99": record.get(
+                "overhead_pct_filter_p99"
+            ),
         }
     if load is not None:
         # structural note: the filter MISS tier is ratio-capped independent
@@ -623,13 +655,39 @@ def main():
             f"{name}={'pass' if entry['passed'] else 'FAIL'}"
             for name, entry in sorted(twin_out["matrix"].items())
         )
+        rep = twin_out.get("replay") or {}
         print(
             f"twin: {twin_out['num_nodes']} nodes, "
-            f"{twin_out['wall_s']}s wall — {compact}",
+            f"{twin_out['wall_s']}s wall — {compact}; replay "
+            f"{rep.get('num_nodes')} nodes "
+            f"{rep.get('ticks_per_s_legacy')} -> "
+            f"{rep.get('ticks_per_s_vectorized')} ticks/s "
+            f"({rep.get('vectorized_speedup')}x), 2x what-if "
+            f"degraded={(rep.get('whatif') or {}).get('degraded_at_2x')}",
             file=sys.stderr,
         )
     except Exception as exc:  # must never sink the headline
         print(f"twin bench failed: {exc}", file=sys.stderr)
+
+    # --- flight recorder: hermetic per-request delta (gc-fenced
+    # interleaved on/off batches — the stable pin) + spawned wire p99
+    # A/B at 10k nodes (benchmarks/http_load.py;
+    # docs/observability.md "Flight recorder & what-if") ---
+    record_out = None
+    try:
+        record_out = http_load.record_overhead(num_nodes=NUM_NODES)
+        inproc = record_out.get("inprocess") or {}
+        print(
+            f"record: in-process delta prioritize "
+            f"{inproc.get('prioritize_delta_us')} us / filter "
+            f"{inproc.get('filter_delta_us')} us per request "
+            f"(recorder on vs off); wire p99 A/B prioritize "
+            f"{record_out['overhead_pct_prioritize_p99']}% / filter "
+            f"{record_out['overhead_pct_filter_p99']}%",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"record bench failed: {exc}", file=sys.stderr)
 
     # --- BASELINE configs #2/#3/#4/#5 + solver surface ---
     configs_out = None
@@ -654,7 +712,7 @@ def main():
 
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
-        decisions_out, gang, forecast_out, ha_out, twin_out,
+        decisions_out, gang, forecast_out, ha_out, twin_out, record_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

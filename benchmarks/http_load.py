@@ -137,12 +137,19 @@ def build_service(
     seed: int = 3,
     serving: str = "threaded",
     forecast: bool = False,
+    flight: bool = False,
 ):
     """(server, node names) — a live unsafe-HTTP extender over a seeded
     cache (see build_extender).  ``serving="async"`` serves through the
     event-loop micro-batching front-end (docs/serving.md) instead of the
-    reference-parity threaded server."""
+    reference-parity threaded server.  ``flight=True`` wires a
+    FlightRecorder (--flightRecorder=on analog) so the recorder A/B can
+    flip it per service subprocess."""
     ext, names = build_extender(num_nodes, device, seed, forecast=forecast)
+    if flight:
+        from platform_aware_scheduling_tpu.utils.record import FlightRecorder
+
+        ext.flight = FlightRecorder()
     if serving == "async":
         from platform_aware_scheduling_tpu.serving import AsyncServer
 
@@ -422,6 +429,7 @@ def _serve_forever(
     serving: str = "threaded",
     decisions_enabled: bool = True,
     forecast: bool = False,
+    flight: bool = False,
 ) -> None:
     """Subprocess entry: start the service, print ``READY <port>``, block.
     The server gets its own process (and GIL) — in-process serving would
@@ -444,7 +452,11 @@ def _serve_forever(
         server, _ = builder(num_nodes, device=device)
     else:
         server, _ = build_service(
-            num_nodes, device=device, serving=serving, forecast=forecast
+            num_nodes,
+            device=device,
+            serving=serving,
+            forecast=forecast,
+            flight=flight,
         )
     devicewatch.DeviceWatcher(period_s=2.0).start()
     tune_for_serving()
@@ -459,6 +471,7 @@ def _spawn_service(
     serving: str = "threaded",
     decisions_enabled: bool = True,
     forecast: bool = False,
+    flight: bool = False,
 ) -> tuple:
     """(process, port) for an isolated service subprocess running
     ``python -m <module> --serve`` (shared by the GAS A/B)."""
@@ -476,6 +489,7 @@ def _spawn_service(
             serving,
             "1" if decisions_enabled else "0",
             "1" if forecast else "0",
+            "1" if flight else "0",
         ],
         stdout=subprocess.PIPE,
         text=True,
@@ -951,6 +965,161 @@ def decision_overhead(
     return out
 
 
+def record_overhead(
+    num_nodes: int = 10_000,
+    requests: int = 400,
+    warmup: int = 5,
+    repeats: int = 3,
+) -> Dict:
+    """Flight-recorder A/B (ISSUE 13 acceptance: recorder-on p99 within
+    5% of off): serving p99 with --flightRecorder on vs off — same
+    device service, same bodies, same raw-socket client, prioritize AND
+    filter at c=1 on the primary NodeNames hit tier (smallest
+    per-request cost, therefore the harshest relative-overhead lens,
+    exactly like the decision-provenance A/B above).  The ON side also
+    scrapes GET /debug/record so BENCH_DETAIL shows the ring actually
+    captured the driven traffic, not just that it cost nothing.
+
+    Unlike the decision A/B, the repeat loop is OUTSIDE the spawn: a
+    fresh pair of interleaved service processes per repeat, best-of
+    across them — the recorder's true per-request cost (~3 us, one
+    lock + deque append + counter) is an order of magnitude below
+    spawn-to-spawn placement variance at this scale, so a single
+    unlucky process would otherwise read as phantom overhead."""
+    names = node_names(num_nodes)
+    bodies = make_bodies(names, "nodenames")
+    out: Dict = {"num_nodes": num_nodes, "on": {}, "off": {}}
+    pair_ratios: Dict[str, List[float]] = {
+        "prioritize": [], "filter": []
+    }
+    for _rep in range(max(repeats, 1)):
+        pair: Dict[str, Dict[str, Dict]] = {}
+        for label, enabled in (("on", True), ("off", False)):
+            proc, port = _spawn_service(
+                num_nodes, device=True, flight=enabled
+            )
+            try:
+                side = out[label]
+                pair[label] = {}
+                for verb in ("prioritize", "filter"):
+                    drive(
+                        port, bodies[:5], warmup, concurrency=1,
+                        path=_PATHS[verb],
+                    )
+                    measured = drive(
+                        port, bodies, requests, concurrency=1,
+                        path=_PATHS[verb],
+                    )
+                    pair[label][verb] = measured
+                    side[verb] = (
+                        measured
+                        if verb not in side
+                        else _best_of(side[verb], measured)
+                    )
+                if enabled:
+                    status, payload = http_get(port, "/debug/record")
+                    capture: Dict = {"status": status}
+                    if status == 200:
+                        lines = payload.decode().splitlines()
+                        header = json.loads(lines[0])
+                        verbs = sum(
+                            1
+                            for line in lines[1:]
+                            if json.loads(line).get("kind") == "verb"
+                        )
+                        capture.update(
+                            {
+                                "format": header.get("format"),
+                                "events": header.get("events"),
+                                "dropped": header.get("dropped"),
+                                "verb_events": verbs,
+                            }
+                        )
+                    side["capture"] = capture
+            finally:
+                proc.terminate()
+                proc.wait(timeout=10)
+        for verb in ("prioritize", "filter"):
+            pair_ratios[verb].append(
+                pair["on"][verb]["p99_ms"] / pair["off"][verb]["p99_ms"]
+            )
+    # paired estimator: each repeat's on/off spawns run back to back and
+    # share ambient machine conditions, so the per-pair p99 ratio cancels
+    # temporal drift; the MEDIAN pair resists the one pair that still
+    # caught a noise burst (best-of-p99 across unpaired spawns does not:
+    # a single calm spawn on either side skews the division)
+    for verb in ("prioritize", "filter"):
+        ratios = sorted(pair_ratios[verb])
+        median = ratios[len(ratios) // 2]
+        out[f"overhead_pct_{verb}_p99"] = round((median - 1.0) * 100.0, 1)
+        out[f"pair_ratios_{verb}_p99"] = [round(r, 3) for r in ratios]
+    # the hermetic companion number: on shared/noisy machines the wire
+    # A/B's spawn variance can exceed the recorder's whole cost, so the
+    # in-process delta is the authoritative per-request figure
+    out["inprocess"] = record_inprocess_overhead(num_nodes)
+    return out
+
+
+def record_inprocess_overhead(
+    num_nodes: int = 10_000, batches: int = 14, per_batch: int = 50
+) -> Dict:
+    """Hermetic recorder cost: mean per-request microseconds with the
+    recorder wired vs not — interleaved batches in ONE process, median
+    of batch means per side, so machine drift hits both sides equally
+    and the delta isolates the recorder itself (stash + ring append +
+    counters).  This is the stable pin behind the <=5% acceptance
+    figure; the wire A/B above contextualizes it against full HTTP
+    request cost."""
+    from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+    from platform_aware_scheduling_tpu.utils.record import FlightRecorder
+
+    ext, names = build_extender(num_nodes, device=True)
+    bodies = make_bodies(names, "nodenames")
+
+    def req(body, path):
+        return HTTPRequest(
+            method="POST",
+            path=path,
+            headers={"Content-Type": "application/json"},
+            body=body,
+        )
+
+    out: Dict = {"num_nodes": num_nodes}
+    recorder = FlightRecorder()
+    import gc
+
+    for verb in ("prioritize", "filter"):
+        path = _PATHS[verb]
+        handler = getattr(ext, verb)
+        for body in bodies[:5]:
+            handler(req(body, path))
+        means: Dict[str, List[float]] = {"on": [], "off": []}
+        for batch in range(batches):
+            label = "on" if batch % 2 == 0 else "off"
+            ext.flight = recorder if label == "on" else None
+            # a GC pause inside one side's batch would dwarf the whole
+            # recorder cost, so collect up front and time gc-free
+            gc.collect()
+            gc.disable()
+            try:
+                t0 = time.perf_counter()
+                for i in range(per_batch):
+                    handler(req(bodies[i % len(bodies)], path))
+                means[label].append(
+                    (time.perf_counter() - t0) / per_batch * 1e6
+                )
+            finally:
+                gc.enable()
+        on = sorted(means["on"])[len(means["on"]) // 2]
+        off = sorted(means["off"])[len(means["off"]) // 2]
+        out[f"{verb}_on_mean_us"] = round(on, 1)
+        out[f"{verb}_off_mean_us"] = round(off, 1)
+        out[f"{verb}_delta_us"] = round(on - off, 1)
+        out[f"{verb}_overhead_pct"] = round((on / off - 1.0) * 100.0, 1)
+    ext.flight = None
+    return out
+
+
 if __name__ == "__main__":
     import sys
 
@@ -963,7 +1132,11 @@ if __name__ == "__main__":
                 sys.argv[5] == "1" if len(sys.argv) > 5 else True
             ),
             forecast=(sys.argv[6] == "1" if len(sys.argv) > 6 else False),
+            flight=(sys.argv[7] == "1" if len(sys.argv) > 7 else False),
         )
+    elif len(sys.argv) > 1 and sys.argv[1] == "--record":
+        nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+        print(json.dumps(record_overhead(num_nodes=nodes), indent=2))
     elif len(sys.argv) > 1 and sys.argv[1] == "--decisions":
         nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
         print(json.dumps(decision_overhead(num_nodes=nodes), indent=2))

@@ -179,10 +179,58 @@ def check_front_end(serving: str) -> str:
                 f"{wire['counters']}"
             )
             wire_note = f"wire intern hits={wire['counters']['hits']}"
+        # flight recorder + what-if: 404 while off (--flightRecorder=off),
+        # then the record -> export -> replay loop end to end: wire a
+        # recorder, drive a verb + one telemetry pass, export the JSONL,
+        # and ask /debug/whatif for a projected 2x-load verdict
+        assert "/debug/record" in paths, f"{serving}: index missing record"
+        status, _payload = _get(port, "/debug/record")
+        assert status == 404, (
+            f"{serving}: /debug/record must 404 while off -> {status}"
+        )
+        status, _payload = _post(port, "/debug/whatif", b"{}")
+        assert status == 404, (
+            f"{serving}: /debug/whatif must 404 while off -> {status}"
+        )
+        from platform_aware_scheduling_tpu.utils.record import (
+            FlightRecorder,
+        )
+
+        flight = FlightRecorder()
+        server.scheduler.flight = flight
+        status, _ = _post(port, "/scheduler/prioritize", body)
+        assert status == 200
+        server.scheduler.cache.write_metric("load_metric")
+        flight.observe_cache(server.scheduler.cache)
+        status, payload = _get(port, "/debug/record")
+        assert status == 200, f"{serving}: /debug/record -> {status}"
+        lines = [
+            json.loads(line) for line in payload.decode().splitlines()
+        ]
+        assert lines[0]["events"] == len(lines) - 1, lines[0]
+        kinds = {event.get("kind") for event in lines[1:]}
+        assert {"verb", "telemetry"} <= kinds, (
+            f"{serving}: capture kinds {kinds}"
+        )
+        spec = json.dumps(
+            {"num_nodes": 8, "max_ticks": 1, "load_multiplier": 2.0}
+        ).encode()
+        status, payload = _post(port, "/debug/whatif", spec)
+        assert status == 200, (
+            f"{serving}: /debug/whatif -> {status}: {payload[:200]!r}"
+        )
+        projection = json.loads(payload)
+        assert projection["verdicts"], projection
+        assert projection["transform"]["load_multiplier"] == 2.0
+        record_note = (
+            f"record events={lines[0]['events']}, "
+            f"whatif slos={len(projection['verdicts'])}"
+        )
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "
-            f"{len(families)} metric families, {wire_note})"
+            f"{len(families)} metric families, {wire_note}, "
+            f"{record_note})"
         )
     finally:
         server.shutdown()

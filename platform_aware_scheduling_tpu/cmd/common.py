@@ -242,6 +242,52 @@ def build_slo_engine(args, extender, cache=None, period_s: float = 5.0):
     return engine
 
 
+def add_record_flags(parser: argparse.ArgumentParser) -> None:
+    """Flight-recorder flag surface shared by both mains
+    (docs/observability.md "Flight recorder & what-if")."""
+    parser.add_argument("--flightRecorder", default="off",
+                        choices=["off", "on"],
+                        help="bounded ring of ANONYMIZED control-plane "
+                        "events (verb arrivals keyed by the interned-"
+                        "universe digest + candidate count, per-refresh "
+                        "telemetry decile curves, eviction/leader flips "
+                        "— never node, pod, or namespace names), "
+                        "exported as versioned JSONL on GET /debug/record "
+                        "and replayable through the digital twin "
+                        "(POST /debug/whatif, python -m ...cmd.whatif). "
+                        "Costs <=5%% serving p99 (pinned by the http_load "
+                        "recorder A/B); off records nothing and 404s "
+                        "both endpoints")
+    parser.add_argument("--recordSize", type=int, default=4096,
+                        help="flight-recorder ring capacity; overflow "
+                        "drops the OLDEST event (the recorder keeps the "
+                        "latest window) and counts it in "
+                        "pas_record_dropped_total")
+
+
+def build_flight_recorder(args, extender, cache=None):
+    """The FlightRecorder for --flightRecorder=on (None when off),
+    attached as ``extender.flight`` (the /debug/record + /debug/whatif +
+    /metrics wiring keys off that attr).  With a telemetry ``cache``
+    (TAS), one ``on_refresh_pass`` subscription summarizes each pass's
+    metric values into decile events and polls the eviction/leadership
+    families — the same hook the forecaster refits on, so control
+    events cost nothing on the request path."""
+    if getattr(args, "flightRecorder", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.utils.record import FlightRecorder
+
+    recorder = FlightRecorder(
+        capacity=getattr(args, "recordSize", 4096)
+    )
+    extender.flight = recorder
+    if cache is not None:
+        cache.on_refresh_pass.append(
+            lambda: recorder.observe_cache(cache)
+        )
+    return recorder
+
+
 def slo_period(args, default_s: float) -> float:
     """The --sloPeriod in seconds (default: the caller's sync period)."""
     raw = getattr(args, "sloPeriod", "")

@@ -55,6 +55,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_forecast_flags(parser, forecast=False)
     common.add_ha_flags(parser, ha=False)
     common.add_slo_flags(parser)
+    common.add_record_flags(parser)
     return parser
 
 
@@ -85,6 +86,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     slo_engine = common.build_slo_engine(args, extender)
     if slo_engine is not None:
         slo_engine.start(common.slo_period(args, 5.0), stop=watch_stop)
+    # flight recorder (--flightRecorder=on): verb arrivals only — GAS
+    # has no telemetry cache, so no decile/control events here
+    common.build_flight_recorder(args, extender)
 
     from platform_aware_scheduling_tpu.cmd.tas import build_server
     from platform_aware_scheduling_tpu.utils.duration import parse_duration

@@ -111,6 +111,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_forecast_flags(parser)
     common.add_ha_flags(parser)
     common.add_slo_flags(parser)
+    common.add_record_flags(parser)
     return parser
 
 
@@ -358,6 +359,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     slo_engine = common.build_slo_engine(args, extender, cache=cache)
     if slo_engine is not None:
         slo_engine.start(common.slo_period(args, sync_period_s), stop=stop)
+
+    # flight recorder (--flightRecorder=on; docs/observability.md
+    # "Flight recorder & what-if"): anonymized verb/telemetry/control
+    # events into a bounded ring behind GET /debug/record and
+    # POST /debug/whatif.  Off (the default) builds nothing — the verbs
+    # skip one attribute check and the wire stays byte-identical
+    common.build_flight_recorder(args, extender, cache=cache)
 
     common.maybe_start_profiler(args.profilePort)
     common.start_device_watch(stop=stop)

@@ -1,0 +1,118 @@
+"""What-if CLI: replay a flight-recorder capture offline.
+
+``python -m platform_aware_scheduling_tpu.cmd.whatif --capture
+capture.jsonl --loadMultiplier 2.0`` is the air-gapped sibling of
+``POST /debug/whatif``: fetch a capture once (``curl
+.../debug/record > capture.jsonl``), then ask what-if questions against
+it from anywhere — no scheduler process needed
+(docs/observability.md "Flight recorder & what-if").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from platform_aware_scheduling_tpu.testing import replay
+from platform_aware_scheduling_tpu.utils import klog
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pas-whatif",
+        description=(
+            "replay a flight-recorder capture through the digital twin "
+            "under transform knobs; prints projected per-SLO verdicts, "
+            "burn rates and budget ledgers as JSON"
+        ),
+    )
+    parser.add_argument(
+        "--capture",
+        required=True,
+        help="path to a /debug/record JSONL capture, or - for stdin",
+    )
+    parser.add_argument(
+        "--loadMultiplier",
+        type=float,
+        default=1.0,
+        help="scale the recorded load surface and verb arrivals",
+    )
+    parser.add_argument(
+        "--removeNodes",
+        type=int,
+        default=0,
+        help="replay with this many fewer nodes than recorded",
+    )
+    parser.add_argument(
+        "--numNodes",
+        type=int,
+        default=None,
+        help="override the recorded node scale entirely",
+    )
+    parser.add_argument(
+        "--maxTicks",
+        type=int,
+        default=None,
+        help="cap the replayed tick count",
+    )
+    parser.add_argument(
+        "--servingCapacity",
+        type=int,
+        default=None,
+        help="per-tick verb admission budget (default: the recorded "
+        "per-tick peak, so 1x sheds nothing)",
+    )
+    parser.add_argument(
+        "--latencyThresholdMs",
+        type=float,
+        default=25.0,
+        help="Prioritize/Filter p99 SLO threshold for the projection",
+    )
+    parser.add_argument(
+        "--wireSloUs",
+        type=float,
+        default=0.0,
+        help="wire-floor SLO threshold in us (0 = off; a replay "
+        "cannot reproduce wall-clock jitter)",
+    )
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--v", type=int, default=1, help="klog verbosity")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    klog.set_verbosity(args.v)
+    if args.capture == "-":
+        text = sys.stdin.read()
+    else:
+        try:
+            with open(args.capture, "r", encoding="utf-8") as handle:
+                text = handle.read()
+        except OSError as exc:
+            print(f"error: cannot read capture: {exc}", file=sys.stderr)
+            return 2
+    spec = {
+        "capture": text,
+        "load_multiplier": args.loadMultiplier,
+        "remove_nodes": args.removeNodes,
+        "num_nodes": args.numNodes,
+        "max_ticks": args.maxTicks,
+        "serving_capacity": args.servingCapacity,
+        "latency_threshold_ms": args.latencyThresholdMs,
+        "wire_slo_us": args.wireSloUs,
+        "seed": args.seed,
+    }
+    try:
+        result = replay.whatif_from_spec(spec)
+    except replay.CaptureError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    print(json.dumps(result, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -88,6 +88,8 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/slo", "description": "SLO compliance, error budgets, and multi-window burn rates (404 when --slo=off)"},
     {"path": "/debug/wire", "description": "wire-path caches: interned node-name universes, intern hit/miss/eviction counts, response-skeleton keys (404 without a device fastpath)"},
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
+    {"path": "/debug/record", "description": "flight-recorder capture as versioned JSONL: anonymized verb arrivals, telemetry deciles, eviction/leader events (404 when --flightRecorder=off)"},
+    {"path": "/debug/whatif", "method": "POST", "description": "twin replay of a capture under transform knobs (load_multiplier, remove_nodes, thresholds): projected SLO verdicts + budget ledgers (404 when --flightRecorder=off)"},
 ]
 
 #: index paths that must stay readable when the async admission queue is
@@ -95,11 +97,13 @@ DEBUG_ENDPOINTS = [
 #: diagnose exactly that condition and never touch the device).  Derived
 #: from the index above so a new endpoint cannot be routed here but
 #: silently left queued (or unindexed) on the async front-end;
-#: /debug/profile is excluded because its bounded capture SLEEPS and the
-#: async front-end must run it off-loop (serving/http.py special-cases it).
+#: /debug/profile is excluded because its bounded capture SLEEPS, and
+#: /debug/whatif because it RUNS a twin replay — both must execute
+#: off-loop on the async front-end (serving/http.py special-cases them).
+EXECUTOR_DEBUG_PATHS = frozenset({"/debug/profile", "/debug/whatif"})
 QUEUE_BYPASS_PATHS = frozenset(
     entry["path"] for entry in DEBUG_ENDPOINTS
-    if entry["path"] != "/debug/profile"
+    if entry["path"] not in EXECUTOR_DEBUG_PATHS
 ) | {"/debug", "/debug/"}
 
 
@@ -517,6 +521,71 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=json.dumps(fastpath.wire_debug()).encode() + b"\n",
+            )
+        if bare_path == "/debug/record":
+            # flight-recorder export (utils/record.py): versioned JSONL
+            # of anonymized events; 404 when no recorder is wired
+            # (--flightRecorder=off), the off-path convention
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            flight = getattr(self.scheduler, "flight", None)
+            if flight is None:
+                return HTTPResponse.json(
+                    b'{"error": "flight recorder not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/x-ndjson"},
+                body=flight.to_jsonl(),
+            )
+        if bare_path == "/debug/whatif":
+            # what-if serving (testing/replay.py): replay a capture
+            # through the digital twin under transform knobs and return
+            # projected SLO verdicts + ledgers.  POST-only — it RUNS a
+            # replay; the async front-end executes it off-loop like
+            # /debug/profile.  404 while no recorder is wired.
+            if request.method != "POST":
+                return HTTPResponse(status=405)
+            flight = getattr(self.scheduler, "flight", None)
+            if flight is None:
+                return HTTPResponse.json(
+                    b'{"error": "flight recorder not configured"}\n',
+                    status=404,
+                )
+            import json
+
+            from platform_aware_scheduling_tpu.testing import replay
+
+            try:
+                spec = json.loads(request.body or b"{}")
+                if not isinstance(spec, dict):
+                    raise ValueError("not an object")
+            except Exception:
+                trace.COUNTERS.inc("pas_whatif_failures_total")
+                return HTTPResponse.json(
+                    b'{"error": "body must be a JSON object"}\n',
+                    status=400,
+                )
+            try:
+                result = replay.whatif_from_spec(spec, flight=flight)
+            except replay.CaptureError as exc:
+                trace.COUNTERS.inc("pas_whatif_failures_total")
+                return HTTPResponse.json(
+                    json.dumps({"error": str(exc)}).encode() + b"\n",
+                    status=400,
+                )
+            except Exception as exc:
+                trace.COUNTERS.inc("pas_whatif_failures_total")
+                klog.error("what-if replay failed: %r", exc)
+                return HTTPResponse.json(
+                    json.dumps({"error": f"replay failed: {exc}"}).encode()
+                    + b"\n",
+                    status=500,
+                )
+            trace.COUNTERS.inc("pas_whatif_runs_total")
+            return HTTPResponse.json(
+                json.dumps(result).encode() + b"\n"
             )
         if bare_path == "/debug/traces":
             # observability extension (utils/trace.py): a bounded ring of

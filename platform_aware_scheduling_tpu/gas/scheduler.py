@@ -122,6 +122,12 @@ class GASExtender:
         # extender's recorder; front-ends serve GET /debug/slo (404
         # while None) and /metrics gains the pas_slo_* gauges
         self.slo = None
+        # opt-in utils.record.FlightRecorder (--flightRecorder=on):
+        # gas_filter/gas_bind arrivals land in the ring as anonymized
+        # (verb, candidate count) events — GAS has no interned-universe
+        # layer, so the universe key is always null here; front-ends
+        # serve GET /debug/record + POST /debug/whatif (404 while None)
+        self.flight = None
         self._device = None
         if use_device:
             # deferred import: keeps the host layer importable without jax
@@ -135,9 +141,22 @@ class GASExtender:
         """The /metrics provider for this extender (utils/trace.py);
         pas_slo_* gauges join only while an SLO engine is wired."""
         counter_sets = [self.slo.counters] if self.slo is not None else []
+        if self.flight is not None:
+            counter_sets.append(self.flight.counters)
         return trace.exposition(
             recorders=[self.recorder], counter_sets=counter_sets
         )
+
+    def _record_flight_verb(self, verb: str, request: HTTPRequest) -> None:
+        """Anonymized arrival event for the verb's finally (candidate
+        count only — never node names); must never raise into the verb."""
+        try:
+            _uid, candidates = getattr(
+                request, "flight_universe", (None, 0)
+            )
+            self.flight.record_verb(verb, None, candidates)
+        except Exception as exc:
+            klog.error("flight record failed: %r", exc)
 
     def readiness_conditions(self):
         """The /readyz conditions GAS contributes (utils/health.py):
@@ -170,6 +189,10 @@ class GASExtender:
                 klog.error("cannot decode request %s", exc)
             if args is None:
                 return HTTPResponse(status=404)
+            if self.flight is not None:
+                request.flight_universe = (
+                    None, len(args.node_names or ())
+                )
             with span.stage("kernel"):
                 result = self._filter_nodes(args, span=span)
             status = 404 if result.error else 200
@@ -178,6 +201,8 @@ class GASExtender:
             return HTTPResponse.json(body, status=status)
         finally:
             self.recorder.observe("gas_filter", time.perf_counter() - start)
+            if self.flight is not None:
+                self._record_flight_verb("gas_filter", request)
 
     def bind(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()
@@ -205,6 +230,8 @@ class GASExtender:
             return HTTPResponse.json(body, status=status)
         finally:
             self.recorder.observe("gas_bind", time.perf_counter() - start)
+            if self.flight is not None:
+                self._record_flight_verb("gas_bind", request)
 
     # -- filter (scheduler.go:447-482) -----------------------------------------
 

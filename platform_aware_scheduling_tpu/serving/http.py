@@ -35,6 +35,7 @@ from typing import Optional
 from platform_aware_scheduling_tpu.extender.server import (
     HTTPRequest,
     HTTPResponse,
+    EXECUTOR_DEBUG_PATHS,
     HeadParseError,
     MAX_HEAD_LENGTH,
     QUEUE_BYPASS_PATHS,
@@ -326,10 +327,11 @@ class AsyncServer:
                     except Exception as exc:
                         klog.error("handler raised: %r", exc)
                         response = HTTPResponse(status=500)
-                elif bare_path == "/debug/profile":
-                    # also bypasses the queue, but the bounded capture
-                    # SLEEPS for the requested window — run it off-loop
-                    # so the event loop keeps serving meanwhile
+                elif bare_path in EXECUTOR_DEBUG_PATHS:
+                    # also bypass the queue, but these BLOCK: the
+                    # bounded profile capture sleeps for its window and
+                    # a what-if runs a whole twin replay — run them
+                    # off-loop so the event loop keeps serving meanwhile
                     try:
                         response = await asyncio.get_running_loop().run_in_executor(
                             None, self._router.route, request

@@ -24,6 +24,20 @@ from platform_aware_scheduling_tpu.utils import klog, trace
 STRATEGY_TYPE = "deschedule"
 
 
+class _BareNode:
+    """A name-only stand-in for a node known to carry none of the
+    registered policy labels (it missed every label-exists selector):
+    the label pass needs only its name to add ``=violating``."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def get_labels(self) -> Dict[str, str]:
+        return {}
+
+
 @dataclass
 class Strategy:
     policy_name: str = ""
@@ -123,8 +137,10 @@ class Strategy:
     # -- enforcement (enforce.go) --------------------------------------------
 
     def enforce(self, enforcer: core.MetricEnforcer, cache) -> int:
-        """List all nodes, compute per-policy violations, patch labels
-        (enforce.go:57-71).
+        """Compute per-policy violations, list the nodes whose labels
+        can change, patch labels (enforce.go:57-71; see
+        :meth:`_nodes_needing_labels` for the deliberate divergence from
+        the reference's list-every-node loop).
 
         Hard invariant (docs/robustness.md): while the degraded-mode
         controller reports evictions suspended — telemetry stale or the
@@ -177,12 +193,12 @@ class Strategy:
                     self._node_status_for_strategy(enforcer, cache),
                 )
                 return 0
+        violations = self._node_status_for_strategy(enforcer, cache)
         try:
-            nodes = enforcer.kube_client.list_nodes()
+            nodes = self._nodes_needing_labels(enforcer, violations)
         except Exception as exc:
             klog.v(2).info_s(f"cannot list nodes: {exc}", component="controller")
             raise
-        violations = self._node_status_for_strategy(enforcer, cache)
         try:
             total = self._update_node_labels(enforcer, violations, nodes)
         finally:
@@ -228,6 +244,29 @@ class Strategy:
     ) -> None:
         enforcer.kube_client.patch_node(node_name, payload)
 
+    def _nodes_needing_labels(
+        self, enforcer: core.MetricEnforcer, violations: Dict[str, List[str]]
+    ):
+        """Only the nodes whose label state can change this cycle: any
+        node carrying a registered policy's label (the remove/re-add-
+        "null" dance, enforce.go:118-132) plus the violating nodes
+        themselves.  The reference lists EVERY node each cycle; at 100k
+        nodes that is a full-cluster copy per enforcement pass to build
+        payloads that are empty on all but a handful.  A label-exists
+        selector asks the API server for exactly the candidate set, and
+        the final label state is identical — a node matching neither
+        list got an empty payload (a no-op patch) before."""
+        candidates: Dict[str, object] = {}
+        for policy_name in self._all_policies(enforcer):
+            for node in enforcer.kube_client.list_nodes(
+                label_selector=policy_name
+            ):
+                candidates[node.name] = node
+        for name in violations:
+            if name not in candidates:
+                candidates[name] = _BareNode(name)
+        return list(candidates.values())
+
     def _all_policies(self, enforcer: core.MetricEnforcer) -> Dict[str, None]:
         return {
             strat.get_policy_name(): None
@@ -264,9 +303,11 @@ class Strategy:
         violations: Dict[str, List[str]],
         all_nodes,
     ) -> int:
-        """Patch every node: violating policies -> add ``=violating``;
-        registered-but-not-violating policies whose label is present ->
-        remove + re-add as "null" (enforce.go:99-151)."""
+        """Patch the candidate nodes: violating policies -> add
+        ``=violating``; registered-but-not-violating policies whose
+        label is present -> remove + re-add as "null"
+        (enforce.go:99-151).  Empty payloads are skipped — a no-op
+        patch costs an API round trip and changes nothing."""
         total_violations = 0
         label_errs = ""
         for node in all_nodes:
@@ -299,6 +340,10 @@ class Strategy:
             # inside the non-violated loop returned the number of
             # non-violating registered policies per node instead
             total_violations += len(violations.get(node.name, []))
+            if not payload:
+                # an empty JSON patch changes nothing: spare the API
+                # server the round trip entirely
+                continue
             try:
                 self._patch_node(node.name, enforcer, payload)
             except Exception as exc:

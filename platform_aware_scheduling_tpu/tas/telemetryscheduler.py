@@ -144,6 +144,16 @@ class MetricsExtender:
         # touches the request path either way (docs/observability.md
         # "SLOs & error budgets")
         self.slo = None
+        # opt-in utils.record.FlightRecorder, set by assembly when
+        # --flightRecorder=on: the verbs append one anonymized arrival
+        # event each (universe digest + candidate count, never names),
+        # the telemetry refresh pass appends decile summaries, and the
+        # front-ends serve GET /debug/record + POST /debug/whatif (404
+        # while this is None).  Off (None) costs the verbs a single
+        # attribute check and keeps the wire byte-identical — pinned by
+        # tests/test_record.py.  NOT self.recorder: that name is the
+        # latency-histogram LatencyRecorder above.
+        self.flight = None
         # opt-in tas.degraded.DegradedModeController, set by assembly:
         # when telemetry goes stale or a circuit opens, Filter fails
         # open/closed per --degradedMode and Prioritize degrades to
@@ -379,9 +389,47 @@ class MetricsExtender:
         engine is wired — its pas_slo_* gauges (the engine owns its own
         CounterSet precisely so --slo=off emits nothing)."""
         counter_sets = [self.slo.counters] if self.slo is not None else []
+        if self.flight is not None:
+            counter_sets.append(self.flight.counters)
         return trace.exposition(
             recorders=[self.recorder], counter_sets=counter_sets
         )
+
+    def _record_flight_verb(self, verb: str, request: HTTPRequest) -> None:
+        """One anonymized arrival event in the verb's finally: the
+        universe digest + candidate count stashed by the wire path (or
+        nulls — the recorder never hashes names itself) and the gang
+        size stashed by the exact decode.  Must never raise into the
+        verb."""
+        try:
+            uid, candidates = getattr(
+                request, "flight_universe", (None, 0)
+            )
+            self.flight.record_verb(
+                verb,
+                uid,
+                candidates,
+                getattr(request, "flight_gang", 0),
+            )
+        except Exception as exc:
+            klog.error("flight record failed: %r", exc)
+
+    def _stash_flight_exact(
+        self, request: HTTPRequest, args, candidates: Optional[int] = None
+    ) -> None:
+        """Exact-path stash for the flight recorder: candidate count
+        (unless the wire path already stashed an interned key) and the
+        pod's gang size — the one pod-shape label a capture keeps."""
+        try:
+            if not hasattr(request, "flight_universe"):
+                if candidates is None:
+                    candidates = len(self._candidate_names(args))
+                request.flight_universe = (None, int(candidates))
+            gang = args.pod.get_labels().get(shared_labels.GANG_SIZE_LABEL)
+            if gang:
+                request.flight_gang = int(gang)
+        except Exception:
+            pass
 
     def prioritize(self, request: HTTPRequest) -> HTTPResponse:
         start = time.perf_counter()
@@ -412,11 +460,15 @@ class MetricsExtender:
             if isinstance(decoded, HTTPResponse):
                 return decoded
             args, names, status = decoded
+            if self.flight is not None:
+                self._stash_flight_exact(request, args, candidates=len(names))
             return HTTPResponse.json(
                 self._prioritize_body(args, names, span=span), status=status
             )
         finally:
             self.recorder.observe("prioritize", time.perf_counter() - start)
+            if self.flight is not None:
+                self._record_flight_verb("prioritize", request)
 
     def _decode_prioritize_args(self, request: HTTPRequest, span):
         """The exact path's decode quirks, shared with the degraded
@@ -517,6 +569,8 @@ class MetricsExtender:
                 )
             if args is None:
                 return HTTPResponse()
+            if self.flight is not None:
+                self._stash_flight_exact(request, args)
             gang_codes: Dict[str, int] = {}
             with span.stage("kernel"):
                 result = self._filter_nodes(
@@ -570,6 +624,8 @@ class MetricsExtender:
             return HTTPResponse.json(body)
         finally:
             self.recorder.observe("filter", time.perf_counter() - start)
+            if self.flight is not None:
+                self._record_flight_verb("filter", request)
 
     def _gang_cache_token(self, request: HTTPRequest):
         """(reservation version, held map) when this request may use the
@@ -675,6 +731,14 @@ class MetricsExtender:
             candidates = (
                 parsed.num_node_names if use_node_names else parsed.num_nodes
             )
+            if self.flight is not None:
+                # the anonymized arrival key for the verb's finally: the
+                # interned digest (or None on a cold span) + the count —
+                # computed here where both already exist, O(1)
+                request.flight_universe = (
+                    universe.uid if universe is not None else None,
+                    int(candidates),
+                )
             cached = self.fastpath.filter_lookup(
                 violations, use_node_names, parsed, gang_version,
                 universe=universe,
@@ -885,6 +949,11 @@ class MetricsExtender:
         with span.stage("intern"):
             universe = self.fastpath.universe_probe(
                 wirec, parsed, use_node_names
+            )
+        if self.flight is not None:
+            request.flight_universe = (
+                universe.uid if universe is not None else None,
+                int(candidates),
             )
         if compiled is not None and self._device_prioritize_ok(compiled, rule):
             try:

@@ -194,18 +194,51 @@ class FakeKubeClient:
 
     def list_nodes(self, label_selector: Optional[str] = None) -> List[Node]:
         self._fault("list_nodes")
-        with self._lock:
-            nodes = [Node(copy.deepcopy(raw)) for raw in self._nodes.values()]
+        # selector pushdown, like the real API server: match on the raw
+        # labels FIRST and deepcopy only the hits — a label-filtered
+        # list over 100k nodes copies a handful, not the cluster.
+        # ``k=v`` matches equality; a bare ``k`` is the exists matcher.
+        want: Dict[str, Optional[str]] = {}
         if label_selector:
-            want = dict(
-                part.split("=", 1) for part in label_selector.split(",") if "=" in part
-            )
-            nodes = [
-                n
-                for n in nodes
-                if all(n.get_labels().get(k) == v for k, v in want.items())
-            ]
-        return nodes
+            for part in label_selector.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if "=" in part:
+                    key, value = part.split("=", 1)
+                    want[key] = value
+                else:
+                    want[part] = None
+        with self._lock:
+            if not want:
+                return [
+                    Node(copy.deepcopy(raw)) for raw in self._nodes.values()
+                ]
+            matched = []
+            if len(want) == 1:
+                # single-term selector (the enforcement path's exists
+                # query) gets a branch-free scan: one dict dig per node
+                (key, value), = want.items()
+                for raw in self._nodes.values():
+                    meta = raw.get("metadata")
+                    labels = meta.get("labels") if meta is not None else None
+                    if not labels:
+                        continue
+                    if value is None:
+                        if key in labels:
+                            matched.append(Node(copy.deepcopy(raw)))
+                    elif labels.get(key) == value:
+                        matched.append(Node(copy.deepcopy(raw)))
+                return matched
+            for raw in self._nodes.values():
+                labels = (raw.get("metadata") or {}).get("labels") or {}
+                if all(
+                    (key in labels if value is None
+                     else labels.get(key) == value)
+                    for key, value in want.items()
+                ):
+                    matched.append(Node(copy.deepcopy(raw)))
+            return matched
 
     def get_node(self, name: str) -> Node:
         self._fault("get_node")

@@ -260,6 +260,25 @@ class FaultyClient:
         return call
 
 
+#: shared NodeMetric per integer value: the twin's vectorized
+#: publication path reuses ONE object per distinct load instead of
+#: parsing a Quantity string per node per tick (readers only ever call
+#: value.milli_value_exact(), never mutate).  Bounded so a pathological
+#: value stream cannot grow it without limit.
+_INT_METRIC_MEMO: Dict[int, NodeMetric] = {}
+_INT_METRIC_MEMO_MAX = 1 << 16
+
+
+def int_node_metric(value: int) -> NodeMetric:
+    value = int(value)
+    metric = _INT_METRIC_MEMO.get(value)
+    if metric is None:
+        metric = NodeMetric(value=Quantity(str(value)))
+        if len(_INT_METRIC_MEMO) < _INT_METRIC_MEMO_MAX:
+            _INT_METRIC_MEMO[value] = metric
+    return metric
+
+
 class FakeMetricsClient:
     """In-memory custom-metrics API double speaking the
     ``tas.metrics.Client`` protocol, with the FaultPlan hook
@@ -288,6 +307,16 @@ class FakeMetricsClient:
                 node: NodeMetric(value=Quantity(str(value)))
                 for node, value in values.items()
             }
+
+    def set_all_metrics(
+        self, metric: str, values: Dict[str, NodeMetric]
+    ) -> None:
+        """Vectorized set_all: the caller supplies prebuilt (typically
+        memo-shared, see :func:`int_node_metric`) NodeMetric objects, so
+        publishing a 100k-node surface costs one dict copy, not 100k
+        Quantity parses."""
+        with self._lock:
+            self.store[metric] = dict(values)
 
     def get_node_metric(self, metric_name: str) -> NodeMetricsInfo:
         if self.fault_plan is not None:

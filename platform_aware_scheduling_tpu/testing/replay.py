@@ -1,0 +1,657 @@
+"""Trace replay: a flight-recorder capture becomes a twin scenario
+(docs/observability.md "Flight recorder & what-if").
+
+The recorder (utils/record.py) keeps an anonymized ring of what a
+front-end actually saw: verb arrivals, per-refresh telemetry decile
+curves, eviction and leadership movement.  This module turns that
+capture back into a :class:`~platform_aware_scheduling_tpu.testing.
+twin.TwinCluster` program:
+
+  * :func:`parse_capture` — validate the versioned JSONL (or an
+    in-process recorder / decoded dict) and infer the replay timeline:
+    node scale from the telemetry passes' node counts, tick period from
+    the median stamp delta, one replay tick per recorded refresh pass,
+    and the verb arrival shape from how many verbs landed between
+    consecutive passes;
+  * :class:`ReplayScenario` — drive a twin at the recorded scale: each
+    tick interpolates the recorded decile curve across the node axis
+    (the load SHAPE replays; the node->value map never left the
+    process), subtracts the placement-derived pod load so the published
+    surface tracks the recorded one, and pushes the recorded number of
+    verb pairs through the REAL handlers under a per-tick admission
+    budget (``serving_capacity``, default: the recorded per-tick peak —
+    so a 1x replay sheds nothing and a 2x what-if saturates exactly the
+    way AsyncServer's queue would);
+  * :func:`whatif` / :func:`whatif_from_spec` — the ``POST
+    /debug/whatif`` and ``cmd/whatif.py`` engine: capture + transform
+    knobs (load multiplier, node removal, threshold changes) in,
+    projected per-SLO verdicts, burn rates and budget ledgers out, off
+    the serving path;
+  * :class:`ReplayedDiurnal` — the round-trip fidelity gate in the
+    scenario matrix: record a small diurnal run through the production
+    recorder wiring, replay the capture, and require the replay to
+    reproduce the original run's SLO verdicts (alert tiers, compliance,
+    and the final telemetry decile curve).
+
+Like the rest of testing/, importable without jax; building a twin to
+actually replay needs the full stack.
+"""
+
+from __future__ import annotations
+
+import json
+from bisect import bisect_right
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from platform_aware_scheduling_tpu.testing.ha import POD_LOAD
+from platform_aware_scheduling_tpu.utils.record import (
+    FORMAT,
+    QUANTILES,
+    FlightRecorder,
+)
+
+#: the verbs the replay twin can re-drive (its traffic loop speaks the
+#: TAS pair); other recorded verbs (GAS) still count in the stats
+_REPLAY_VERBS = ("prioritize", "filter")
+
+#: endpoint safety rails: /debug/whatif builds a real twin, so a spec
+#: cannot ask for more than this off one POST (CLI callers can override
+#: nothing here — captures themselves are ring-bounded)
+MAX_REPLAY_NODES = 4096
+MAX_REPLAY_TICKS = 2000
+
+#: a replay node hosts at most this many synthesized pods: one below
+#: the twin's node_cap (4) so eviction rebinding always has headroom
+_MAX_PODS_PER_NODE = 3
+
+
+class CaptureError(Exception):
+    """A capture (or what-if spec) that cannot be replayed: wrong
+    format version, no telemetry timeline, malformed knobs."""
+
+
+class Capture:
+    """A parsed capture plus the inferred replay timeline."""
+
+    def __init__(
+        self, events: List[Dict], header: Optional[Dict] = None
+    ):
+        self.header = dict(header or {})
+        fmt = self.header.get("format")
+        if fmt is not None and fmt != FORMAT:
+            raise CaptureError(
+                f"unsupported capture format {fmt!r} (this loader "
+                f"speaks {FORMAT!r})"
+            )
+        if not isinstance(events, list) or not all(
+            isinstance(e, dict) for e in events
+        ):
+            raise CaptureError("capture events must be a list of objects")
+        # stable sort by stamp: rings are appended in clock order, but a
+        # hand-assembled spec may not be
+        self.events = sorted(
+            events, key=lambda e: float(e.get("t", 0.0))
+        )
+        self._infer()
+
+    # -- timeline inference ----------------------------------------------------
+
+    def _infer(self) -> None:
+        telemetry = [
+            e for e in self.events if e.get("kind") == "telemetry"
+        ]
+        if not telemetry:
+            raise CaptureError(
+                "capture contains no telemetry passes; nothing to "
+                "anchor a replay timeline to (record on a TAS "
+                "front-end, whose cache emits them)"
+            )
+        by_metric: Dict[str, int] = {}
+        for e in telemetry:
+            by_metric[e.get("metric", "")] = (
+                by_metric.get(e.get("metric", ""), 0) + 1
+            )
+        #: the replayed metric: the one with the most passes (ties break
+        #: lexicographically for determinism)
+        self.metric = min(
+            by_metric, key=lambda m: (-by_metric[m], m)
+        )
+        self.passes = [
+            e for e in telemetry if e.get("metric") == self.metric
+        ]
+        self.tick_count = len(self.passes)
+        self.num_nodes = max(
+            (int(e.get("nodes", 0)) for e in self.passes), default=0
+        ) or 16
+        stamps = [float(e.get("t", 0.0)) for e in self.passes]
+        deltas = sorted(
+            b - a for a, b in zip(stamps, stamps[1:]) if b > a
+        )
+        self.period_s = (
+            deltas[len(deltas) // 2] if deltas else 5.0
+        )
+        #: the lowest recorded p0: how much of the surface is
+        #: placement-derived floor — the replay synthesizes that many
+        #: pods so rebalance dynamics stay in play
+        self.floor_load = min(
+            float((e.get("deciles") or [0.0])[0]) for e in self.passes
+        )
+        # verb arrival shape: verbs landing between consecutive passes
+        # belong to the window the earlier pass opened (a verb stamped
+        # exactly at a pass follows it within the same twin tick)
+        self.arrivals = [0] * self.tick_count
+        self.verb_counts: Dict[str, int] = {}
+        self.evictions = 0
+        self.leader_flips = 0
+        for e in self.events:
+            kind = e.get("kind")
+            if kind == "verb":
+                verb = str(e.get("verb", ""))
+                self.verb_counts[verb] = self.verb_counts.get(verb, 0) + 1
+                if verb in _REPLAY_VERBS:
+                    window = max(
+                        0,
+                        min(
+                            self.tick_count - 1,
+                            bisect_right(stamps, float(e.get("t", 0.0)))
+                            - 1,
+                        ),
+                    )
+                    self.arrivals[window] += 1
+            elif kind == "eviction":
+                self.evictions += int(e.get("count", 0))
+            elif kind == "leader":
+                self.leader_flips += 1
+
+    def stats(self) -> Dict:
+        """The capture summary a what-if response echoes back."""
+        return {
+            "events": len(self.events),
+            "dropped": int(self.header.get("dropped", 0)),
+            "metric": self.metric,
+            "ticks": self.tick_count,
+            "num_nodes": self.num_nodes,
+            "period_s": round(self.period_s, 6),
+            "verbs": dict(sorted(self.verb_counts.items())),
+            "peak_verbs_per_tick": max(self.arrivals, default=0),
+            "evictions": self.evictions,
+            "leader_flips": self.leader_flips,
+        }
+
+
+def parse_capture(
+    source: Union[bytes, str, Dict, List, FlightRecorder]
+) -> Capture:
+    """Parse any capture shape the system hands around — the
+    ``GET /debug/record`` JSONL (bytes or text), a decoded
+    ``{"format": ..., "events": [...]}`` object, a bare event list, or
+    a live :class:`FlightRecorder` — into a :class:`Capture`.  Raises
+    :class:`CaptureError` on anything unreplayable."""
+    if isinstance(source, FlightRecorder):
+        return Capture(source.events(), header=source.snapshot())
+    if isinstance(source, bytes):
+        try:
+            source = source.decode("utf-8")
+        except UnicodeDecodeError as exc:
+            raise CaptureError(f"capture is not utf-8: {exc}") from exc
+    if isinstance(source, str):
+        header: Optional[Dict] = None
+        events: List[Dict] = []
+        for i, line in enumerate(source.splitlines()):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as exc:
+                raise CaptureError(
+                    f"capture line {i + 1} is not JSON: {exc}"
+                ) from exc
+            if not isinstance(obj, dict):
+                raise CaptureError(
+                    f"capture line {i + 1} is not an object"
+                )
+            if header is None and "format" in obj and "kind" not in obj:
+                header = obj
+            else:
+                events.append(obj)
+        if header is None and not events:
+            raise CaptureError("capture is empty")
+        return Capture(events, header=header)
+    if isinstance(source, dict):
+        events = source.get("events")
+        if not isinstance(events, list):
+            raise CaptureError(
+                'a capture object needs an "events" list'
+            )
+        header = {k: v for k, v in source.items() if k != "events"}
+        return Capture(events, header=header)
+    if isinstance(source, list):
+        return Capture(source)
+    raise CaptureError(
+        f"cannot parse a capture from {type(source).__name__}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the replay scenario
+# ---------------------------------------------------------------------------
+
+
+class ReplayScenario:
+    """A capture replayed through a twin under transform knobs.
+
+    This speaks the :class:`~platform_aware_scheduling_tpu.testing.
+    twin.Scenario` protocol (build/ticks/apply/checks/run) but is
+    parameterized, so it is instantiated explicitly — the matrix's
+    no-arg slot is :class:`ReplayedDiurnal` below."""
+
+    name = "replay"
+
+    def __init__(
+        self,
+        capture: Capture,
+        load_multiplier: float = 1.0,
+        remove_nodes: int = 0,
+        num_nodes: Optional[int] = None,
+        max_ticks: Optional[int] = None,
+        serving_capacity: Optional[int] = None,
+        latency_threshold_ms: float = 25.0,
+        wire_slo_us: float = 0.0,
+        vectorized: bool = True,
+        seed: int = 7,
+    ):
+        if not isinstance(capture, Capture):
+            raise CaptureError("ReplayScenario needs a parsed Capture")
+        if load_multiplier <= 0:
+            raise CaptureError("load_multiplier must be > 0")
+        self.capture = capture
+        self.load_multiplier = float(load_multiplier)
+        self.remove_nodes = max(0, int(remove_nodes))
+        base_nodes = int(num_nodes or capture.num_nodes)
+        self.num_nodes = min(
+            MAX_REPLAY_NODES, max(1, base_nodes - self.remove_nodes)
+        )
+        self.ticks_n = min(
+            capture.tick_count,
+            int(max_ticks) if max_ticks else MAX_REPLAY_TICKS,
+            MAX_REPLAY_TICKS,
+        )
+        if self.ticks_n <= 0:
+            raise CaptureError("capture has no replayable ticks")
+        # admission budget: explicit knob, else the recorded per-tick
+        # peak — the "capacity the recorded service evidently had", so
+        # the 1x replay sheds nothing and multipliers saturate it
+        peak = max(capture.arrivals[: self.ticks_n], default=0)
+        self.serving_capacity = (
+            int(serving_capacity)
+            if serving_capacity is not None
+            else (peak or None)
+        )
+        self.latency_threshold_ms = float(latency_threshold_ms)
+        self.wire_slo_us = float(wire_slo_us)
+        self.vectorized = bool(vectorized)
+        self.seed = int(seed)
+        pods_per_node = min(
+            _MAX_PODS_PER_NODE, int(capture.floor_load // POD_LOAD)
+        )
+        self.pods = max(0, pods_per_node) * self.num_nodes
+        self._quantiles = np.asarray(QUANTILES, dtype=np.float64)
+        self._positions = (
+            np.linspace(0.0, 1.0, self.num_nodes)
+            if self.num_nodes > 1
+            else np.zeros(1)
+        )
+
+    # -- Scenario protocol -----------------------------------------------------
+
+    def build(self, scale: Dict):
+        from platform_aware_scheduling_tpu.testing.twin import TwinCluster
+
+        return TwinCluster(
+            num_nodes=self.num_nodes,
+            pods=self.pods,
+            period_s=self.capture.period_s,
+            requests_per_tick=0,
+            latency_threshold_ms=self.latency_threshold_ms,
+            wire_slo_us=self.wire_slo_us,
+            gas=False,
+            serving_capacity=self.serving_capacity,
+            vectorized=self.vectorized,
+            seed=self.seed,
+        )
+
+    def ticks(self, scale: Dict) -> int:
+        return self.ticks_n
+
+    def apply(self, twin, t: int) -> None:
+        curve = np.asarray(
+            self.capture.passes[t].get("deciles")
+            or [0.0] * len(QUANTILES),
+            dtype=np.float64,
+        )
+        target = (
+            np.interp(self._positions, self._quantiles, curve)
+            * self.load_multiplier
+        )
+        counts = twin._count_vector()
+        base = np.maximum(
+            np.rint(target).astype(np.int64) - counts * POD_LOAD, 0
+        )
+        twin.set_base_load_vector(base)
+        verbs = int(
+            round(self.capture.arrivals[t] * self.load_multiplier)
+        )
+        twin.requests_per_tick = (verbs + 1) // 2
+
+    def checks(self, twin) -> List[Dict]:
+        judgment = twin.judgment()
+        return [
+            {
+                "check": "replay_judged",
+                "ok": bool(judgment) and twin.traffic["requests"] > 0,
+                "detail": (
+                    f"{len(judgment)} slos judged over "
+                    f"{twin.traffic['requests']} replayed requests"
+                ),
+            }
+        ]
+
+    def run(self, scale: Optional[Dict] = None) -> Dict:
+        from platform_aware_scheduling_tpu.testing.twin import Scenario
+
+        return Scenario.run(self, scale)
+
+
+# ---------------------------------------------------------------------------
+# what-if serving
+# ---------------------------------------------------------------------------
+
+
+def whatif(
+    capture: Union[Capture, bytes, str, Dict, List, FlightRecorder],
+    load_multiplier: float = 1.0,
+    remove_nodes: int = 0,
+    num_nodes: Optional[int] = None,
+    max_ticks: Optional[int] = None,
+    serving_capacity: Optional[int] = None,
+    latency_threshold_ms: float = 25.0,
+    wire_slo_us: float = 0.0,
+    seed: int = 7,
+) -> Dict:
+    """One what-if: replay ``capture`` under the transform knobs and
+    return projected per-SLO verdicts, burn rates and budget ledgers —
+    the ``POST /debug/whatif`` payload."""
+    if not isinstance(capture, Capture):
+        capture = parse_capture(capture)
+    scenario = ReplayScenario(
+        capture,
+        load_multiplier=load_multiplier,
+        remove_nodes=remove_nodes,
+        num_nodes=num_nodes,
+        max_ticks=max_ticks,
+        serving_capacity=serving_capacity,
+        latency_threshold_ms=latency_threshold_ms,
+        wire_slo_us=wire_slo_us,
+        seed=seed,
+    )
+    verdict = scenario.run({})
+    return {
+        "format": FORMAT,
+        "capture": capture.stats(),
+        "transform": {
+            "load_multiplier": scenario.load_multiplier,
+            "remove_nodes": scenario.remove_nodes,
+            "latency_threshold_ms": scenario.latency_threshold_ms,
+            "wire_slo_us": scenario.wire_slo_us,
+        },
+        "scale": {
+            "num_nodes": scenario.num_nodes,
+            "pods": scenario.pods,
+            "ticks": scenario.ticks_n,
+            "period_s": round(scenario.capture.period_s, 6),
+            "serving_capacity": scenario.serving_capacity,
+        },
+        "traffic": verdict["traffic"],
+        "verdicts": {
+            name: {
+                "alert": entry.get("alert"),
+                "compliance": entry.get("compliance"),
+                "error_budget_remaining": entry.get(
+                    "error_budget_remaining"
+                ),
+                "burn_rate": entry.get("burn_rate"),
+                "breaches": entry.get("breaches"),
+                "events": entry.get("events"),
+            }
+            for name, entry in verdict["judgment"].items()
+        },
+    }
+
+
+#: the knobs a what-if spec may carry (anything else is a hard 400:
+#: silently ignoring a typoed knob would serve a projection the caller
+#: did not ask for)
+_SPEC_KEYS = frozenset(
+    {
+        "capture",
+        "load_multiplier",
+        "remove_nodes",
+        "num_nodes",
+        "max_ticks",
+        "serving_capacity",
+        "latency_threshold_ms",
+        "wire_slo_us",
+        "seed",
+    }
+)
+
+
+def _spec_number(spec: Dict, key: str, default, integer: bool = False):
+    value = spec.get(key, default)
+    if value is None:
+        return None
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise CaptureError(f"{key} must be a number")
+    return int(value) if integer else float(value)
+
+
+def whatif_from_spec(
+    spec: Dict, flight: Optional[FlightRecorder] = None
+) -> Dict:
+    """Validate a ``POST /debug/whatif`` body (or the CLI's equivalent)
+    and run :func:`whatif`.  ``capture`` may be ``"self"`` (the live
+    recorder's current ring — the default), inline JSONL text, or a
+    decoded ``{"events": [...]}`` object."""
+    unknown = sorted(set(spec) - _SPEC_KEYS)
+    if unknown:
+        raise CaptureError(
+            f"unknown what-if knobs {unknown}; valid: "
+            f"{sorted(_SPEC_KEYS)}"
+        )
+    ref = spec.get("capture", "self")
+    if ref == "self":
+        if flight is None:
+            raise CaptureError(
+                'capture "self" needs a live recorder '
+                "(--flightRecorder=on)"
+            )
+        source: Union[bytes, str, Dict] = flight.to_jsonl()
+    elif isinstance(ref, (str, dict)):
+        source = ref
+    else:
+        raise CaptureError(
+            'capture must be "self", JSONL text, or an object with '
+            'an "events" list'
+        )
+    return whatif(
+        source,
+        load_multiplier=_spec_number(spec, "load_multiplier", 1.0),
+        remove_nodes=_spec_number(spec, "remove_nodes", 0, integer=True),
+        num_nodes=_spec_number(spec, "num_nodes", None, integer=True),
+        max_ticks=_spec_number(spec, "max_ticks", None, integer=True),
+        serving_capacity=_spec_number(
+            spec, "serving_capacity", None, integer=True
+        ),
+        latency_threshold_ms=_spec_number(
+            spec, "latency_threshold_ms", 25.0
+        ),
+        wire_slo_us=_spec_number(spec, "wire_slo_us", 0.0),
+        seed=_spec_number(spec, "seed", 7, integer=True),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the round-trip fidelity gate
+# ---------------------------------------------------------------------------
+
+
+class ReplayedDiurnal:
+    """Record -> replay -> same verdicts.  A no-arg scenario for the
+    matrix: ``build`` runs a SMALL diurnal twin with a flight recorder
+    wired the production way (:meth:`TwinCluster.attach_flight`),
+    exports the ring as JSONL, parses it back, and builds the replay
+    twin; ``checks`` require the replay to reproduce the source run's
+    per-SLO alert tiers and compliance, and the final published decile
+    curve — the fidelity contract the what-if endpoint leans on."""
+
+    name = "replayed_diurnal"
+    rec_nodes = 12
+    rec_pods = 24
+    compliance_tolerance = 0.02
+    decile_tolerance = 0.05  # relative, on the final telemetry curve
+
+    def __init__(self):
+        self._source_judgment: Optional[Dict] = None
+        self._source_curve: Optional[List[float]] = None
+        self._replay: Optional[ReplayScenario] = None
+        self._replay_flight: Optional[FlightRecorder] = None
+
+    def build(self, scale: Dict):
+        from platform_aware_scheduling_tpu.testing.twin import (
+            DiurnalLoad,
+        )
+
+        program = DiurnalLoad()
+        rec_scale = {
+            "num_nodes": self.rec_nodes,
+            "pods": self.rec_pods,
+            "period_s": scale.get("period_s", 5.0),
+            "requests_per_tick": scale.get("requests_per_tick", 2),
+            "latency_threshold_ms": scale.get(
+                "latency_threshold_ms", 25.0
+            ),
+            # the wire-floor latency gate is a REAL-time measurement —
+            # a replay cannot reproduce wall-clock jitter, so the
+            # fidelity contract is scoped to the clock-driven SLOs
+            "wire_slo_us": 0.0,
+        }
+        source = program.build(rec_scale)
+        recorder = FlightRecorder(
+            capacity=65536, clock=source.clock.now
+        )
+        source.attach_flight(recorder)
+        try:
+            for t in range(program.ticks(rec_scale)):
+                program.apply(source, t)
+                source.tick()
+            self._source_judgment = source.judgment()
+            payload = recorder.to_jsonl()
+        finally:
+            source.close()
+        self._replay = ReplayScenario(
+            parse_capture(payload),
+            latency_threshold_ms=rec_scale["latency_threshold_ms"],
+        )
+        last = self._replay.capture.passes[-1]
+        self._source_curve = list(last.get("deciles") or [])
+        twin = self._replay.build({})
+        self._replay_flight = FlightRecorder(
+            capacity=65536, clock=twin.clock.now
+        )
+        twin.attach_flight(self._replay_flight)
+        return twin
+
+    def ticks(self, scale: Dict) -> int:
+        return self._replay.ticks(scale)
+
+    def apply(self, twin, t: int) -> None:
+        self._replay.apply(twin, t)
+
+    def checks(self, twin) -> List[Dict]:
+        from platform_aware_scheduling_tpu.testing.twin import Scenario
+
+        checks: List[Dict] = []
+        replayed = twin.judgment()
+        source = self._source_judgment or {}
+        # verdict fidelity on the SLOs both runs judged (the replay
+        # twin has no GAS lane — GAS verbs were not in the capture)
+        for name in sorted(set(source) & set(replayed)):
+            src, rep = source[name], replayed[name]
+            same_alert = src.get("alert") == rep.get("alert")
+            drift = abs(
+                (src.get("compliance") or 0.0)
+                - (rep.get("compliance") or 0.0)
+            )
+            checks.append(
+                Scenario._check(
+                    f"fidelity:{name}",
+                    same_alert and drift <= self.compliance_tolerance,
+                    f"alert {src.get('alert')} -> {rep.get('alert')}, "
+                    f"compliance drift {drift:.4f}",
+                )
+            )
+        checks.append(
+            Scenario._check(
+                "round_trip_scale",
+                twin.num_nodes == self.rec_nodes,
+                f"replayed {twin.num_nodes} nodes vs recorded "
+                f"{self.rec_nodes}",
+            )
+        )
+        # the replay's OWN final telemetry pass must land on the
+        # recorded decile curve (the load shape round-trips)
+        replay_curve: Optional[List[float]] = None
+        for event in reversed(self._replay_flight.events()):
+            if event.get("kind") == "telemetry":
+                replay_curve = list(event.get("deciles") or [])
+                break
+        curve_ok = (
+            replay_curve is not None
+            and self._source_curve is not None
+            and len(replay_curve) == len(self._source_curve)
+            and all(
+                abs(a - b)
+                <= max(2.0, self.decile_tolerance * max(abs(a), 1.0))
+                for a, b in zip(self._source_curve, replay_curve)
+            )
+        )
+        checks.append(
+            Scenario._check(
+                "decile_round_trip",
+                curve_ok,
+                f"recorded {self._source_curve} vs replayed "
+                f"{replay_curve}",
+            )
+        )
+        return checks
+
+    def run(self, scale: Optional[Dict] = None) -> Dict:
+        from platform_aware_scheduling_tpu.testing.twin import Scenario
+
+        return Scenario.run(self, scale)
+
+
+__all__ = [
+    "Capture",
+    "CaptureError",
+    "MAX_REPLAY_NODES",
+    "MAX_REPLAY_TICKS",
+    "ReplayScenario",
+    "ReplayedDiurnal",
+    "parse_capture",
+    "whatif",
+    "whatif_from_spec",
+]

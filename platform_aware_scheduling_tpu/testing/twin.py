@@ -42,8 +42,11 @@ import json
 import math
 from typing import Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from platform_aware_scheduling_tpu.extender.server import HTTPRequest
 from platform_aware_scheduling_tpu.testing.builders import make_pod
+from platform_aware_scheduling_tpu.testing.faults import int_node_metric
 from platform_aware_scheduling_tpu.testing.ha import (
     HAHarness,
     METRIC,
@@ -58,6 +61,7 @@ from platform_aware_scheduling_tpu.utils.slo import (
     SLOEngine,
     default_slos,
 )
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 
 GAS_NODES = 4  # the GAS lane's GPU nodes, constant across scales
 
@@ -140,6 +144,8 @@ class TwinCluster(HAHarness):
         gang: bool = False,
         mesh: Optional[Tuple[int, int]] = None,
         lease_duration_s: float = 15.0,
+        serving_capacity: Optional[int] = None,
+        vectorized: bool = True,
     ):
         super().__init__(
             replicas=replicas,
@@ -168,6 +174,24 @@ class TwinCluster(HAHarness):
         self._bodies: Optional[List[bytes]] = None
         self.traffic = {"requests": 0, "errors": 0}
         self.storm_evictions: Optional[int] = None
+        #: per-tick verb admission budget (None = unlimited): requests
+        #: past it are SHED the way AsyncServer sheds past --queueDepth —
+        #: counted into pas_serving_rejected_total (the twin-local
+        #: CounterSet below, wired into the engine's sources), never
+        #: reaching a verb handler, so verb_availability degrades under
+        #: a what-if load multiplier exactly as production would
+        self.serving_capacity = serving_capacity
+        self.serving_counters = CounterSet()
+        #: vectorized per-tick load model (numpy bincount over interned
+        #: node ordinals + memoized NodeMetric publication); the legacy
+        #: dict path stays selectable so benchmarks/twin_load.py can
+        #: report the before/after ticks-per-second honestly
+        self.vectorized = vectorized
+        self._node_ordinal: Dict[str, int] = (
+            {} if gang else {f"node-{i}": i for i in range(num_nodes)}
+        )
+        self._base_vector = np.zeros(num_nodes, dtype=np.int64)
+        self._live_cache: Optional[List[str]] = None
         if not gang and pods:
             for i in range(pods):
                 name = f"pod-{i}"
@@ -289,6 +313,7 @@ class TwinCluster(HAHarness):
             self.engine = SLOEngine(
                 slos,
                 recorders=recorders,
+                counter_sets=[self.serving_counters],
                 freshness=self._freshness,
                 clock=self.clock.now,
                 windows=slo_windows,
@@ -312,11 +337,17 @@ class TwinCluster(HAHarness):
     def live_node_names(self) -> List[str]:
         if self.gang:
             return [n for n in self.mesh_nodes if n not in self.failed_nodes]
-        return [
-            f"node-{i}"
-            for i in range(self.num_nodes)
-            if f"node-{i}" not in self.failed_nodes
-        ]
+        # memoized: node names are fixed for the twin's lifetime and the
+        # failed set only changes through fail_nodes(), which invalidates
+        cached = self._live_cache
+        if cached is None:
+            cached = [
+                f"node-{i}"
+                for i in range(self.num_nodes)
+                if f"node-{i}" not in self.failed_nodes
+            ]
+            self._live_cache = cached
+        return cached
 
     def pod_counts(self, live: Optional[List[str]] = None) -> Dict[str, int]:
         """Running pods per live node — the ONE counting rule
@@ -324,6 +355,10 @@ class TwinCluster(HAHarness):
         eviction rebinding, and failure-wave rescheduling, so the three
         consumers can never drift on what 'load' means."""
         nodes = live if live is not None else self.live_node_names()
+        if self.vectorized and self._node_ordinal:
+            vec = self._count_vector().tolist()
+            ordinal = self._node_ordinal
+            return {n: vec[ordinal[n]] for n in nodes if n in ordinal}
         counts: Dict[str, int] = {n: 0 for n in nodes}
         with self.fake._lock:
             for raw in self.fake._pods.values():
@@ -337,6 +372,34 @@ class TwinCluster(HAHarness):
                     counts[node] += 1
         return counts
 
+    def _count_vector(self) -> "np.ndarray":
+        """Running pods per node ordinal as ONE bincount: the pod scan
+        appends interned node indices and numpy folds them — replacing
+        a dict increment per pod and a per-node dict comprehension on
+        the tick's hottest loop (100k nodes x every tick)."""
+        idx: List[int] = []
+        append = idx.append
+        ordinal_get = self._node_ordinal.get
+        with self.fake._lock:
+            for raw in self.fake._pods.values():
+                status = raw.get("status")
+                if status is not None and status.get("phase") in (
+                    "Succeeded",
+                    "Failed",
+                ):
+                    continue
+                spec = raw.get("spec")
+                if spec is None:
+                    continue
+                j = ordinal_get(spec.get("nodeName", ""))
+                if j is not None:
+                    append(j)
+        if not idx:
+            return np.zeros(self.num_nodes, dtype=np.int64)
+        return np.bincount(
+            np.asarray(idx, dtype=np.int64), minlength=self.num_nodes
+        )
+
     def publish_loads(self) -> None:
         """Scenario-aware telemetry publication: placement-derived pod
         load + the scenario's base load, for live nodes only (a failed
@@ -347,14 +410,31 @@ class TwinCluster(HAHarness):
         if self.gang:
             self.metrics.set_all(METRIC, {n: 0 for n in live})
             return
-        counts = self.pod_counts(live)
-        self.metrics.set_all(
-            METRIC,
-            {
-                n: counts[n] * POD_LOAD + self.base_load.get(n, 0)
-                for n in live
-            },
-        )
+        if not self.vectorized:
+            counts = self.pod_counts(live)
+            self.metrics.set_all(
+                METRIC,
+                {
+                    n: counts[n] * POD_LOAD + self.base_load.get(n, 0)
+                    for n in live
+                },
+            )
+            return
+        # vectorized: one bincount + one fused numpy expression for the
+        # whole load surface, published as SHARED per-value NodeMetric
+        # objects (int_node_metric) instead of a Quantity parse per node
+        loads = (
+            self._count_vector() * POD_LOAD + self._base_vector
+        ).tolist()
+        metric_for = int_node_metric
+        if not self.failed_nodes:
+            # healthy fleet: live is exactly node-0..N-1 in ordinal order,
+            # so the payload zips straight off the load vector
+            payload = dict(zip(live, map(metric_for, loads)))
+        else:
+            ordinal = self._node_ordinal
+            payload = {n: metric_for(loads[ordinal[n]]) for n in live}
+        self.metrics.set_all_metrics(METRIC, payload)
 
     # -- the tick --------------------------------------------------------------
 
@@ -421,6 +501,8 @@ class TwinCluster(HAHarness):
                 for i in range(max(1, self.requests_per_tick))
             ]
         extender = live[0].extender
+        capacity = self.serving_capacity
+        issued = 0
         for i in range(self.requests_per_tick):
             body = self._bodies[i % len(self._bodies)]
             for verb, path in (
@@ -428,6 +510,16 @@ class TwinCluster(HAHarness):
                 ("filter", "/scheduler/filter"),
             ):
                 self.traffic["requests"] += 1
+                if capacity is not None and issued >= capacity:
+                    # admission queue full: shed without touching a verb
+                    # handler (no histogram sample), counted bad in the
+                    # family verb_availability's SLI reads
+                    self.traffic["errors"] += 1
+                    self.serving_counters.inc(
+                        "pas_serving_rejected_total"
+                    )
+                    continue
+                issued += 1
                 try:
                     response = getattr(extender, verb)(
                         _request(path, body)
@@ -454,6 +546,29 @@ class TwinCluster(HAHarness):
 
     def set_base_load(self, loads: Dict[str, int]) -> None:
         self.base_load = dict(loads)
+        if self._node_ordinal:
+            vec = np.zeros(self.num_nodes, dtype=np.int64)
+            ordinal = self._node_ordinal
+            for name, value in self.base_load.items():
+                j = ordinal.get(name)
+                if j is not None:
+                    vec[j] = int(value)
+            self._base_vector = vec
+
+    def set_base_load_vector(self, vector) -> None:
+        """The replay loader's base-load knob: index i loads node-i
+        directly from an array (its per-tick targets come out of numpy
+        interpolation already), keeping the legacy dict view in sync so
+        ``vectorized=False`` replays publish the same surface."""
+        vec = np.zeros(self.num_nodes, dtype=np.int64)
+        arr = np.asarray(vector, dtype=np.int64)
+        span = min(arr.shape[0], self.num_nodes)
+        vec[:span] = np.maximum(arr[:span], 0)
+        self._base_vector = vec
+        values = vec.tolist()
+        self.base_load = {
+            f"node-{i}": values[i] for i in range(self.num_nodes)
+        }
 
     def fail_nodes(self, names: List[str]) -> None:
         """A node-failure wave: the named nodes' telemetry sources die
@@ -461,6 +576,7 @@ class TwinCluster(HAHarness):
         (the controller re-create path, like an eviction's)."""
         self.failed_nodes.update(names)
         self._bodies = None  # verb traffic stops naming dead nodes
+        self._live_cache = None
         doomed: List[Tuple[str, str, str]] = []
         with self.fake._lock:
             for raw in self.fake._pods.values():
@@ -507,6 +623,18 @@ class TwinCluster(HAHarness):
         """Remember the eviction count at storm start: the suspension
         gate asserts it never moves until recovery."""
         self.storm_evictions = len(self.fake.evictions)
+
+    def attach_flight(self, recorder) -> None:
+        """Wire a FlightRecorder exactly the way cmd/common.py does in
+        production: verb hooks on the first live replica's extender plus
+        ONE telemetry subscription on its cache's refresh pass — so a
+        twin-recorded capture and a production capture come off the same
+        code paths (testing/replay.py round-trips the former)."""
+        stack = self.live()[0]
+        stack.extender.flight = recorder
+        stack.cache.on_refresh_pass.append(
+            lambda: recorder.observe_cache(stack.cache)
+        )
 
     def serve(self, serving: str = "threaded"):
         """Mount the first live replica's extender behind a REAL HTTP

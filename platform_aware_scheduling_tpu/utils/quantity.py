@@ -62,12 +62,18 @@ class QuantityParseError(ValueError):
 class Quantity:
     """An exact, immutable k8s resource quantity."""
 
-    __slots__ = ("_value", "_text")
+    __slots__ = ("_value", "_text", "_milli")
 
     def __init__(self, value: Union[str, int, float, Fraction, "Quantity"]):
+        # lazily-computed milli_value_exact cache: Quantity is immutable,
+        # so the Fraction scaling can run once per object instead of once
+        # per telemetry pass per node (the mirror reads every value in
+        # fixed-point form each refresh)
+        self._milli: Union[Tuple[int, bool], None] = None
         if isinstance(value, Quantity):
             self._value = value._value
             self._text = value._text
+            self._milli = value._milli
             return
         if isinstance(value, str):
             self._value = _parse(value)
@@ -131,6 +137,9 @@ class Quantity:
         device-tensor mirror stores metric values in this fixed-point form;
         when ``exact`` is false for any node the host fallback path is used so
         rule evaluation stays bit-identical to the reference."""
+        cached = self._milli
+        if cached is not None:
+            return cached
         scaled = self._value * 1000
         exact = scaled.denominator == 1
         if exact:
@@ -139,10 +148,13 @@ class Quantity:
             # round toward zero for the approximate device value
             v = int(scaled)
         if v > _INT64_MAX:
-            return _INT64_MAX, False
-        if v < _INT64_MIN:
-            return _INT64_MIN, False
-        return v, exact
+            result = (_INT64_MAX, False)
+        elif v < _INT64_MIN:
+            result = (_INT64_MIN, False)
+        else:
+            result = (v, exact)
+        self._milli = result
+        return result
 
     def as_dec(self) -> str:
         """Decimal string (used in log lines, like Go ``AsDec``)."""

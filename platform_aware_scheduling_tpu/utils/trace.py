@@ -194,6 +194,15 @@ declare("pas_slo_compliance", "gauge", "Good-event fraction over the budget wind
 declare("pas_slo_error_budget_remaining", "gauge", "Fraction of the error budget left over the budget window: 1 - burn_rate(budget window); negative means overspent (label: slo).")
 declare("pas_slo_burn_rate", "gauge", "Error-budget burn rate per sliding window: bad fraction / (1 - objective); 1.0 spends the budget exactly by window end (labels: slo, window).")
 declare("pas_slo_breaches_total", "counter", "Alert-tier entries per SLO, edge-triggered: page when both fast windows burn past page_burn, warn when both slow windows burn past warn_burn (labels: slo, tier).")
+# flight recorder + what-if serving (utils/record.py, testing/replay.py;
+# docs/observability.md "Flight recorder & what-if").  The pas_record_*
+# families live in the recorder's own CounterSet and appear on /metrics
+# only while one is wired (--flightRecorder=on) — like pas_slo_*, the
+# off path registers nothing and stays byte-identical on the wire.
+declare("pas_record_events_total", "counter", "Anonymized events accepted into the flight-recorder ring (verb arrivals, telemetry deciles, eviction/leader flips).")
+declare("pas_record_dropped_total", "counter", "Oldest flight-recorder events evicted by ring overflow (raise --recordSize if this moves).")
+declare("pas_whatif_runs_total", "counter", "What-if twin replay runs served (POST /debug/whatif + the cmd.whatif CLI).")
+declare("pas_whatif_failures_total", "counter", "What-if runs that failed to parse their capture or crashed mid-replay.")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where

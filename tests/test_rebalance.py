@@ -340,6 +340,10 @@ class TestRebalanceLoop:
         streaks: the violation map is published every cycle regardless,
         so clean cycles during the window still reset streaks."""
         harness = ChurnHarness(mode="dry-run", hysteresis_cycles=2, **SMALL)
+        # publish telemetry once so the hot nodes actually violate: the
+        # enforcement pass only patches nodes whose labels can change,
+        # so a patch failure needs a real violation to surface
+        harness.step()
 
         def broken_patch(name, payload):
             raise RuntimeError("RBAC says no")
@@ -347,8 +351,8 @@ class TestRebalanceLoop:
         harness.fake.patch_node = broken_patch
         with pytest.raises(Exception):
             harness.strategy.enforce(harness.enforcer, harness.cache)
-        # the cycle still reached the rebalancer
-        assert harness.rebalancer.status()["cycles"] == 1
+        # the failing cycle still reached the rebalancer
+        assert harness.rebalancer.status()["cycles"] == 2
 
     def test_node_list_failure_aborts_cycle(self):
         """Capacity must never be fabricated: if nodes cannot be listed

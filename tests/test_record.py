@@ -1,0 +1,756 @@
+"""Flight recorder + trace replay gates (ISSUE 13, docs/observability.md
+"Flight recorder & what-if").
+
+Four contracts pinned here, not merely promised in docstrings:
+
+  * anonymization — a serialized capture NEVER contains a node, pod, or
+    namespace name (grepped against every name the traffic used);
+  * off-path neutrality — with no recorder wired the verb responses are
+    byte-identical on the wire to a recorder-on build (modulo the
+    per-request X-Request-ID) and /metrics emits no pas_record_*
+    families at all;
+  * round-trip fidelity — a capture exported over real sockets parses
+    back into the exact event stream, and a twin-recorded diurnal run
+    replayed through ReplayScenario reproduces the source run's SLO
+    verdicts (ReplayedDiurnal);
+  * bounded hot-path cost — the recorder's per-request delta, measured
+    hermetically in-process with interleaved on/off batches, stays far
+    under the <=5% p99 budget the wire A/B contextualizes.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from benchmarks.http_load import (
+    build_extender,
+    make_bodies,
+    record_inprocess_overhead,
+)
+from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.testing import replay
+from platform_aware_scheduling_tpu.testing.ha import METRIC, POD_LOAD
+from platform_aware_scheduling_tpu.testing.twin import TwinCluster
+from platform_aware_scheduling_tpu.utils import trace
+from platform_aware_scheduling_tpu.utils.record import (
+    FORMAT,
+    FlightRecorder,
+    decile_summary,
+)
+from wirehelpers import (
+    get_request,
+    post_bytes,
+    raw_request,
+    start_async,
+    start_threaded,
+)
+
+
+def verb_request(path: str, body: bytes) -> HTTPRequest:
+    return HTTPRequest(
+        method="POST",
+        path=path,
+        headers={"Content-Type": "application/json"},
+        body=body,
+    )
+
+
+def synth_recorder(
+    ticks: int = 4,
+    nodes: int = 8,
+    verbs_per_tick: int = 4,
+    period: float = 5.0,
+    lo: float = 100.0,
+    hi: float = 800.0,
+) -> FlightRecorder:
+    """A deterministic fake-clock capture: one telemetry pass per tick
+    over a linear load ramp, ``verbs_per_tick`` verb arrivals inside
+    each tick's window."""
+    state = {"t": 0.0}
+    rec = FlightRecorder(capacity=4096, clock=lambda: state["t"])
+    values = [
+        lo + (hi - lo) * i / max(1, nodes - 1) for i in range(nodes)
+    ]
+    for tick in range(ticks):
+        state["t"] = tick * period
+        rec.record_telemetry(METRIC, values)
+        for v in range(verbs_per_tick):
+            state["t"] = tick * period + 0.2 * (v + 1)
+            rec.record_verb(
+                "prioritize" if v % 2 == 0 else "filter",
+                universe_uid=0xDEADBEEF,
+                candidates=nodes,
+            )
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# recorder units
+# ---------------------------------------------------------------------------
+
+
+class TestDecileSummary:
+    def test_empty_is_none(self):
+        assert decile_summary([]) is None
+
+    def test_single_value_is_flat_curve(self):
+        assert decile_summary([7.0]) == [7.0] * 11
+
+    def test_linear_ramp_interpolates_exactly(self):
+        assert decile_summary(range(11)) == [float(i) for i in range(11)]
+
+    def test_unsorted_input_and_rounding(self):
+        curve = decile_summary([3.0001, 1.0, 2.0])
+        assert curve[0] == 1.0
+        assert curve[-1] == 3.0
+        assert all(round(v, 3) == v for v in curve)
+
+
+class TestFlightRecorder:
+    def test_verb_event_fields_are_anonymous(self):
+        rec = FlightRecorder(clock=lambda: 12.5)
+        rec.record_verb("prioritize", universe_uid=0x1234, candidates=3)
+        (event,) = rec.events()
+        assert set(event) == {"t", "kind", "verb", "universe", "candidates"}
+        assert event["t"] == 12.5
+        assert event["verb"] == "prioritize"
+        assert event["universe"] == "0000000000001234"
+        assert event["candidates"] == 3
+
+    def test_gang_size_key_only_when_nonzero(self):
+        rec = FlightRecorder(clock=lambda: 0.0)
+        rec.record_verb("filter", gang_size=0)
+        rec.record_verb("filter", gang_size=4)
+        first, second = rec.events()
+        assert "gang_size" not in first
+        assert second["gang_size"] == 4
+
+    def test_cold_span_universe_is_null(self):
+        rec = FlightRecorder(clock=lambda: 0.0)
+        rec.record_verb("filter", universe_uid=None, candidates=9)
+        assert rec.events()[0]["universe"] is None
+
+    def test_negative_uid_masks_to_64_bits(self):
+        rec = FlightRecorder(clock=lambda: 0.0)
+        rec.record_verb("filter", universe_uid=-1)
+        assert rec.events()[0]["universe"] == "f" * 16
+
+    def test_ring_keeps_latest_window_and_counts_drops(self):
+        rec = FlightRecorder(capacity=4, clock=lambda: 0.0)
+        for i in range(6):
+            rec.record_verb("prioritize", candidates=i)
+        events = rec.events()
+        assert [e["candidates"] for e in events] == [2, 3, 4, 5]
+        snap = rec.snapshot()
+        assert snap["events"] == 4
+        assert snap["dropped"] == 2
+        assert rec.counters.get("pas_record_events_total") == 6
+        assert rec.counters.get("pas_record_dropped_total") == 2
+
+    def test_empty_telemetry_pass_records_nothing(self):
+        rec = FlightRecorder(clock=lambda: 0.0)
+        rec.record_telemetry("node_load", [])
+        rec.record_eviction(0)
+        rec.record_eviction(-3)
+        assert rec.events() == []
+
+    def test_jsonl_framing_round_trips(self):
+        rec = synth_recorder(ticks=2, verbs_per_tick=2)
+        payload = rec.to_jsonl()
+        assert payload.endswith(b"\n")
+        lines = payload.decode().splitlines()
+        header = json.loads(lines[0])
+        assert header["format"] == FORMAT
+        assert header["events"] == len(lines) - 1
+        assert header["dropped"] == 0
+        kinds = {json.loads(line)["kind"] for line in lines[1:]}
+        assert kinds == {"telemetry", "verb"}
+
+    def test_poll_control_diffs_fleet_counters(self):
+        rec = FlightRecorder(clock=lambda: 0.0)
+        # poll_control sums the whole pas_leader family (a fleet has a
+        # leader, whichever replica label carries it) — drop series left
+        # behind by earlier HA/twin tests so this test owns the family
+        trace.COUNTERS.remove("pas_leader", kind="gauge")
+        trace.COUNTERS.set_gauge("pas_leader", 1.0)
+        rec.poll_control()
+        # the FIRST observation is itself an event: the capture says
+        # which role the window started in
+        leaders = [e for e in rec.events() if e["kind"] == "leader"]
+        assert leaders and leaders[-1]["leader"] is True
+        before = len(rec.events())
+        rec.poll_control()  # no movement -> no event
+        assert len(rec.events()) == before
+        trace.COUNTERS.inc("pas_rebalance_moves_executed_total", 2)
+        trace.COUNTERS.set_gauge("pas_leader", 0.0)
+        rec.poll_control()
+        evictions = [e for e in rec.events() if e["kind"] == "eviction"]
+        assert evictions and evictions[-1]["count"] == 2
+        leaders = [e for e in rec.events() if e["kind"] == "leader"]
+        assert leaders[-1]["leader"] is False
+
+
+# ---------------------------------------------------------------------------
+# the wire: /debug/record, /debug/whatif, off-path neutrality
+# ---------------------------------------------------------------------------
+
+
+def _start(front_end, ext):
+    return start_async(ext) if front_end == "async" else start_threaded(ext)
+
+
+@pytest.mark.parametrize("front_end", ["threaded", "async"])
+class TestRecordEndpoint:
+    def test_record_404_when_off(self, front_end):
+        ext, _names = build_extender(8, device=True)
+        server = _start(front_end, ext)
+        try:
+            status, _, body = get_request(server.port, "/debug/record")
+            assert status == 404
+            assert "flight recorder" in json.loads(body)["error"]
+        finally:
+            server.shutdown()
+
+    def test_record_serves_capture_after_traffic(self, front_end):
+        ext, names = build_extender(8, device=True)
+        ext.flight = FlightRecorder()
+        server = _start(front_end, ext)
+        try:
+            body = make_bodies(names, "nodenames", count=1)[0]
+            for path in ("/scheduler/prioritize", "/scheduler/filter"):
+                status, _, _ = raw_request(
+                    server.port, post_bytes(path, body)
+                )
+                assert status == 200
+            status, headers, payload = get_request(
+                server.port, "/debug/record"
+            )
+            assert status == 200
+            assert headers["content-type"] == "application/x-ndjson"
+            lines = [
+                json.loads(line)
+                for line in payload.decode().splitlines()
+            ]
+            assert lines[0]["format"] == FORMAT
+            verbs = [
+                e for e in lines[1:] if e.get("kind") == "verb"
+            ]
+            assert {e["verb"] for e in verbs} == {"prioritize", "filter"}
+            assert all(e["candidates"] == len(names) for e in verbs)
+            # POST against the GET-only export must 405
+            status, _, _ = raw_request(
+                server.port, post_bytes("/debug/record", b"{}")
+            )
+            assert status == 405
+        finally:
+            server.shutdown()
+
+
+class TestWhatifEndpoint:
+    def test_whatif_404_when_off_and_405_on_get(self):
+        ext, _names = build_extender(8, device=True)
+        server = start_threaded(ext)
+        try:
+            status, _, body = raw_request(
+                server.port, post_bytes("/debug/whatif", b"{}")
+            )
+            assert status == 404
+            assert "flight recorder" in json.loads(body)["error"]
+            ext.flight = FlightRecorder()
+            status, _, _ = get_request(server.port, "/debug/whatif")
+            assert status == 405
+        finally:
+            server.shutdown()
+
+    def test_whatif_rejects_bad_specs(self):
+        ext, _names = build_extender(8, device=True)
+        ext.flight = FlightRecorder()
+        server = start_threaded(ext)
+        try:
+            for bad in (b"[1, 2]", b"not json"):
+                status, _, body = raw_request(
+                    server.port, post_bytes("/debug/whatif", bad)
+                )
+                assert status == 400
+                assert "JSON object" in json.loads(body)["error"]
+            status, _, body = raw_request(
+                server.port,
+                post_bytes("/debug/whatif", b'{"load_mult": 2}'),
+            )
+            assert status == 400
+            assert "load_mult" in json.loads(body)["error"]
+            # an empty live ring has no telemetry passes to anchor on
+            status, _, body = raw_request(
+                server.port, post_bytes("/debug/whatif", b"{}")
+            )
+            assert status == 400
+            assert "telemetry" in json.loads(body)["error"]
+            assert (
+                trace.COUNTERS.get("pas_whatif_failures_total") >= 3
+            )
+        finally:
+            server.shutdown()
+
+    def test_whatif_projects_verdicts_from_live_ring(self):
+        ext, names = build_extender(8, device=True)
+        # register the metric so observe_cache's telemetry pass sees it
+        # (production assembly registers through the policy watcher)
+        ext.cache.write_metric("load_metric")
+        clk = {"t": 0.0}
+        flight = FlightRecorder(clock=lambda: clk["t"])
+        ext.flight = flight
+        server = start_threaded(ext)
+        try:
+            body = make_bodies(names, "nodenames", count=1)[0]
+            for tick in range(3):
+                clk["t"] = tick * 5.0
+                for path in (
+                    "/scheduler/prioritize",
+                    "/scheduler/filter",
+                ):
+                    status, _, _ = raw_request(
+                        server.port, post_bytes(path, body)
+                    )
+                    assert status == 200
+                flight.observe_cache(ext.cache)
+            runs_before = trace.COUNTERS.get("pas_whatif_runs_total")
+            status, _, payload = raw_request(
+                server.port,
+                post_bytes("/debug/whatif", b'{"max_ticks": 2}'),
+            )
+            assert status == 200
+            result = json.loads(payload)
+            assert result["format"] == FORMAT
+            assert result["capture"]["num_nodes"] == len(names)
+            assert result["scale"]["ticks"] == 2
+            assert result["traffic"]["requests"] > 0
+            assert result["verdicts"]
+            for entry in result["verdicts"].values():
+                assert "alert" in entry and "compliance" in entry
+            assert (
+                trace.COUNTERS.get("pas_whatif_runs_total")
+                == runs_before + 1
+            )
+        finally:
+            server.shutdown()
+
+    def test_whatif_accepts_inline_capture(self):
+        ext, _names = build_extender(8, device=True)
+        ext.flight = FlightRecorder()  # wired but empty: spec supplies
+        server = start_threaded(ext)
+        try:
+            spec = json.dumps(
+                {
+                    "capture": synth_recorder(ticks=2)
+                    .to_jsonl()
+                    .decode(),
+                    "max_ticks": 2,
+                }
+            ).encode()
+            status, _, payload = raw_request(
+                server.port, post_bytes("/debug/whatif", spec)
+            )
+            assert status == 200
+            result = json.loads(payload)
+            assert result["capture"]["metric"] == METRIC
+            assert result["scale"]["num_nodes"] == 8
+        finally:
+            server.shutdown()
+
+
+class TestOffPathNeutrality:
+    def test_verb_responses_byte_identical_with_and_without_recorder(
+        self,
+    ):
+        """The recorder must never touch a verb response: the same
+        request against a recorder-off and a recorder-on build returns
+        the same status, the same body, and the same headers (only the
+        per-request X-Request-ID may differ)."""
+        wire = {}
+        for label, flight in (("off", None), ("on", FlightRecorder())):
+            ext, names = build_extender(12, device=True)
+            ext.flight = flight
+            server = start_threaded(ext)
+            try:
+                body = make_bodies(names, "nodenames", count=1)[0]
+                wire[label] = {
+                    path: raw_request(
+                        server.port, post_bytes(path, body)
+                    )
+                    for path in (
+                        "/scheduler/prioritize",
+                        "/scheduler/filter",
+                    )
+                }
+            finally:
+                server.shutdown()
+        for path, (status, headers, body) in wire["off"].items():
+            on_status, on_headers, on_body = wire["on"][path]
+            assert status == on_status == 200
+            assert body == on_body
+            drop = "x-request-id"
+            assert {k: v for k, v in headers.items() if k != drop} == {
+                k: v for k, v in on_headers.items() if k != drop
+            }
+
+    def test_metrics_families_follow_the_recorder(self):
+        ext, names = build_extender(8, device=True)
+        body = make_bodies(names, "nodenames", count=1)[0]
+        ext.prioritize(verb_request("/scheduler/prioritize", body))
+        assert "pas_record_" not in ext.metrics_text()
+        # capacity 1 so the second event also overflows the ring: both
+        # record families land on the exposition in one pass
+        ext.flight = FlightRecorder(capacity=1)
+        ext.prioritize(verb_request("/scheduler/prioritize", body))
+        ext.prioritize(verb_request("/scheduler/prioritize", body))
+        text = ext.metrics_text()
+        assert "pas_record_events_total" in text
+        assert "pas_record_dropped_total" in text
+
+
+class TestAnonymization:
+    def test_capture_never_contains_cluster_names(self):
+        """The contract docs/observability.md promises: drive real
+        traffic carrying node, pod, and namespace names, run a full
+        telemetry pass, and grep the serialized capture for every one
+        of them — zero hits."""
+        ext, names = build_extender(24, device=True)
+        flight = FlightRecorder()
+        ext.flight = flight
+        server = start_threaded(ext)
+        try:
+            for body in make_bodies(names, "nodenames", count=4):
+                for path in (
+                    "/scheduler/prioritize",
+                    "/scheduler/filter",
+                ):
+                    status, _, _ = raw_request(
+                        server.port, post_bytes(path, body)
+                    )
+                    assert status == 200
+            flight.observe_cache(ext.cache)
+        finally:
+            server.shutdown()
+        payload = flight.to_jsonl()
+        assert flight.events(), "capture must not be empty"
+        for name in names:
+            assert name.encode() not in payload, name
+        assert b"node-" not in payload
+        assert b"bench-pod" not in payload  # the driven pod names
+        assert b"default" not in payload  # the driven namespace
+        # and the positive side: verb events carry only the digest/count
+        for event in flight.events():
+            if event["kind"] == "verb":
+                universe = event["universe"]
+                assert universe is None or (
+                    len(universe) == 16
+                    and int(universe, 16) >= 0
+                )
+
+
+# ---------------------------------------------------------------------------
+# replay + what-if units
+# ---------------------------------------------------------------------------
+
+
+class TestParseCapture:
+    def test_rejects_unreplayable_sources(self):
+        with pytest.raises(replay.CaptureError):
+            replay.parse_capture("")
+        with pytest.raises(replay.CaptureError):
+            replay.parse_capture("not json\n")
+        with pytest.raises(replay.CaptureError):
+            replay.parse_capture('{"format": "pas-flight-record/999"}\n')
+        with pytest.raises(replay.CaptureError):
+            replay.parse_capture({"no_events": True})
+        with pytest.raises(replay.CaptureError):
+            replay.parse_capture(42)
+        # a capture with no telemetry passes has no replay timeline
+        rec = FlightRecorder(clock=lambda: 0.0)
+        rec.record_verb("prioritize")
+        with pytest.raises(replay.CaptureError):
+            replay.parse_capture(rec)
+
+    def test_timeline_inference_from_synthetic_capture(self):
+        capture = replay.parse_capture(
+            synth_recorder(ticks=4, nodes=8, verbs_per_tick=4)
+        )
+        assert capture.metric == METRIC
+        assert capture.tick_count == 4
+        assert capture.num_nodes == 8
+        assert capture.period_s == 5.0
+        assert capture.arrivals == [4, 4, 4, 4]
+        assert capture.floor_load == 100.0
+        stats = capture.stats()
+        assert stats["verbs"] == {"filter": 8, "prioritize": 8}
+        assert stats["peak_verbs_per_tick"] == 4
+        assert stats["ticks"] == 4
+
+    def test_jsonl_and_dict_and_recorder_sources_agree(self):
+        rec = synth_recorder(ticks=2)
+        from_rec = replay.parse_capture(rec)
+        from_jsonl = replay.parse_capture(rec.to_jsonl())
+        from_dict = replay.parse_capture(
+            {"format": FORMAT, "events": rec.events()}
+        )
+        for capture in (from_jsonl, from_dict):
+            assert capture.stats() == from_rec.stats()
+
+
+class TestWhatif:
+    def test_spec_validation(self):
+        with pytest.raises(replay.CaptureError, match="unknown"):
+            replay.whatif_from_spec({"typo_knob": 1})
+        with pytest.raises(replay.CaptureError, match="self"):
+            replay.whatif_from_spec({})  # no live recorder
+        with pytest.raises(replay.CaptureError, match="number"):
+            replay.whatif_from_spec(
+                {"capture": "x", "load_multiplier": True}
+            )
+        with pytest.raises(replay.CaptureError, match="capture"):
+            replay.whatif_from_spec({"capture": 7})
+
+    def test_double_load_degrades_availability(self):
+        """The acceptance demo: the recorded peak becomes the admission
+        budget, so a 1x replay sheds nothing and a 2x what-if saturates
+        it — the availability SLO must degrade."""
+        rec = synth_recorder(ticks=4, nodes=8, verbs_per_tick=4)
+        base = replay.whatif(rec, load_multiplier=1.0)
+        doubled = replay.whatif(rec, load_multiplier=2.0)
+        assert base["traffic"]["errors"] == 0
+        assert doubled["traffic"]["errors"] > 0
+        avail = [
+            name
+            for name in base["verdicts"]
+            if "availability" in name
+        ]
+        assert avail, sorted(base["verdicts"])
+        for name in avail:
+            assert (
+                doubled["verdicts"][name]["compliance"]
+                < base["verdicts"][name]["compliance"]
+            )
+
+    def test_remove_nodes_shrinks_the_replay_fleet(self):
+        rec = synth_recorder(ticks=2, nodes=8)
+        out = replay.whatif(rec, remove_nodes=3)
+        assert out["scale"]["num_nodes"] == 5
+        assert out["transform"]["remove_nodes"] == 3
+
+
+# ---------------------------------------------------------------------------
+# round-trip fidelity
+# ---------------------------------------------------------------------------
+
+
+class TestRoundTrip:
+    def test_socket_export_round_trips_into_a_running_replay(self):
+        """Capture over a REAL socket -> parse -> ReplayScenario run:
+        the stats round-trip exactly and the replayed twin judges
+        traffic — the full production path of the what-if feature."""
+        ext, names = build_extender(8, device=True)
+        ext.cache.write_metric("load_metric")
+        clk = {"t": 0.0}
+        flight = FlightRecorder(clock=lambda: clk["t"])
+        ext.flight = flight
+        server = start_threaded(ext)
+        try:
+            body = make_bodies(names, "nodenames", count=1)[0]
+            for tick in range(3):
+                clk["t"] = tick * 5.0
+                for path in (
+                    "/scheduler/prioritize",
+                    "/scheduler/filter",
+                ):
+                    status, _, _ = raw_request(
+                        server.port, post_bytes(path, body)
+                    )
+                    assert status == 200
+                flight.observe_cache(ext.cache)
+            status, _, payload = get_request(
+                server.port, "/debug/record"
+            )
+            assert status == 200
+        finally:
+            server.shutdown()
+        capture = replay.parse_capture(payload)
+        assert capture.stats() == replay.parse_capture(flight).stats()
+        assert capture.num_nodes == len(names)
+        assert capture.period_s == 5.0
+        verdict = replay.ReplayScenario(capture, max_ticks=2).run()
+        assert all(c["ok"] for c in verdict["checks"]), verdict["checks"]
+        assert verdict["traffic"]["requests"] > 0
+
+    def test_replayed_diurnal_reproduces_source_verdicts(self):
+        """The fidelity gate itself: record a diurnal twin run through
+        the production wiring, replay the capture, and require the same
+        per-SLO alert tiers + compliance and the same final decile
+        curve."""
+        verdict = replay.ReplayedDiurnal().run()
+        assert verdict["checks"], "fidelity run produced no checks"
+        for check in verdict["checks"]:
+            assert check["ok"], check
+        names = {c["check"] for c in verdict["checks"]}
+        assert "round_trip_scale" in names
+        assert "decile_round_trip" in names
+        assert any(n.startswith("fidelity:") for n in names)
+
+
+# ---------------------------------------------------------------------------
+# the vectorized twin
+# ---------------------------------------------------------------------------
+
+
+class TestVectorizedTwin:
+    def _payload(self, twin):
+        info = twin.metrics.get_node_metric(METRIC)
+        return {
+            name: metric.value.milli_value_exact()[0]
+            for name, metric in info.items()
+        }
+
+    def test_vectorized_publication_matches_legacy(self):
+        base = {f"node-{i}": 37 * i for i in range(10)}
+        payloads = {}
+        for mode in (False, True):
+            twin = TwinCluster(
+                num_nodes=10,
+                pods=20,
+                gas=False,
+                vectorized=mode,
+                seed=3,
+            )
+            try:
+                twin.set_base_load(base)
+                twin.publish_loads()
+                payloads[mode] = self._payload(twin)
+                twin.fail_nodes(["node-3"])
+                twin.publish_loads()
+                payloads[(mode, "failed")] = self._payload(twin)
+            finally:
+                twin.close()
+        assert payloads[True] == payloads[False]
+        assert payloads[(True, "failed")] == payloads[(False, "failed")]
+        assert "node-3" not in payloads[(True, "failed")]
+        # placement-derived pod load is visible on top of base load
+        assert payloads[True]["node-0"] == 2 * POD_LOAD * 1000
+
+    def test_set_base_load_vector_clamps_and_syncs(self):
+        twin = TwinCluster(num_nodes=4, pods=0, gas=False, seed=3)
+        try:
+            twin.set_base_load_vector(np.array([50, -10, 75]))
+            assert twin.base_load == {
+                "node-0": 50,
+                "node-1": 0,  # negative interpolation targets clamp
+                "node-2": 75,
+                "node-3": 0,  # short vectors zero-fill
+            }
+            twin.set_base_load_vector(np.arange(10))  # long: truncated
+            assert twin.base_load["node-3"] == 3
+        finally:
+            twin.close()
+
+    def test_serving_capacity_sheds_and_counts(self):
+        twin = TwinCluster(
+            num_nodes=4,
+            pods=4,
+            gas=False,
+            serving_capacity=2,
+            requests_per_tick=3,
+            seed=3,
+        )
+        try:
+            twin.tick()
+            # 3 pairs = 6 verb requests against a budget of 2
+            assert twin.traffic["requests"] == 6
+            assert twin.traffic["errors"] == 4
+            assert (
+                twin.serving_counters.get("pas_serving_rejected_total")
+                == 4
+            )
+        finally:
+            twin.close()
+
+
+# ---------------------------------------------------------------------------
+# hot-path cost + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestRecorderOverhead:
+    def test_in_process_delta_stays_in_budget(self):
+        """The hermetic form of the <=5% p99 acceptance bound: the
+        recorder's per-request delta (interleaved on/off batches,
+        median of batch means, gc fenced) must stay far below the
+        ~200 us a 10k-node verb costs — 50 us is >5x the measured
+        ~4-8 us and still well under the budget."""
+        out = record_inprocess_overhead(
+            num_nodes=2000, batches=10, per_batch=30
+        )
+        for verb in ("prioritize", "filter"):
+            delta = out[f"{verb}_delta_us"]
+            assert delta < 50.0, out
+
+
+class TestWhatifCLI:
+    def test_cli_projects_from_a_capture_file(self, tmp_path, capsys):
+        from platform_aware_scheduling_tpu.cmd.whatif import main
+
+        path = tmp_path / "capture.jsonl"
+        path.write_bytes(synth_recorder(ticks=2).to_jsonl())
+        code = main(
+            ["--capture", str(path), "--maxTicks", "2",
+             "--loadMultiplier", "2.0"]
+        )
+        assert code == 0
+        result = json.loads(capsys.readouterr().out)
+        assert result["format"] == FORMAT
+        assert result["transform"]["load_multiplier"] == 2.0
+        assert result["verdicts"]
+
+    def test_cli_fails_cleanly(self, tmp_path, capsys):
+        from platform_aware_scheduling_tpu.cmd.whatif import main
+
+        assert main(["--capture", str(tmp_path / "missing.jsonl")]) == 2
+        assert "error:" in capsys.readouterr().err
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("not json\n")
+        assert main(["--capture", str(bad)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# scale (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestVectorizedTickScale:
+    def test_vectorized_tick_beats_legacy_at_100k(self):
+        """ISSUE 13's speed gate, at full scale: the vectorized tick
+        must hold an absolute budget (<=1 s/tick at 100k nodes, vs the
+        ~5 s/tick seed baseline) and beat the in-tree legacy path by
+        >=3x (the switch isolates exactly the vectorized load model)."""
+        import time
+
+        rates = {}
+        for mode in (False, True):
+            twin = TwinCluster(
+                num_nodes=100_000,
+                pods=200_000,
+                gas=False,
+                slo=False,
+                vectorized=mode,
+                requests_per_tick=0,
+                seed=3,
+            )
+            try:
+                twin.tick()  # warm caches/JIT outside the window
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    twin.tick()
+                rates[mode] = (time.perf_counter() - t0) / 3
+            finally:
+                twin.close()
+        assert rates[True] <= 1.0, rates
+        assert rates[False] / rates[True] >= 3.0, rates

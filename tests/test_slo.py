@@ -12,6 +12,7 @@ import pytest
 from benchmarks.http_load import build_extender, make_bodies
 from platform_aware_scheduling_tpu.extender.server import (
     DEBUG_ENDPOINTS,
+    EXECUTOR_DEBUG_PATHS,
     HTTPRequest,
     QUEUE_BYPASS_PATHS,
     Server,
@@ -684,7 +685,8 @@ class TestDebugIndexCompleteness:
         "/healthz", "/readyz", "/metrics", "/debug/traces",
         "/debug/decisions", "/debug/rebalance", "/debug/gangs",
         "/debug/forecast", "/debug/leader", "/debug/slo",
-        "/debug/wire", "/debug/profile",
+        "/debug/wire", "/debug/profile", "/debug/record",
+        "/debug/whatif",
     }
 
     def test_index_names_every_debug_route(self):
@@ -692,7 +694,7 @@ class TestDebugIndexCompleteness:
 
     def test_bypass_set_derived_from_index(self):
         assert QUEUE_BYPASS_PATHS == (
-            self.EXPECTED - {"/debug/profile"}
+            self.EXPECTED - EXECUTOR_DEBUG_PATHS
         ) | {"/debug", "/debug/"}
 
     @pytest.mark.parametrize("serving", ["threaded", "async"])
@@ -704,11 +706,25 @@ class TestDebugIndexCompleteness:
         try:
             status, _h, body = get_request(server.port, "/debug")
             assert status == 200
-            index_paths = {
-                e["path"] for e in json.loads(body)["endpoints"]
-            }
-            assert index_paths == self.EXPECTED
-            for path in sorted(index_paths):
+            endpoints = json.loads(body)["endpoints"]
+            assert {e["path"] for e in endpoints} == self.EXPECTED
+            for entry in sorted(endpoints, key=lambda e: e["path"]):
+                path = entry["path"]
+                method = entry.get("method", "GET")
+                if method == "POST":
+                    # POST routes flip the semantics: GET must 405,
+                    # POST must be served (never the bare catch-all)
+                    status, _h, body = get_request(server.port, path)
+                    assert status == 405, f"GET {path} -> {status}"
+                    status, _h, body = raw_request(
+                        server.port, post_bytes(path, b"{}")
+                    )
+                    assert body, f"{path}: empty body is the catch-all 404"
+                    json.loads(body)
+                    assert status in (200, 400, 404, 503), (
+                        f"{path} -> {status}"
+                    )
+                    continue
                 status, _h, body = get_request(server.port, path)
                 assert body, f"{path}: empty body is the catch-all 404"
                 if path != "/metrics":

@@ -175,6 +175,20 @@ class TestDeclaredInventory:
             assert name in trace.METRICS, f"{name} missing from inventory"
             assert trace.METRICS[name][0] == kind, name
 
+    def test_record_families_declared(self):
+        """ISSUE 13: the flight-recorder + what-if counter families are
+        part of the declared inventory (docs/observability.md "Flight
+        recorder & what-if")."""
+        expected = {
+            "pas_record_events_total": "counter",
+            "pas_record_dropped_total": "counter",
+            "pas_whatif_runs_total": "counter",
+            "pas_whatif_failures_total": "counter",
+        }
+        for name, kind in expected.items():
+            assert name in trace.METRICS, f"{name} missing from inventory"
+            assert trace.METRICS[name][0] == kind, name
+
     def test_fault_tolerance_families_declared(self):
         """ISSUE 5: the retry/circuit/degraded families are part of the
         declared inventory (docs/robustness.md)."""
