@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record bench-replay test-wirec trace-lint obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record bench-replay test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
 
 all: test
 
@@ -141,6 +141,7 @@ WIREC_SAN_SO := $(abspath build/_wirec_sanitized.so)
 test-wirec:
 	mkdir -p build
 	$(CC) -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+		-Wall -Wextra -Wshadow -Wvla -Werror \
 		-shared -fPIC \
 		-I$$(python -c 'import sysconfig; print(sysconfig.get_paths()["include"])') \
 		platform_aware_scheduling_tpu/native/wirec.c -o $(WIREC_SAN_SO)
@@ -155,6 +156,12 @@ test-wirec:
 # duplicates, and live /metrics output parses as valid exposition
 trace-lint:
 	python -m pytest tests/test_trace_lint.py -q
+
+# project-native static analysis (docs/analysis.md): clock discipline,
+# hot-path blocking, lock scope/ordering, metric declaration cross-check;
+# exits nonzero on any finding not pragma'd or baselined
+pascheck:
+	python -m platform_aware_scheduling_tpu.analysis
 
 # control-plane & device observability suite: /healthz + /readyz
 # condition toggling on both front-ends, workqueue/informer

@@ -1,0 +1,40 @@
+"""pascheck: project-native static analysis for the control plane.
+
+The correctness of the twin/replay/SLO stack rests on invariants that
+used to live in prose and after-the-fact regression tests:
+
+  * **clock discipline** — determinism holds only because every
+    subsystem takes an injectable clock; a single raw ``time.time()``
+    in a new module silently breaks twin replay;
+  * **hot-path blocking** — "must never wedge a verb": nothing
+    reachable from the Filter/Prioritize/gas_filter verb handlers may
+    sleep, call the kube/metrics APIs, touch files or sockets, or spin
+    a retrying loop (the PR-9 journal-save bug class);
+  * **lock scope & ordering** — no blocking or known-heavy work while
+    holding a hot-path lock (the PR-8 "history dict built under the
+    cache lock" class), and no inconsistent two-lock acquisition order;
+  * **metric emission cross-check** — every statically-resolvable
+    emission names a family declared in ``trace.METRICS``, and every
+    declared family has at least one emission site (the dead-metric
+    half trace-lint's runtime scrape cannot see).
+
+``python -m platform_aware_scheduling_tpu.analysis`` (or
+``make pascheck``) runs all four checkers over the package and exits
+nonzero on any finding that is neither suppressed by an inline pragma
+(``# pascheck: allow[<check>] -- <reason>``, reason required) nor
+listed in the committed baseline (``analysis/baseline.json``, every
+entry carrying a reason).  See docs/analysis.md for the checker
+catalog and the pragma/baseline workflow.
+
+This package must import with nothing but the standard library — it is
+a build gate, not part of the serving process.
+"""
+
+from platform_aware_scheduling_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    load_modules,
+    run_checks,
+)
+
+__all__ = ["Baseline", "Finding", "load_modules", "run_checks"]

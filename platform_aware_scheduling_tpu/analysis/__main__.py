@@ -1,0 +1,118 @@
+"""CLI for pascheck: ``python -m platform_aware_scheduling_tpu.analysis``.
+
+Exit codes: 0 clean (everything pragma'd/baselined), 1 new findings,
+2 usage error.  ``--write-baseline`` accepts the current findings into
+the baseline file, preserving existing reasons and marking new entries
+UNREVIEWED — replace those with real justifications before committing
+(tests assert the committed baseline never grows and every reason is
+human-written).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from platform_aware_scheduling_tpu.analysis.core import (
+    CHECK_NAMES,
+    Baseline,
+    default_baseline_path,
+    run_checks,
+)
+
+#: analysis/ is excluded from its own scan: checker tables spell raw
+#: clock names as string literals and docstrings show pragma syntax.
+DEFAULT_SKIP = ("analysis",)
+
+UNREVIEWED = "UNREVIEWED — replace with a justification before committing"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="pascheck",
+        description="project-native static analysis (see docs/analysis.md)",
+    )
+    parser.add_argument(
+        "--checks",
+        default=None,
+        metavar="NAMES",
+        help=f"comma-separated subset of: {', '.join(CHECK_NAMES)}",
+    )
+    parser.add_argument(
+        "--root",
+        type=Path,
+        default=None,
+        help="package root to scan (default: the installed package)",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help="baseline file (default: analysis/baseline.json); pass "
+        "/dev/null to run baseline-free",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept current findings into the baseline and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    root = (args.root or Path(__file__).resolve().parent.parent).resolve()
+    if not root.is_dir():
+        print(f"pascheck: no such directory: {root}", file=sys.stderr)
+        return 2
+    checks = None
+    if args.checks:
+        checks = tuple(c.strip() for c in args.checks.split(",") if c.strip())
+    skip = DEFAULT_SKIP if args.root is None else ()
+
+    started = time.perf_counter()
+    try:
+        findings = run_checks(root, checks, skip=skip)
+    except ValueError as exc:
+        print(f"pascheck: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    if baseline_path.is_file() and baseline_path.stat().st_size:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (ValueError, OSError) as exc:
+            print(f"pascheck: bad baseline: {exc}", file=sys.stderr)
+            return 2
+    else:
+        baseline = Baseline()
+
+    if args.write_baseline:
+        entries = {
+            f.key: baseline.entries.get(f.key, UNREVIEWED) for f in findings
+        }
+        Baseline(entries).dump(baseline_path)
+        print(f"pascheck: wrote {len(entries)} entries to {baseline_path}")
+        return 0
+
+    new, accepted, stale = baseline.split(findings)
+    for finding in new:
+        print(finding.render())
+    for key in stale:
+        print(
+            f"pascheck: note: stale baseline entry (finding fixed — prune "
+            f"it): {key}",
+            file=sys.stderr,
+        )
+    elapsed = time.perf_counter() - started
+    summary = (
+        f"pascheck: {len(new)} new finding(s), {len(accepted)} baselined, "
+        f"{len(stale)} stale baseline entr(y/ies) "
+        f"[checks={','.join(checks or CHECK_NAMES)}] in {elapsed:.2f}s"
+    )
+    print(summary, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Checker ``clock``: no raw wall/monotonic clock calls or sleeps.
+
+The twin, trace replay, the SLO engine, lease election, gang TTLs, and
+every fake-clock test are deterministic only because subsystems take an
+injectable clock (``clock=time.monotonic`` as a constructor DEFAULT is
+the sanctioned boundary — a reference, never a call).  A single raw
+``time.time()`` in a new code path silently re-couples the control
+plane to the host clock and the twin can no longer replay it.
+
+Flagged (calls only — references as injectable defaults pass):
+
+  * ``time.time() / time.monotonic() / time_ns / monotonic_ns``
+  * ``time.sleep(...)``
+  * ``datetime.datetime.now() / utcnow()``, ``datetime.date.today()``
+
+``time.perf_counter`` is NOT flagged: it measures durations for
+observability (spans, latency histograms) and never feeds control
+flow or replayable state.
+
+Genuine boundaries carry ``# pascheck: allow[clock] -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from platform_aware_scheduling_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    enclosing_functions,
+)
+
+#: canonical dotted callables whose CALL breaks clock discipline
+RAW_CLOCK_CALLS = frozenset({
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.sleep",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.date.today",
+})
+
+
+def check(modules: Dict[str, ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules.values():
+        spans = None
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func, mod.imports)
+            if callee is None or callee not in RAW_CLOCK_CALLS:
+                continue
+            if spans is None:
+                spans = enclosing_functions(mod.tree)
+            func = spans.get(node.lineno, "<module>")
+            findings.append(Finding(
+                "clock",
+                "raw-clock",
+                mod.relpath,
+                node.lineno,
+                f"{func}:{callee}",
+                f"raw {callee}() in {func} — take an injectable clock "
+                "(clock=time.monotonic as a default is fine; calling it "
+                "inline breaks twin/replay determinism)",
+            ))
+    return findings

@@ -1,0 +1,431 @@
+"""pascheck framework: findings, pragmas, baseline, module loading.
+
+Everything here is plain ``ast`` over the package source — no imports of
+the checked code, no jax, nothing outside the standard library.  The
+four checkers (clocks/hotpath/locks/metricscheck) consume the
+:class:`ModuleInfo` table this module builds and return
+:class:`Finding` lists; the runner filters them through inline pragmas
+and the committed baseline and decides the exit code.
+
+Suppression model (docs/analysis.md):
+
+  * a pragma comment on the finding's line (or a standalone comment on
+    the line directly above) suppresses it for ONE named check, and the
+    reason is mandatory::
+
+        time.sleep(ms / 1000.0)  # pascheck: allow[clock] -- profile capture window is real wall time
+
+    A pragma with a missing/empty reason or an unknown check name is
+    itself a finding (check ``pragma``) — suppressions must stay
+    readable, not accumulate as bare switches.
+
+  * the baseline (``analysis/baseline.json``) carries accepted legacy
+    findings keyed WITHOUT line numbers (check:path:code:symbol), so
+    unrelated edits don't churn it; every entry carries a reason and
+    tests assert the committed file never grows.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: the four project checkers + the pragma meta-check
+CHECK_NAMES = ("clock", "hotpath", "locks", "metrics")
+
+PACKAGE = "platform_aware_scheduling_tpu"
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit.  ``symbol`` is the line-stable anchor (function
+    qualname + offending callee, metric name, lock pair) that keys the
+    baseline — line numbers drift with every edit, symbols don't."""
+
+    check: str
+    code: str
+    path: str  # package-relative posix path, e.g. "tas/cache.py"
+    line: int
+    symbol: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.check}:{self.path}:{self.code}:{self.symbol}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.check}/{self.code}] "
+            f"{self.message}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+#: ``# pascheck: allow[clock] -- reason`` suppresses one check on the
+#: pragma's line (or the line below, for standalone comments);
+#: ``allow-file[locks]`` suppresses one check for the whole file —
+#: for modules whose entire design trades the invariant away (the
+#: kube fake deep-copies under its lock *by contract*).  Separator
+#: before the mandatory reason: --, em/en dash, or :.
+_PRAGMA_RE = re.compile(
+    r"#\s*pascheck:\s*allow(-file)?\[([a-z-]+)\]\s*(?:--+|—|–|:)?\s*(.*)$"
+)
+
+
+@dataclass
+class Pragmas:
+    """Per-file suppression table: line -> {check: reason}, plus
+    whole-file allows ({check: reason})."""
+
+    by_line: Dict[int, Dict[str, str]] = field(default_factory=dict)
+    whole_file: Dict[str, str] = field(default_factory=dict)
+
+    def allows(self, line: int, check: str) -> bool:
+        if check in self.whole_file:
+            return True
+        for probe in (line, line - 1):
+            entry = self.by_line.get(probe)
+            if entry and check in entry:
+                return True
+        return False
+
+
+def collect_pragmas(relpath: str, lines: Sequence[str]) -> Tuple[Pragmas, List[Finding]]:
+    """Parse every pascheck pragma in a file; malformed ones (unknown
+    check, missing reason) become findings instead of suppressions."""
+    pragmas = Pragmas()
+    findings: List[Finding] = []
+    for lineno, text in enumerate(lines, 1):
+        if "pascheck:" not in text:
+            continue
+        match = _PRAGMA_RE.search(text)
+        if match is None:
+            findings.append(Finding(
+                "pragma", "bad-pragma", relpath, lineno, f"line-{lineno}",
+                "unparseable pascheck pragma (expected "
+                "'# pascheck: allow[<check>] -- <reason>')",
+            ))
+            continue
+        filewide = match.group(1) is not None
+        check, reason = match.group(2), match.group(3).strip()
+        if check not in CHECK_NAMES:
+            findings.append(Finding(
+                "pragma", "bad-pragma", relpath, lineno, f"line-{lineno}",
+                f"pragma names unknown check {check!r} "
+                f"(known: {', '.join(CHECK_NAMES)})",
+            ))
+            continue
+        if not reason:
+            findings.append(Finding(
+                "pragma", "bad-pragma", relpath, lineno, f"line-{lineno}",
+                f"pragma allow{'-file' if filewide else ''}[{check}] "
+                "carries no reason — every suppression must say why",
+            ))
+            continue
+        if filewide:
+            pragmas.whole_file[check] = reason
+        else:
+            pragmas.by_line.setdefault(lineno, {})[check] = reason
+    return pragmas, findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+class Baseline:
+    """Accepted legacy findings: key -> reason, committed as JSON."""
+
+    def __init__(self, entries: Optional[Dict[str, str]] = None):
+        self.entries: Dict[str, str] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        data = json.loads(path.read_text())
+        if data.get("version") != 1:
+            raise ValueError(f"{path}: unsupported baseline version")
+        entries: Dict[str, str] = {}
+        for entry in data.get("entries", []):
+            key = entry.get("key")
+            reason = (entry.get("reason") or "").strip()
+            if not key or not reason:
+                raise ValueError(
+                    f"{path}: baseline entry {entry!r} needs both a key "
+                    "and a non-empty reason"
+                )
+            if key in entries:
+                raise ValueError(f"{path}: duplicate baseline key {key!r}")
+            entries[key] = reason
+        return cls(entries)
+
+    def dump(self, path: Path) -> None:
+        payload = {
+            "version": 1,
+            "entries": [
+                {"key": key, "reason": reason}
+                for key, reason in sorted(self.entries.items())
+            ],
+        }
+        path.write_text(json.dumps(payload, indent=2) + "\n")
+
+    def split(
+        self, findings: Iterable[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """(new, accepted, stale-keys): new findings fail the run,
+        accepted ones are covered by the baseline, stale keys name
+        baseline entries whose finding no longer exists (prune them)."""
+        new: List[Finding] = []
+        accepted: List[Finding] = []
+        seen: Set[str] = set()
+        for finding in findings:
+            if finding.key in self.entries:
+                accepted.append(finding)
+                seen.add(finding.key)
+            else:
+                new.append(finding)
+        stale = sorted(set(self.entries) - seen)
+        return new, accepted, stale
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+# ---------------------------------------------------------------------------
+# module table
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str  # posix, relative to the scanned root
+    modname: str  # dotted, relative to the scanned root ("tas.cache")
+    tree: ast.Module
+    lines: List[str]
+    #: local name -> canonical dotted origin ("time", "time.sleep",
+    #: "datetime.datetime", "utils.trace" for in-package imports)
+    imports: Dict[str, str] = field(default_factory=dict)
+    #: function/method qualname ("Class.meth", "func") -> def node
+    functions: Dict[str, ast.AST] = field(default_factory=dict)
+    classes: Dict[str, ast.ClassDef] = field(default_factory=dict)
+    #: module-level NAME = "literal" constants
+    constants: Dict[str, str] = field(default_factory=dict)
+    pragmas: Pragmas = field(default_factory=Pragmas)
+
+
+def _canonical(module: str) -> str:
+    """Strip the package prefix so import origins match modnames."""
+    if module == PACKAGE:
+        return ""
+    if module.startswith(PACKAGE + "."):
+        return module[len(PACKAGE) + 1 :]
+    return module
+
+
+def _collect_imports(tree: ast.Module, modname: str) -> Dict[str, str]:
+    imports: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                origin = _canonical(alias.name)
+                local = alias.asname or alias.name.split(".")[0]
+                # "import a.b" binds "a"; only map when unambiguous
+                imports[local] = origin if alias.asname else _canonical(
+                    alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative import (the package itself uses none; fixture
+                # packages might): resolve against this module's package
+                parts = modname.split(".")
+                base = parts[: max(0, len(parts) - node.level)]
+                prefix = ".".join(base + ([node.module] if node.module else []))
+                prefix = prefix + "." if prefix else ""
+            else:
+                prefix = _canonical(node.module or "")
+                prefix = prefix + "." if prefix else ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                imports[alias.asname or alias.name] = prefix + alias.name
+    return imports
+
+
+def _collect_defs(
+    tree: ast.Module,
+) -> Tuple[Dict[str, ast.AST], Dict[str, ast.ClassDef]]:
+    functions: Dict[str, ast.AST] = {}
+    classes: Dict[str, ast.ClassDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            classes[node.name] = node
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    functions[f"{node.name}.{item.name}"] = item
+    return functions, classes
+
+
+def _collect_constants(tree: ast.Module) -> Dict[str, str]:
+    constants: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            constants[node.targets[0].id] = node.value.value
+    return constants
+
+
+def load_modules(
+    root: Path, skip: Sequence[str] = ()
+) -> Tuple[Dict[str, ModuleInfo], List[Finding]]:
+    """Parse every .py under ``root`` into the ModuleInfo table.
+    Returns (modules keyed by modname, pragma findings)."""
+    modules: Dict[str, ModuleInfo] = {}
+    findings: List[Finding] = []
+    root = root.resolve()
+    for path in sorted(root.rglob("*.py")):
+        relpath = path.relative_to(root).as_posix()
+        if any(relpath == s or relpath.startswith(s.rstrip("/") + "/") for s in skip):
+            continue
+        if "__pycache__" in relpath:
+            continue
+        source = path.read_text()
+        try:
+            tree = ast.parse(source, filename=str(path))
+        except SyntaxError as exc:
+            raise SyntaxError(f"{relpath}: {exc}") from exc
+        modname = relpath[:-3].replace("/", ".")
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        lines = source.splitlines()
+        pragmas, pragma_findings = collect_pragmas(relpath, lines)
+        findings.extend(pragma_findings)
+        functions, classes = _collect_defs(tree)
+        modules[modname] = ModuleInfo(
+            relpath=relpath,
+            modname=modname,
+            tree=tree,
+            lines=lines,
+            imports=_collect_imports(tree, modname),
+            functions=functions,
+            classes=classes,
+            constants=_collect_constants(tree),
+            pragmas=pragmas,
+        )
+    return modules, findings
+
+
+# ---------------------------------------------------------------------------
+# shared AST resolution helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Resolve a Name/Attribute chain to a canonical dotted string via
+    the module's import map; None for anything not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(imports.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def enclosing_functions(tree: ast.Module) -> Dict[int, str]:
+    """Map every statement line to its enclosing function qualname
+    (""), for attributing findings to functions."""
+    spans: Dict[int, str] = {}
+
+    def visit(node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{qual}.{child.name}" if qual else child.name
+                end = getattr(child, "end_lineno", child.lineno)
+                for line in range(child.lineno, end + 1):
+                    spans[line] = name
+                visit(child, name)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{qual}.{child.name}" if qual else child.name)
+            else:
+                visit(child, qual)
+
+    visit(tree, "")
+    return spans
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def run_checks(
+    root: Path,
+    checks: Optional[Sequence[str]] = None,
+    *,
+    skip: Sequence[str] = (),
+    hotpath_roots: Optional[Sequence[str]] = None,
+    metrics_inventory: Optional[str] = None,
+) -> List[Finding]:
+    """Run the selected checkers over ``root`` and return findings that
+    survive pragma suppression (bad pragmas included).  Baseline
+    filtering is the caller's job (:meth:`Baseline.split`)."""
+    from platform_aware_scheduling_tpu.analysis import (
+        clocks,
+        hotpath,
+        locks,
+        metricscheck,
+    )
+
+    selected = tuple(checks) if checks else CHECK_NAMES
+    unknown = set(selected) - set(CHECK_NAMES)
+    if unknown:
+        raise ValueError(f"unknown checks: {sorted(unknown)}")
+    modules, findings = load_modules(root, skip=skip)
+    if "clock" in selected:
+        findings.extend(clocks.check(modules))
+    if "hotpath" in selected:
+        findings.extend(hotpath.check(modules, roots=hotpath_roots))
+    if "locks" in selected:
+        findings.extend(locks.check(modules))
+    if "metrics" in selected:
+        findings.extend(metricscheck.check(modules, inventory=metrics_inventory))
+    kept: List[Finding] = []
+    for finding in findings:
+        mod = _module_for(modules, finding.path)
+        if (
+            finding.check != "pragma"
+            and mod is not None
+            and mod.pragmas.allows(finding.line, finding.check)
+        ):
+            continue
+        kept.append(finding)
+    kept.sort(key=lambda f: (f.path, f.line, f.check, f.code, f.symbol))
+    return kept
+
+
+def _module_for(modules: Dict[str, ModuleInfo], relpath: str) -> Optional[ModuleInfo]:
+    for mod in modules.values():
+        if mod.relpath == relpath:
+            return mod
+    return None

@@ -1,0 +1,419 @@
+"""Checker ``hotpath``: nothing blocking reachable from a verb handler.
+
+"Must never wedge a verb" (PR 9): the Filter/Prioritize/gas_filter
+handlers run on request threads; anything that sleeps, calls the
+kube/metrics APIs, touches files or sockets, or spins a retrying loop
+on that path turns one slow API server into cluster-wide scheduling
+latency.  PR 9 removed exactly such a bug by hand (a RETRYING journal
+read on the Filter thread); this checker makes the class structural.
+
+Mechanics: a lightweight intra-package call graph.  Edges come from
+
+  * bare and imported in-package function calls;
+  * ``self.meth()`` through the class and its in-package bases;
+  * ``self.attr.meth()`` through attribute→class bindings inferred from
+    annotated constructor params and ``self.attr = ClassName(...)``
+    assignments, plus the explicit :data:`EXTRA_BINDINGS` table for
+    collaborators assembly wires in untyped (``extender.gangs`` etc.);
+  * local ``var = ClassName(...); var.meth()`` construction.
+
+Code inside nested ``def``/``lambda`` bodies belongs to the nested
+function, not its definer — a closure handed to ``threading.Thread``
+runs off-thread and must not taint the verb path that builds it.
+
+Blocking atoms: ``time.sleep`` (and injectable ``self._sleep(...)``
+CALLS — taking a sleep is fine, calling it on a verb thread is not),
+kube/metrics client verbs by name (distinctive enough to flag on any
+receiver, which also sees through the FaultTolerantClient wrapper),
+file/socket/subprocess I/O, and ``.wait(...)`` on events/conditions.
+Retrying loops surface through the sleep/verb atoms they contain.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from platform_aware_scheduling_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+)
+
+#: verb entry points: "modname:Class.method"
+DEFAULT_ROOTS = (
+    "tas.telemetryscheduler:MetricsExtender.filter",
+    "tas.telemetryscheduler:MetricsExtender.prioritize",
+    "gas.scheduler:GASExtender.filter",
+    "gas.scheduler:GASExtender.prioritize",
+)
+
+#: kube/metrics API client verbs (kube/client.py + the custom-metrics
+#: read) — flagged on ANY receiver: the names are distinctive, and
+#: name-matching sees through FaultTolerantClient and the fakes alike.
+KUBE_VERBS = frozenset({
+    "list_nodes", "get_node", "patch_node",
+    "list_pods", "get_pod", "update_pod", "bind_pod", "evict_pod",
+    "get_lease", "create_lease", "update_lease",
+    "get_configmap", "create_configmap", "update_configmap",
+    "list_taspolicies", "get_taspolicy", "create_taspolicy",
+    "update_taspolicy", "delete_taspolicy",
+    "watch_taspolicies", "watch_pods", "watch_nodes",
+    "get_node_custom_metric",
+})
+
+#: canonical dotted callables that block or do I/O
+BLOCKING_DOTTED = {
+    "time.sleep": "sleep",
+    "subprocess.run": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "os.system": "subprocess",
+    "urllib.request.urlopen": "socket-io",
+    "socket.socket": "socket-io",
+    "socket.create_connection": "socket-io",
+}
+
+#: method names that block on ANY receiver: injectable sleeps and
+#: event/condition waits (taking them injected is sanctioned; CALLING
+#: them on a verb thread is the bug)
+BLOCKING_METHODS = {
+    "sleep": "sleep",
+    "_sleep": "sleep",
+    "wait": "wait",
+}
+
+#: (modname, Class, attr) -> (modname, Class): collaborator attributes
+#: assembly wires in untyped (``self.gangs = None`` then set from
+#: cmd/tas.py assemble()).  Keep this in sync with the extender
+#: attribute docs — a missing entry silently prunes the call graph.
+EXTRA_BINDINGS: Dict[Tuple[str, str, str], Tuple[str, str]] = {
+    ("tas.telemetryscheduler", "MetricsExtender", "rebalancer"): ("rebalance.loop", "Rebalancer"),
+    ("tas.telemetryscheduler", "MetricsExtender", "gangs"): ("gang.group", "GangTracker"),
+    ("tas.telemetryscheduler", "MetricsExtender", "forecaster"): ("forecast.engine", "Forecaster"),
+    ("tas.telemetryscheduler", "MetricsExtender", "slo"): ("utils.slo", "SLOEngine"),
+    ("tas.telemetryscheduler", "MetricsExtender", "flight"): ("utils.record", "FlightRecorder"),
+    ("tas.telemetryscheduler", "MetricsExtender", "degraded"): ("tas.degraded", "DegradedModeController"),
+    ("tas.telemetryscheduler", "MetricsExtender", "leadership"): ("kube.lease", "LeaseElector"),
+    ("tas.telemetryscheduler", "MetricsExtender", "planner"): ("tas.planner", "BatchPlanner"),
+    ("gas.scheduler", "GASExtender", "slo"): ("utils.slo", "SLOEngine"),
+    ("gas.scheduler", "GASExtender", "flight"): ("utils.record", "FlightRecorder"),
+    ("gang.group", "GangTracker", "journal"): ("gang.journal", "GangJournal"),
+}
+
+
+@dataclass
+class _Func:
+    key: str  # "modname:Qual.name"
+    modname: str
+    qualname: str
+    class_name: Optional[str]
+    node: ast.AST
+    calls: Set[str] = field(default_factory=set)  # resolved callee keys
+    atoms: List[Tuple[int, str, str]] = field(default_factory=list)  # (line, kind, detail)
+
+
+def iter_exec(root: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``root``'s executed-inline nodes: nested function/lambda
+    bodies are deferred code and belong to their own graph node."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _Graph:
+    def __init__(self, modules: Dict[str, ModuleInfo]):
+        self.modules = modules
+        self.funcs: Dict[str, _Func] = {}
+        #: "modname:Class" -> {attr: "modname:Class"}
+        self.bindings: Dict[str, Dict[str, str]] = {}
+        #: "modname:Class" -> in-package base class keys
+        self.bases: Dict[str, List[str]] = {}
+        self._build()
+
+    # -- construction -------------------------------------------------------
+
+    def _class_key(self, mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+        """Resolve an annotation/base/constructor expression to an
+        in-package class key.  Unwraps Optional[X]/ "X" strings."""
+        if isinstance(node, ast.Subscript):  # Optional[X], List[X] -> X
+            node = node.slice
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            dotted = node.value
+        else:
+            dotted = dotted_name(node, mod.imports)
+        if not dotted:
+            return None
+        # longest module prefix with a class remainder
+        parts = dotted.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:split])
+            rest = parts[split:]
+            target = self.modules.get(modname)
+            if target is not None and len(rest) == 1 and rest[0] in target.classes:
+                return f"{modname}:{rest[0]}"
+        # bare name defined in this module
+        if "." not in dotted and dotted in mod.classes:
+            return f"{mod.modname}:{dotted}"
+        return None
+
+    def _build(self) -> None:
+        for mod in self.modules.values():
+            for qual, node in mod.functions.items():
+                class_name = qual.split(".")[0] if "." in qual else None
+                key = f"{mod.modname}:{qual}"
+                self.funcs[key] = _Func(key, mod.modname, qual, class_name, node)
+            for cname, cnode in mod.classes.items():
+                ckey = f"{mod.modname}:{cname}"
+                self.bases[ckey] = [
+                    base_key
+                    for base in cnode.bases
+                    if (base_key := self._class_key(mod, base)) is not None
+                ]
+                self.bindings[ckey] = self._class_bindings(mod, cname, cnode)
+        for (modname, cname, attr), (tmod, tcls) in EXTRA_BINDINGS.items():
+            if f"{modname}:{cname}" in self.bindings and tmod in self.modules:
+                self.bindings[f"{modname}:{cname}"][attr] = f"{tmod}:{tcls}"
+        for func in self.funcs.values():
+            self._analyze(func)
+
+    def _class_bindings(
+        self, mod: ModuleInfo, cname: str, cnode: ast.ClassDef
+    ) -> Dict[str, str]:
+        """attr -> class key, from annotated params assigned to self and
+        direct ``self.attr = ClassName(...)`` constructions."""
+        bindings: Dict[str, str] = {}
+        for item in cnode.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            ann: Dict[str, str] = {}
+            args = item.args
+            for arg in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+                if arg.annotation is not None:
+                    key = self._class_key(mod, arg.annotation)
+                    if key:
+                        ann[arg.arg] = key
+            for node in iter_exec(item):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                target = node.targets[0]
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                value = node.value
+                if isinstance(value, ast.Name) and value.id in ann:
+                    bindings.setdefault(target.attr, ann[value.id])
+                elif isinstance(value, ast.Call):
+                    key = self._class_key(mod, value.func)
+                    if key:
+                        bindings.setdefault(target.attr, key)
+        return bindings
+
+    def _mro(self, ckey: str) -> List[str]:
+        out, stack = [], [ckey]
+        while stack:
+            current = stack.pop(0)
+            if current in out:
+                continue
+            out.append(current)
+            stack.extend(self.bases.get(current, []))
+        return out
+
+    def _method(self, ckey: str, name: str) -> Optional[str]:
+        for klass in self._mro(ckey):
+            key = f"{klass.split(':')[0]}:{klass.split(':')[1]}.{name}"
+            if key in self.funcs:
+                return key
+        return None
+
+    def _attr_class(self, ckey: Optional[str], attr: str) -> Optional[str]:
+        if ckey is None:
+            return None
+        for klass in self._mro(ckey):
+            bound = self.bindings.get(klass, {}).get(attr)
+            if bound:
+                return bound
+        return None
+
+    # -- per-function analysis ----------------------------------------------
+
+    def _analyze(self, func: _Func) -> None:
+        mod = self.modules[func.modname]
+        own_class = f"{func.modname}:{func.class_name}" if func.class_name else None
+        local_types: Dict[str, str] = {}
+        for node in iter_exec(func.node):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call):  # x = ClassName(...)
+                key = self._class_key(mod, value.func)
+                if key:
+                    local_types[node.targets[0].id] = key
+            elif (  # x = self.attr — the journal-flush aliasing pattern
+                isinstance(value, ast.Attribute)
+                and isinstance(value.value, ast.Name)
+                and value.value.id == "self"
+            ):
+                bound = self._attr_class(own_class, value.attr)
+                if bound:
+                    local_types[node.targets[0].id] = bound
+        for node in iter_exec(func.node):
+            if not isinstance(node, ast.Call):
+                continue
+            self._resolve_call(func, mod, own_class, local_types, node)
+
+    def _resolve_call(
+        self,
+        func: _Func,
+        mod: ModuleInfo,
+        own_class: Optional[str],
+        local_types: Dict[str, str],
+        node: ast.Call,
+    ) -> None:
+        callee = node.func
+        dotted = dotted_name(callee, mod.imports)
+        # blocking atoms first: canonical dotted, then method-name based
+        if dotted is not None and dotted in BLOCKING_DOTTED:
+            func.atoms.append((node.lineno, BLOCKING_DOTTED[dotted], dotted))
+            return
+        if isinstance(callee, ast.Name) and callee.id == "open" and "open" not in mod.imports:
+            func.atoms.append((node.lineno, "file-io", "open"))
+            return
+        if isinstance(callee, ast.Attribute):
+            if callee.attr in KUBE_VERBS:
+                func.atoms.append((node.lineno, "kube-call", callee.attr))
+                return
+            if callee.attr in BLOCKING_METHODS:
+                func.atoms.append(
+                    (node.lineno, BLOCKING_METHODS[callee.attr], callee.attr)
+                )
+                return
+        # graph edges
+        if isinstance(callee, ast.Name):
+            name = callee.id
+            if name in mod.functions:
+                func.calls.add(f"{mod.modname}:{name}")
+                return
+            ckey = self._class_key(mod, callee)
+            if ckey:  # constructor
+                init = self._method(ckey, "__init__")
+                if init:
+                    func.calls.add(init)
+                return
+            origin = mod.imports.get(name)
+            if origin and ":" not in origin:
+                target = self._imported_function(origin)
+                if target:
+                    func.calls.add(target)
+            return
+        if not isinstance(callee, ast.Attribute):
+            return
+        parts = []
+        base = callee
+        while isinstance(base, ast.Attribute):
+            parts.append(base.attr)
+            base = base.value
+        parts.reverse()  # attribute chain after the base expression
+        if isinstance(base, ast.Name):
+            if base.id == "self" and own_class is not None:
+                if len(parts) == 1:
+                    target = self._method(own_class, parts[0])
+                    if target:
+                        func.calls.add(target)
+                elif len(parts) == 2:
+                    bound = self._attr_class(own_class, parts[0])
+                    if bound:
+                        target = self._method(bound, parts[1])
+                        if target:
+                            func.calls.add(target)
+                return
+            if base.id in local_types and len(parts) == 1:
+                target = self._method(local_types[base.id], parts[0])
+                if target:
+                    func.calls.add(target)
+                return
+        if dotted is not None:
+            # module-qualified function or Class.method
+            target = self._imported_function(dotted)
+            if target:
+                func.calls.add(target)
+            else:
+                ckey = self._class_key(mod, callee)
+                if ckey:
+                    init = self._method(ckey, "__init__")
+                    if init:
+                        func.calls.add(init)
+
+    def _imported_function(self, dotted: str) -> Optional[str]:
+        """'utils.trace.exposition' or 'gang.group.GangTracker.reserve'
+        -> function key, when it names an in-package def."""
+        parts = dotted.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:split])
+            target = self.modules.get(modname)
+            if target is None:
+                continue
+            rest = ".".join(parts[split:])
+            if rest in target.functions:
+                return f"{modname}:{rest}"
+        return None
+
+
+def check(
+    modules: Dict[str, ModuleInfo],
+    roots: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    graph = _Graph(modules)
+    selected = [r for r in (roots or DEFAULT_ROOTS) if r in graph.funcs]
+    # BFS with parent pointers for readable "how did we get here" chains
+    parent: Dict[str, Optional[str]] = {}
+    queue: List[str] = []
+    for root in selected:
+        if root not in parent:
+            parent[root] = None
+            queue.append(root)
+    while queue:
+        current = queue.pop(0)
+        for callee in sorted(graph.funcs[current].calls):
+            if callee not in parent:
+                parent[callee] = current
+                queue.append(callee)
+
+    def chain(key: str) -> str:
+        hops = []
+        cursor: Optional[str] = key
+        while cursor is not None:
+            hops.append(cursor.split(":")[1])
+            cursor = parent[cursor]
+        return " <- ".join(hops)
+
+    findings: List[Finding] = []
+    for key in parent:
+        func = graph.funcs[key]
+        mod = modules[func.modname]
+        for line, kind, detail in func.atoms:
+            findings.append(Finding(
+                "hotpath",
+                f"blocking-{kind}",
+                mod.relpath,
+                line,
+                f"{func.key}:{detail}",
+                f"{detail} reachable from a verb entry point "
+                f"({chain(key)}) — nothing on the Filter/Prioritize "
+                "path may block",
+            ))
+    return findings

@@ -1,0 +1,196 @@
+"""Checker ``locks``: scope and ordering of hot-path locks.
+
+Two bug classes this makes structural:
+
+  * **heavy/blocking work under a lock** (PR 8: the per-node history
+    dict built while holding the cache lock; PR 10: the recorder
+    snapshotted per-SLO while holding the hot-path lock).  Inside a
+    ``with self._lock:`` body we flag sleeps, kube/metrics API verbs,
+    file/socket/subprocess I/O, and the known-heavy serializers
+    (``copy.deepcopy``, ``json.dumps/loads``, ``pickle.*``) — the
+    pattern is "snapshot under the lock, format outside it".
+  * **inconsistent two-lock order** — if one code path takes lock A
+    then B and another takes B then A, the deadlock is latent until the
+    schedules interleave.  Every nested acquisition is recorded as an
+    ordered pair keyed by lock *identity* (``module:Class.attr`` for
+    instance locks, ``module:name`` for module-level locks — identity
+    by declaration site, not by object, which is the right granularity
+    for a single-process control plane); both orders observed anywhere
+    in the package flags every site of both.
+
+Lock recognition is name-based: a ``with`` context whose final
+attribute/name contains ``lock``/``cond``/``cv``/``mutex``.  That is
+deliberate — the codebase's convention is ``self._lock`` /
+``self._journal_write_lock`` — and a renamed lock escaping the checker
+is a review problem, not a soundness one.  ``.wait()`` on the *held*
+lock object is exempt (a ``Condition.wait`` releases it); ``.wait()``
+on anything else while holding a lock is flagged.
+
+Bodies of nested ``def``/``lambda`` are skipped: they run later, on
+whatever thread calls them, not under this lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from platform_aware_scheduling_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+)
+from platform_aware_scheduling_tpu.analysis.hotpath import (
+    BLOCKING_DOTTED,
+    KUBE_VERBS,
+)
+
+#: serializer/copy calls heavy enough to forbid under a hot lock
+HEAVY_DOTTED = frozenset({
+    "copy.deepcopy",
+    "json.dumps",
+    "json.loads",
+    "pickle.dumps",
+    "pickle.loads",
+})
+
+_LOCKISH = ("lock", "cond", "mutex")
+
+
+def _is_lockish(name: str) -> bool:
+    lowered = name.lower()
+    return any(tok in lowered for tok in _LOCKISH) or lowered in ("cv", "_cv")
+
+
+def _lock_identity(
+    expr: ast.AST, mod: ModuleInfo, class_name: Optional[str]
+) -> Optional[Tuple[str, str]]:
+    """(identity, local-dotted) for a lock-ish ``with`` context, else
+    None.  local-dotted ("self._lock") is kept so ``.wait()`` on the
+    held object can be recognised."""
+    dotted = dotted_name(expr, mod.imports)
+    if dotted is None:
+        return None
+    leaf = dotted.split(".")[-1]
+    if not _is_lockish(leaf):
+        return None
+    if dotted.startswith("self.") and class_name:
+        return f"{mod.modname}:{class_name}.{dotted[5:]}", dotted
+    if "." not in dotted:
+        return f"{mod.modname}:{dotted}", dotted
+    return f"{mod.modname}:{dotted}", dotted
+
+
+class _LockWalker:
+    def __init__(self, mod: ModuleInfo, qual: str, node: ast.AST):
+        self.mod = mod
+        self.qual = qual
+        self.class_name = qual.split(".")[0] if "." in qual else None
+        self.node = node
+        self.findings: List[Finding] = []
+        #: ordered (outer, inner) -> first site seen in this function
+        self.pairs: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def walk(self) -> None:
+        self._visit_all(ast.iter_child_nodes(self.node), [])
+
+    # held: list of (identity, local-dotted) innermost-last
+    def _visit_all(self, nodes, held: List[Tuple[str, str]]) -> None:
+        for child in nodes:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired: List[Tuple[str, str]] = []
+                for item in child.items:
+                    ident = _lock_identity(
+                        item.context_expr, self.mod, self.class_name
+                    )
+                    if ident is not None:
+                        acquired.append(ident)
+                for ident, _ in acquired:
+                    for outer, _ in held:
+                        if outer != ident:
+                            self.pairs.setdefault(
+                                (outer, ident),
+                                (self.mod.relpath, child.lineno, self.qual),
+                            )
+                for item in child.items:
+                    self._visit_all(
+                        ast.iter_child_nodes(item.context_expr), held
+                    )
+                self._visit_all(child.body, held + acquired)
+                continue
+            if held and isinstance(child, ast.Call):
+                self._check_call(child, held)
+            self._visit_all(ast.iter_child_nodes(child), held)
+
+    def _check_call(self, node: ast.Call, held: List[Tuple[str, str]]) -> None:
+        lock_id = held[-1][0]
+        dotted = dotted_name(node.func, self.mod.imports)
+        if dotted is not None and dotted in BLOCKING_DOTTED:
+            self._flag(node, lock_id, "blocking-under-lock", dotted)
+            return
+        if dotted is not None and dotted in HEAVY_DOTTED:
+            self._flag(node, lock_id, "heavy-under-lock", dotted)
+            return
+        callee = node.func
+        if (
+            isinstance(callee, ast.Name)
+            and callee.id == "open"
+            and "open" not in self.mod.imports
+        ):
+            self._flag(node, lock_id, "blocking-under-lock", "open")
+            return
+        if not isinstance(callee, ast.Attribute):
+            return
+        if callee.attr in KUBE_VERBS:
+            self._flag(node, lock_id, "blocking-under-lock", callee.attr)
+            return
+        if callee.attr == "wait":
+            receiver = dotted_name(callee.value, self.mod.imports)
+            if receiver is not None and any(
+                receiver == local for _, local in held
+            ):
+                return  # Condition.wait on the held lock releases it
+            self._flag(node, lock_id, "blocking-under-lock", "wait")
+
+    def _flag(self, node: ast.Call, lock_id: str, code: str, detail: str) -> None:
+        kind = "blocking" if code.startswith("blocking") else "heavy"
+        self.findings.append(Finding(
+            "locks",
+            code,
+            self.mod.relpath,
+            node.lineno,
+            f"{self.qual}:{lock_id}:{detail}",
+            f"{detail} called while holding {lock_id} in {self.qual} — "
+            f"{kind} work belongs outside the lock (snapshot under it, "
+            "format/IO after release)",
+        ))
+
+
+def check(modules: Dict[str, ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    #: ordered (outer, inner) -> every (relpath, line, func) site
+    pairs: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+    for mod in modules.values():
+        for qual, node in mod.functions.items():
+            walker = _LockWalker(mod, qual, node)
+            walker.walk()
+            findings.extend(walker.findings)
+            for pair, site in walker.pairs.items():
+                pairs.setdefault(pair, []).append(site)
+    for (outer, inner), sites in sorted(pairs.items()):
+        if (inner, outer) not in pairs or (outer, inner) > (inner, outer):
+            continue  # report each inverted pair once, from the lesser order
+        for relpath, line, qual in sites + pairs[(inner, outer)]:
+            findings.append(Finding(
+                "locks",
+                "lock-order",
+                relpath,
+                line,
+                f"{qual}:{outer}<->{inner}",
+                f"inconsistent lock order: {outer} and {inner} are "
+                "acquired in both orders across the package — pick one "
+                "order and enforce it everywhere (latent deadlock)",
+            ))
+    return findings

@@ -179,11 +179,11 @@ class Cache:
         """Test helper: wait until the work queue drains."""
         import time
 
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + timeout  # pascheck: allow[clock] -- test helper polling REAL worker threads; a fake clock would never see them drain
         while time.monotonic() < deadline:
             if len(self.work_queue) == 0:
                 return True
-            time.sleep(0.01)
+            time.sleep(0.01)  # pascheck: allow[clock] -- real-thread poll interval, same boundary as the deadline above
         return False
 
     # -- node events (device-mirror feed) --------------------------------------

@@ -436,7 +436,7 @@ class GASExtender:
         """Write gas-ts + gas-container-cards with a conflict-retry loop
         (scheduler.go:82-119)."""
         pod_copy = pod.deep_copy()
-        ts = str(time.time_ns())
+        ts = str(time.time_ns())  # pascheck: allow[clock] -- gas-ts is an externally-visible wall-clock annotation mirroring scheduler.go; nothing replays it
         last_exc: Optional[Exception] = None
         for attempt in range(UPDATE_RETRY_COUNT):
             pod_copy.annotations[TS_ANNOTATION] = ts

@@ -60,6 +60,7 @@ class Informer:
         counters: Optional[CounterSet] = None,
         relist_backoff_base_s: float = 0.2,
         relist_backoff_max_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
     ):
         """A NAMED informer exports controller-loop health
         (docs/observability.md): ``pas_informer_relists_total`` /
@@ -74,6 +75,7 @@ class Informer:
         sees one relist per backoff window, not a tight relist storm.
         A watch that delivered at least one event resets the streak."""
         self._lw = list_watch
+        self._clock = clock
         self.name = name
         self.relist_backoff_base_s = relist_backoff_base_s
         self.relist_backoff_max_s = relist_backoff_max_s
@@ -240,7 +242,7 @@ class Informer:
                         "pas_informer_synced", 1,
                         labels={"informer": self.name},
                     )
-                watch_started = time.monotonic()
+                watch_started = self._clock()
                 for event_type, obj in self._lw.watch(self._resource_version):
                     if self._stop.is_set():
                         return
@@ -283,7 +285,7 @@ class Informer:
                 # for a single blip hours after the last storm
                 if (
                     watch_started is not None
-                    and time.monotonic() - watch_started
+                    and self._clock() - watch_started
                     > max(self.relist_backoff_max_s, 1.0)
                 ):
                     self._watch_failures = 0

@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Hashable, Optional, Tuple
+from typing import Any, Callable, Hashable, Optional, Tuple
 
 from platform_aware_scheduling_tpu.utils import trace
 from platform_aware_scheduling_tpu.utils.tracing import (
@@ -39,7 +39,9 @@ class WorkQueue:
         name: str = "",
         counters: Optional[CounterSet] = None,
         recorder: Optional[LatencyRecorder] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
+        self._clock = clock
         self._lock = threading.Condition()
         self._queue: deque = deque()
         self._dirty: set = set()
@@ -98,12 +100,12 @@ class WorkQueue:
     def get(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
         """Returns ``(item, shutdown)``; blocks until an item is available or
         the queue shuts down (then ``(None, True)``)."""
-        deadline = time.monotonic() + timeout if timeout is not None else None
+        deadline = self._clock() + timeout if timeout is not None else None
         with self._lock:
             while not self._queue and not self._shutdown:
                 remaining = None
                 if deadline is not None:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - self._clock()
                     if remaining <= 0:
                         return None, False
                 self._lock.wait(remaining)

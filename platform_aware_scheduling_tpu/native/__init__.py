@@ -84,7 +84,7 @@ def _build(so_path: str) -> bool:
     # timeout — never a concurrent builder's in-progress tmp)
     import time
 
-    now = time.time()
+    now = time.time()  # pascheck: allow[clock] -- compared against os.path.getmtime, which is wall time by definition
     try:
         for entry in os.listdir(_DIR):
             path = os.path.join(_DIR, entry)

@@ -478,10 +478,12 @@ static PyObject *materialize_names(PyObject *body, const StrSlice *slices,
 }
 
 static PyObject *ParsedArgs_node_names(ParsedArgs *self, PyObject *noargs) {
+    (void)noargs;
     return materialize_names(self->body, self->names, self->num_names);
 }
 
 static PyObject *ParsedArgs_node_names_list(ParsedArgs *self, PyObject *noargs) {
+    (void)noargs;
     return materialize_names(self->body, self->nn_names, self->num_nn_names);
 }
 
@@ -492,10 +494,12 @@ static PyObject *span_copy(ParsedArgs *self, Py_ssize_t start, Py_ssize_t end) {
 }
 
 static PyObject *ParsedArgs_nodes_span(ParsedArgs *self, PyObject *noargs) {
+    (void)noargs;
     return span_copy(self, self->nodes_span_start, self->nodes_span_end);
 }
 
 static PyObject *ParsedArgs_nn_span(ParsedArgs *self, PyObject *noargs) {
+    (void)noargs;
     return span_copy(self, self->nn_span_start, self->nn_span_end);
 }
 
@@ -1020,6 +1024,7 @@ static int scan_nodes(Scan *sc, ParsedArgs *pa, Py_ssize_t *cap) {
 }
 
 static PyObject *wirec_parse_prioritize(PyObject *mod, PyObject *arg) {
+    (void)mod;
     if (!PyBytes_Check(arg)) {
         PyErr_SetString(PyExc_TypeError, "body must be bytes");
         return NULL;
@@ -1179,6 +1184,7 @@ static PyTypeObject NameTable_Type = {
 };
 
 static PyObject *wirec_build_table(PyObject *mod, PyObject *arg) {
+    (void)mod;
     /* arg: sequence of str node names in row order; fragments use
      * json-exact escaping via json.dumps for non-ASCII-simple names */
     PyObject *seq = PySequence_Fast(arg, "expected a sequence of names");
@@ -1369,6 +1375,7 @@ static size_t ranked_estimate(NameTable *t, const uint8_t *mask) {
 }
 
 static PyObject *wirec_select_encode(PyObject *mod, PyObject *args) {
+    (void)mod;
     PyObject *parsed_obj, *table_obj, *ranked_obj;
     Py_ssize_t planned_row = -1;
     int use_node_names = 0;
@@ -1558,6 +1565,7 @@ static int emit_filter(Buf *out, const char *base, const StrSlice *cand,
  * one FailedNodes entry at first-occurrence position (dict semantics);
  * names absent from the table never violate (they pass through). */
 static PyObject *wirec_filter_encode(PyObject *mod, PyObject *args) {
+    (void)mod;
     PyObject *parsed_obj, *table_obj, *mask_obj, *reasons_obj = Py_None;
     if (!PyArg_ParseTuple(args, "OOO|O", &parsed_obj, &table_obj, &mask_obj,
                           &reasons_obj))
@@ -1815,6 +1823,7 @@ static PyObject *Universe_get(Universe *self, void *closure) {
  * consumer of this universe (exact host fallbacks would otherwise
  * materialize N fresh unicode objects per request) */
 static PyObject *Universe_names(Universe *self, PyObject *noargs) {
+    (void)noargs;
     if (self->names == NULL) {
         PyObject *tup = PyTuple_New(self->num);
         if (!tup) return NULL;
@@ -2198,12 +2207,14 @@ error:
  * iterates these to pre-render response skeletons off the request path */
 static PyObject *UniverseCache_snapshot(UniverseCache *self,
                                         PyObject *noargs) {
+    (void)noargs;
     return PyList_GetSlice(self->entries, 0,
                            PyList_GET_SIZE(self->entries));
 }
 
 static PyObject *UniverseCache_universes(UniverseCache *self,
                                          PyObject *noargs) {
+    (void)noargs;
     Py_ssize_t count = PyList_GET_SIZE(self->entries);
     PyObject *out = PyList_New(count);
     if (!out) return NULL;
@@ -2277,6 +2288,7 @@ static PyTypeObject UniverseCache_Type = {
  * dedup, reasons, and framing from the same per-row data.  Runs under
  * the GIL throughout (see the universe concurrency note). */
 static PyObject *wirec_filter_respond(PyObject *mod, PyObject *args) {
+    (void)mod;
     PyObject *universe_obj, *table_obj, *mask_obj, *reasons_obj = Py_None;
     if (!PyArg_ParseTuple(args, "OOO|O", &universe_obj, &table_obj,
                           &mask_obj, &reasons_obj))
@@ -2402,6 +2414,7 @@ done:
  * identical, so bytes match select_encode over the same request by
  * construction. */
 static PyObject *wirec_select_encode_universe(PyObject *mod, PyObject *args) {
+    (void)mod;
     PyObject *universe_obj, *table_obj, *ranked_obj;
     Py_ssize_t planned_row = -1;
     if (!PyArg_ParseTuple(args, "OOO|n", &universe_obj, &table_obj,
@@ -2490,6 +2503,7 @@ static struct PyModuleDef wirec_module = {
     PyModuleDef_HEAD_INIT, "_wirec",
     "Native wire-protocol fast path for the TPU scheduler extender.",
     -1, wirec_methods,
+    NULL, NULL, NULL, NULL,
 };
 
 PyMODINIT_FUNC PyInit__wirec(void) {

@@ -8,6 +8,8 @@ tests use (reference pkg/metrics/client_test.go:28-55,
 pkg/gpuscheduler/node_resource_cache_test.go:23-44).
 """
 
+# pascheck: allow-file[locks] -- the fake IS the store: deep-copying every object under its lock is its consistency contract (callers must never alias internal state), and test-sized objects make the O(N) cost irrelevant
+
 from __future__ import annotations
 
 import copy
@@ -519,7 +521,7 @@ class FakeKubeClient:
             "describedObject": {"kind": "Node", "name": node_name, "apiVersion": "/v1"},
             "metric": {"name": metric_name},
             "timestamp": timestamp
-            or datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+            or datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),  # pascheck: allow[clock] -- mimics the API server's server-side default; tests pass an explicit timestamp when they care
             "value": value,
         }
         if window_seconds is not None:

@@ -45,7 +45,7 @@ import json
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Mapping, Optional, Tuple
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
 
 from platform_aware_scheduling_tpu.utils import trace
 
@@ -213,7 +213,7 @@ class DecisionRecord:
         self.pod_name = pod_name
         self.policy = policy
         self.path = path
-        self.ts = time.time()
+        self.ts = 0.0  # stamped by the log's clock in add(), like seq
         self.candidates = candidates
         self.filtered = filtered
         self.eligible = max(0, candidates - filtered)
@@ -317,9 +317,15 @@ class DecisionLog:
     ones (awaiting bind/rebalance feedback).  Lock-light: one short lock
     per record/feedback event; /debug/decisions serves a snapshot."""
 
-    def __init__(self, capacity: int = 512, enabled: bool = True):
+    def __init__(
+        self,
+        capacity: int = 512,
+        enabled: bool = True,
+        clock: Callable[[], float] = time.time,
+    ):
         self.capacity = max(1, capacity)
         self.enabled = enabled
+        self._clock = clock
         self._lock = threading.Lock()
         self._records: deque = deque()
         self._open_by_pod: Dict[str, List[DecisionRecord]] = {}
@@ -362,6 +368,7 @@ class DecisionLog:
         with self._lock:
             self._seq += 1
             record.seq = self._seq
+            record.ts = self._clock()
             self._recorded_total += 1
             self._records.append(record)
             # records born closed (rebalance cycle summaries) never count
@@ -458,7 +465,7 @@ class DecisionLog:
         if not self.enabled:
             return
         key = f"{namespace}/{name}"
-        bound_at = time.time()
+        bound_at = self._clock()
         violated = False
         rank: Optional[int] = None
         # outcomes are assigned UNDER the lock: a record must never sit
@@ -508,7 +515,7 @@ class DecisionLog:
             return
         key = f"{namespace}/{name}"
         event = {
-            "ts": round(time.time(), 6),
+            "ts": round(self._clock(), 6),
             "action": action,
         }
         if detail:

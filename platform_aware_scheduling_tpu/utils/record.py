@@ -250,6 +250,8 @@ class FlightRecorder:
         """Versioned JSONL: a header object line, then one event per
         line — streamable, greppable, and the exact payload
         testing/replay.parse_capture consumes."""
+        # snapshot under the lock, serialize after release: dumping the
+        # whole ring is O(capacity) and this lock sits on the record path
         with self._lock:
             header = {
                 "format": FORMAT,
@@ -257,9 +259,9 @@ class FlightRecorder:
                 "events": len(self._ring),
                 "dropped": self._dropped,
             }
-            lines = [json.dumps(header, separators=(",", ":"))]
-            lines.extend(
-                json.dumps(event, separators=(",", ":"))
-                for event in self._ring
-            )
+            events = list(self._ring)
+        lines = [json.dumps(header, separators=(",", ":"))]
+        lines.extend(
+            json.dumps(event, separators=(",", ":")) for event in events
+        )
         return ("\n".join(lines) + "\n").encode()

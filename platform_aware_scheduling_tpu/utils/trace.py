@@ -271,7 +271,7 @@ class Span:
         now = time.perf_counter()
         self._t0 = t0 if t0 is not None else now
         # wall-clock start, back-dated when t0 predates construction
-        self.start_wall = time.time() - (now - self._t0)
+        self.start_wall = time.time() - (now - self._t0)  # pascheck: allow[clock] -- span start is observability-only wall time (log correlation), never control flow or replayed state
         self.duration_s: Optional[float] = None
         self.status: Optional[int] = None
         self.stages: List[Tuple[str, float, float]] = []  # (name, start, dur)
