@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record bench-replay test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record test-control bench-control bench-replay test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
 
 all: test
 
@@ -121,6 +121,19 @@ bench-twin:
 # record->export->parse->replay round trip, and the hermetic overhead pin
 test-record:
 	python -m pytest tests/test_record.py -q -m 'not slow'
+
+# budget feedback control suite (docs/observability.md "Budget feedback
+# control"): knob ladders/clamps/rate limit, hysteresis + trend pre-arm,
+# --sloControl fail-fast, /debug/control codes on both front-ends,
+# off-path byte-identity, and the static-vs-self-tuning head-to-heads
+test-control:
+	python -m pytest tests/test_control.py -q -m 'not slow'
+
+# the controller's head-to-head A/B alone: final error-budget ledgers
+# static vs self-tuning on both programs + the quiet-day null
+# (benchmarks/control_load.py); exits nonzero unless strictly better
+bench-control:
+	python -m benchmarks.control_load
 
 # replay throughput (legacy vs vectorized twin load model) + the
 # what-if demo: 2x load must degrade the availability verdict a 1x

@@ -170,7 +170,7 @@ def _detail_path(round_override=None) -> str:
 def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
     chaos=None, decisions=None, gang=None, forecast=None, ha=None,
-    twin=None, record=None,
+    twin=None, record=None, control=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -363,6 +363,16 @@ def assemble_line(
                     replay.get("whatif") or {}
                 ).get("degraded_at_2x"),
             }
+    if control is not None:
+        # full head-to-head verdicts (checks + judgments) to disk; the
+        # line keeps the final error-budget ledgers static vs
+        # self-tuning per program — the ISSUE 15 acceptance surface
+        # (benchmarks/control_load.py; docs/observability.md "Budget
+        # feedback control")
+        detail["control"] = control
+        from benchmarks import control_load as _control_load
+
+        result["control"] = _control_load.compact(control)
     if record is not None:
         # full pair-ratio lists + capture scrape to disk; the line keeps
         # the hermetic per-request delta (the stable number) next to the
@@ -669,6 +679,30 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"twin bench failed: {exc}", file=sys.stderr)
 
+    # --- budget feedback control: static vs self-tuning head-to-heads
+    # on the twin's final error-budget ledgers + the quiet-day null
+    # (benchmarks/control_load.py; docs/observability.md "Budget
+    # feedback control") ---
+    control_out = None
+    try:
+        from benchmarks import control_load
+
+        control_out = control_load.run()
+        summary = ", ".join(
+            f"{name}: static {entry['static']['budget']} vs tuned "
+            f"{entry['self_tuning']['budget']} "
+            f"({'better' if entry['strictly_better'] else 'NOT BETTER'})"
+            for name, entry in sorted(control_out["scenarios"].items())
+        )
+        print(
+            f"control: {summary}; quiet diurnal "
+            f"{control_out['diurnal_quiet']['actuations']} actuations "
+            f"({control_out['wall_s']}s wall)",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"control bench failed: {exc}", file=sys.stderr)
+
     # --- flight recorder: hermetic per-request delta (gc-fenced
     # interleaved on/off batches — the stable pin) + spawned wire p99
     # A/B at 10k nodes (benchmarks/http_load.py;
@@ -713,6 +747,7 @@ def main():
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
         decisions_out, gang, forecast_out, ha_out, twin_out, record_out,
+        control_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

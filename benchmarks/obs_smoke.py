@@ -152,6 +152,56 @@ def check_front_end(serving: str) -> str:
         assert "pas_slo_compliance" in families, (
             f"{serving}: wired engine's gauges missing from /metrics"
         )
+        # budget controller: 404 while off (--sloControl=off), then the
+        # full loop — wire a controller to the engine above, attach a
+        # knob, burn the availability budget through the engine's own
+        # tick, and watch the actuation land on /debug/control AND
+        # /metrics
+        assert "/debug/control" in paths, f"{serving}: index missing control"
+        status, _payload = _get(port, "/debug/control")
+        assert status == 404, (
+            f"{serving}: /debug/control must 404 while off -> {status}"
+        )
+        from platform_aware_scheduling_tpu.utils.control import (
+            BudgetController,
+        )
+        from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+        controller = BudgetController(engine)
+
+        class _SmokeQueue:
+            max_queue_depth = 64
+
+        queue = _SmokeQueue()
+        controller.attach_admission(queue, floor=4)
+        server.scheduler.control = controller
+        # a rejected-counter spike is an availability bad-event flood:
+        # the engine's next evaluation pages, the subscribed controller
+        # tightens the shed knob one ladder step
+        rejected = CounterSet()
+        rejected.inc("pas_serving_rejected_total", by=500)
+        engine.counter_sets.append(rejected)
+        engine.tick()
+        assert queue.max_queue_depth == 32, (
+            f"{serving}: burn never tightened the shed knob "
+            f"(depth {queue.max_queue_depth})"
+        )
+        status, payload = _get(port, "/debug/control")
+        assert status == 200, f"{serving}: /debug/control -> {status}"
+        control_snap = json.loads(payload)
+        assert control_snap["enabled"] is True
+        assert control_snap["recent"], (
+            f"{serving}: actuation missing from /debug/control provenance"
+        )
+        status, payload = _get(port, "/metrics")
+        assert status == 200
+        families = trace.parse_prometheus_text(payload.decode())
+        assert "pas_control_knob_setting" in families, (
+            f"{serving}: wired controller's gauges missing from /metrics"
+        )
+        control_note = (
+            f"control actuations={controller.actuation_count()}"
+        )
         # wire-path caches: 200 with universe/skeleton state on a device
         # extender (404 belongs to host-only assemblies, pinned in tests)
         assert "/debug/wire" in paths, f"{serving}: index missing wire"
@@ -229,8 +279,8 @@ def check_front_end(serving: str) -> str:
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "
-            f"{len(families)} metric families, {wire_note}, "
-            f"{record_note})"
+            f"{len(families)} metric families, {control_note}, "
+            f"{wire_note}, {record_note})"
         )
     finally:
         server.shutdown()

@@ -242,6 +242,72 @@ def build_slo_engine(args, extender, cache=None, period_s: float = 5.0):
     return engine
 
 
+def add_control_flags(parser: argparse.ArgumentParser) -> None:
+    """Budget-controller flag surface shared by both mains
+    (docs/observability.md "Budget feedback control")."""
+    parser.add_argument("--sloControl", default="off", choices=["off", "on"],
+                        help="close the SLO loop: a budget controller "
+                        "subscribes to the engine's burn-rate evaluations "
+                        "and steps bounded knobs — admission queue depth "
+                        "(availability), rebalancer max-moves/hysteresis "
+                        "(eviction safety), extrapolation band/horizon/LKG "
+                        "bounds (freshness) — one ladder step per tick, "
+                        "hysteretic loosening, every actuation on "
+                        "pas_control_* and GET /debug/control.  Requires "
+                        "--slo=on; off (the default) constructs nothing "
+                        "and leaves the wire byte-identical")
+
+
+def validate_control_flags(parser: argparse.ArgumentParser, args) -> None:
+    """Fail fast at flag parse on contradictory wiring: the controller
+    actuates on the SLO engine's evaluations, so --sloControl=on with
+    --slo=off could only ever no-op silently — reject it loudly
+    instead (parser.error exits 2 with usage, like any bad flag)."""
+    if (
+        getattr(args, "sloControl", "off") == "on"
+        and getattr(args, "slo", "off") != "on"
+    ):
+        parser.error(
+            "--sloControl=on requires --slo=on: the budget controller "
+            "actuates on the SLO engine's burn-rate evaluations; "
+            "without the judge there is nothing to control"
+        )
+
+
+def build_budget_controller(args, extender, engine):
+    """The BudgetController for --sloControl=on (None when off),
+    subscribed to ``engine`` and attached as ``extender.control`` (the
+    /debug/control + /metrics wiring keys off that attr).  Every
+    actuator the extender actually has gets a knob: the rebalancer's
+    aggressiveness pair, the forecaster's extrapolation bounds (plus
+    its surge signal as the trend pre-arm source), and the degraded
+    controller's last-known-good trust.  The admission knob is the
+    async front-end's dispatcher — the caller attaches it after
+    build_server (assembly order: the server does not exist yet
+    here)."""
+    if getattr(args, "sloControl", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.utils.control import BudgetController
+
+    forecaster = getattr(extender, "forecaster", None)
+    controller = BudgetController(
+        engine,
+        trend_source=(
+            forecaster.predicts_surge if forecaster is not None else None
+        ),
+    )
+    rebalancer = getattr(extender, "rebalancer", None)
+    if rebalancer is not None:
+        controller.attach_rebalancer(rebalancer)
+    if forecaster is not None:
+        controller.attach_forecaster(forecaster)
+    degraded = getattr(extender, "degraded", None)
+    if degraded is not None:
+        controller.attach_degraded(degraded)
+    extender.control = controller
+    return controller
+
+
 def add_record_flags(parser: argparse.ArgumentParser) -> None:
     """Flight-recorder flag surface shared by both mains
     (docs/observability.md "Flight recorder & what-if")."""

@@ -55,12 +55,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_forecast_flags(parser, forecast=False)
     common.add_ha_flags(parser, ha=False)
     common.add_slo_flags(parser)
+    common.add_control_flags(parser)
     common.add_record_flags(parser)
     return parser
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_arg_parser().parse_args(argv)
+    parser = build_arg_parser()
+    args = parser.parse_args(argv)
+    common.validate_control_flags(parser, args)
     klog.set_verbosity(args.v)
     common.configure_decisions(args)
 
@@ -86,6 +89,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     slo_engine = common.build_slo_engine(args, extender)
     if slo_engine is not None:
         slo_engine.start(common.slo_period(args, 5.0), stop=watch_stop)
+    # budget controller (--sloControl=on): GAS has no rebalancer/
+    # forecaster/degraded actuators, so only the admission knob (async
+    # serving) can attach below; the controller still observes
+    budget_controller = common.build_budget_controller(
+        args, extender, slo_engine
+    )
     # flight recorder (--flightRecorder=on): verb arrivals only — GAS
     # has no telemetry cache, so no decile/control events here
     common.build_flight_recorder(args, extender)
@@ -102,6 +111,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_batch=args.batchMax,
         max_queue_depth=args.queueDepth,
     )
+    if budget_controller is not None and hasattr(server, "dispatcher"):
+        budget_controller.attach_admission(server.dispatcher)
     done = threading.Event()
     failed = []
 

@@ -111,6 +111,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_forecast_flags(parser)
     common.add_ha_flags(parser)
     common.add_slo_flags(parser)
+    common.add_control_flags(parser)
     common.add_record_flags(parser)
     return parser
 
@@ -301,7 +302,9 @@ def build_server(
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_arg_parser().parse_args(argv)
+    parser = build_arg_parser()
+    args = parser.parse_args(argv)
+    common.validate_control_flags(parser, args)
     klog.set_verbosity(args.v)
     sync_period_s = parse_duration(args.syncPeriod)
     # decision provenance on/off + ring size, before any verb can record
@@ -360,6 +363,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if slo_engine is not None:
         slo_engine.start(common.slo_period(args, sync_period_s), stop=stop)
 
+    # budget feedback controller (--sloControl=on; docs/observability.md
+    # "Budget feedback control"): subscribed to the engine's post-tick
+    # hook, stepping the rebalancer/forecaster/degraded knobs — the
+    # admission knob joins below once the server (and so the dispatcher)
+    # exists.  Off (the default) builds nothing
+    budget_controller = common.build_budget_controller(
+        args, extender, slo_engine
+    )
+
     # flight recorder (--flightRecorder=on; docs/observability.md
     # "Flight recorder & what-if"): anonymized verb/telemetry/control
     # events into a bounded ring behind GET /debug/record and
@@ -385,6 +397,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_batch=args.batchMax,
         max_queue_depth=args.queueDepth,
     )
+    if budget_controller is not None and hasattr(server, "dispatcher"):
+        # the shed knob actuates the async front-end's live-read
+        # admission bound; the threaded server has no admission queue,
+        # so there the availability path simply has no knob
+        budget_controller.attach_admission(server.dispatcher)
     # /readyz also waits on the TASPolicy CRD informer's initial list —
     # the extender's own conditions (warm + telemetry freshness) come
     # from its readiness_conditions() via the server's probe

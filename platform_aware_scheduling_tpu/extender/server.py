@@ -86,6 +86,7 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/forecast", "description": "per-metric forecast fits: slopes, horizons, uncertainty bands (404 when --forecast=off)"},
     {"path": "/debug/leader", "description": "leader-election state: role, lease holder, fencing token (404 when --leaderElect is off)"},
     {"path": "/debug/slo", "description": "SLO compliance, error budgets, and multi-window burn rates (404 when --slo=off)"},
+    {"path": "/debug/control", "description": "budget feedback controller: knob settings, ladder levels, recent actuations with provenance (404 when --sloControl=off)"},
     {"path": "/debug/wire", "description": "wire-path caches: interned node-name universes, intern hit/miss/eviction counts, response-skeleton keys (404 without a device fastpath)"},
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
     {"path": "/debug/record", "description": "flight-recorder capture as versioned JSONL: anonymized verb arrivals, telemetry deciles, eviction/leader events (404 when --flightRecorder=off)"},
@@ -502,6 +503,22 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=slo_engine.to_json(),
+            )
+        if bare_path == "/debug/control":
+            # budget feedback controller (utils/control.py); 404 when no
+            # controller is wired (--sloControl=off), same convention
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            controller = getattr(self.scheduler, "control", None)
+            if controller is None:
+                return HTTPResponse.json(
+                    b'{"error": "budget controller not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=controller.to_json(),
             )
         if bare_path == "/debug/wire":
             # wire-path cache state (tas/fastpath.py wire_debug): interned

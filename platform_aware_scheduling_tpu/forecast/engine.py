@@ -107,6 +107,11 @@ class Forecaster:
         self.horizon_s = horizon_s
         self._period_s = period_s
         self.band_bound = float(band_bound)
+        #: optional cap (in refresh steps) on how far degraded-mode
+        #: extrapolation may reach, below the lookback-window default —
+        #: the budget controller tightens this when the freshness budget
+        #: is gone (utils/control.py); None means the window alone caps
+        self.horizon_cap: Optional[int] = None
         self.use_device = use_device
         self._clock = clock
         self.counters = counters if counters is not None else trace.COUNTERS
@@ -383,6 +388,39 @@ class Forecaster:
                 return False
         return known > 0
 
+    def predicts_surge(self, rate_threshold: float = 0.05) -> Tuple[bool, str]:
+        """The budget controller's trend pre-arm signal
+        (utils/control.py): True when any forecast metric's fleet-mean
+        slope implies growth faster than ``rate_threshold`` of its
+        current predicted magnitude per second — i.e. the fleet would
+        double inside ``1/rate_threshold`` seconds if the trend held.
+        Unit-free on purpose: slope and level are both in metric milli-
+        units, so the ratio compares a cpu storm and a memory storm on
+        the same scale."""
+        fit = self.ensure_current()
+        if fit is None:
+            return False, "no forecast fit yet"
+        period = self.period_s()
+        for name, row in sorted(fit.rows.items()):
+            if row >= fit.predicted.shape[0]:
+                continue
+            mask = fit.present[row]
+            if not mask.any():
+                continue
+            slope_per_s = (
+                float(fit.trend[row][mask].astype(np.float64).mean()) / period
+            )
+            level = float(
+                np.abs(fit.predicted[row][mask]).astype(np.float64).mean()
+            )
+            rate = slope_per_s / (level + _REL_FLOOR_MILLI)
+            if rate > rate_threshold:
+                return True, (
+                    f"{name} growing {rate:.4f}/s of current level "
+                    f"(threshold {rate_threshold:.4f}/s)"
+                )
+        return False, "no metric trending above threshold"
+
     def extrapolation_ok(self) -> Tuple[bool, str]:
         """May degraded LKG mode keep serving forecasts?  Yes while every
         forecast metric's mean relative uncertainty band stays inside
@@ -405,11 +443,38 @@ class Forecaster:
         fit.extrapolation = self._extrapolation_verdict(fit)
         return fit.extrapolation
 
+    def set_extrapolation_bounds(
+        self,
+        band_bound: Optional[float] = None,
+        horizon_cap: Optional[int] = None,
+    ) -> None:
+        """Retighten (or relax) the degraded-mode confidence bounds at
+        runtime — the budget controller's freshness actuator.  Clears the
+        memoized verdict on the CURRENT fit so a tightened bound applies
+        to requests already in flight against it, not just the next
+        refit: a controller that only affected future fits would keep
+        serving stale extrapolations for a whole refresh period after
+        the freshness budget was spent."""
+        with self._lock:
+            if band_bound is not None:
+                if band_bound <= 0:
+                    raise ValueError(f"band_bound must be > 0, got {band_bound}")
+                self.band_bound = float(band_bound)
+            if horizon_cap is not None:
+                if horizon_cap < 1:
+                    raise ValueError(f"horizon_cap must be >= 1, got {horizon_cap}")
+                self.horizon_cap = int(horizon_cap)
+            if self._fit is not None:
+                self._fit.extrapolation = None
+
     def _extrapolation_verdict(self, fit: _Fit) -> Tuple[bool, str]:
-        if fit.horizon_steps > self.window:
+        cap = self.window
+        if self.horizon_cap is not None:
+            cap = min(cap, self.horizon_cap)
+        if fit.horizon_steps > cap:
             return False, (
                 f"extrapolation horizon {fit.horizon_steps} steps exceeds "
-                f"the {self.window}-sample lookback window"
+                f"the {cap}-step cap ({self.window}-sample lookback window)"
             )
         worst = 0.0
         covered = 0
@@ -482,6 +547,7 @@ class Forecaster:
             "horizon_s": self.horizon_s,
             "period_s": self.period_s(),
             "band_bound": self.band_bound,
+            "horizon_cap": self.horizon_cap,
             "fitted": fit is not None,
         }
         if fit is None:

@@ -122,6 +122,11 @@ class GASExtender:
         # extender's recorder; front-ends serve GET /debug/slo (404
         # while None) and /metrics gains the pas_slo_* gauges
         self.slo = None
+        # opt-in utils.control.BudgetController (--sloControl=on): GAS
+        # has no serving/rebalance/forecast actuators, so the controller
+        # here only observes (ticks, /debug/control, pas_control_*) —
+        # knobs attach where the subsystems exist
+        self.control = None
         # opt-in utils.record.FlightRecorder (--flightRecorder=on):
         # gas_filter/gas_bind arrivals land in the ring as anonymized
         # (verb, candidate count) events — GAS has no interned-universe
@@ -141,6 +146,8 @@ class GASExtender:
         """The /metrics provider for this extender (utils/trace.py);
         pas_slo_* gauges join only while an SLO engine is wired."""
         counter_sets = [self.slo.counters] if self.slo is not None else []
+        if self.control is not None:
+            counter_sets.append(self.control.counters)
         if self.flight is not None:
             counter_sets.append(self.flight.counters)
         return trace.exposition(

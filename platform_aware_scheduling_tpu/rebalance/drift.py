@@ -29,10 +29,21 @@ class DriftDetector:
     thread that publishes violations."""
 
     def __init__(self, k: int = DEFAULT_HYSTERESIS_CYCLES):
-        if k < 1:
-            raise ValueError(f"hysteresis cycles must be >= 1, got {k}")
         self.k = k
         self._streaks: Dict[str, int] = {}
+
+    @property
+    def k(self) -> int:
+        return self._k
+
+    @k.setter
+    def k(self, value: int) -> None:
+        # mutated at runtime by the budget controller
+        # (loop.set_aggressiveness); a bad write must never silently
+        # disable hysteresis, so the invariant holds at every assignment
+        if value < 1:
+            raise ValueError(f"hysteresis cycles must be >= 1, got {value}")
+        self._k = int(value)
 
     def observe(
         self,

@@ -132,6 +132,30 @@ class Rebalancer:
         """Subscribe to the enforcer's violation publications."""
         enforcer.violation_observers.append(self.on_violations)
 
+    def set_aggressiveness(
+        self,
+        max_moves: Optional[int] = None,
+        hysteresis_k: Optional[int] = None,
+    ) -> None:
+        """Runtime modulation of how hard the rebalancer pushes — the
+        budget controller's eviction-safety actuator.  Both fields are
+        read live inside the cycle (plan() caps on replanner.max_moves,
+        streak promotion compares against drift.k), so a mid-flight
+        tightening applies to the very next cycle without restart.
+        Raising k mid-streak never evicts retroactively: streaks only
+        promote when they REACH the threshold, so a longer fuse simply
+        delays candidates already burning."""
+        if max_moves is not None:
+            if max_moves < 1:
+                raise ValueError(f"max_moves must be >= 1, got {max_moves}")
+            self.replanner.max_moves = int(max_moves)
+        if hysteresis_k is not None:
+            if hysteresis_k < 1:
+                raise ValueError(
+                    f"hysteresis_k must be >= 1, got {hysteresis_k}"
+                )
+            self.drift.k = int(hysteresis_k)
+
     def on_violations(
         self, strategy_type: str, violations: Dict[str, List[str]]
     ) -> None:

@@ -121,6 +121,9 @@ class AsyncServer:
                 slo_engine = getattr(self.scheduler, "slo", None)
                 if slo_engine is not None:
                     sets.append(slo_engine.counters)
+                controller = getattr(self.scheduler, "control", None)
+                if controller is not None:
+                    sets.append(controller.counters)
                 return trace.exposition(
                     recorders=[self.recorder], counter_sets=sets
                 )

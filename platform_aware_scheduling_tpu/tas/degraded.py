@@ -75,8 +75,12 @@ class DegradedModeController:
         self.breakers = breakers  # CircuitBreakerRegistry or None
         self.mode = mode
         #: explicit last-known-good age bound; None derives it from the
-        #: cache's freshness bound x DEFAULT_LKG_BOUND_MULTIPLE
+        #: cache's freshness bound x lkg_bound_multiple
         self.lkg_max_age_s = lkg_max_age_s
+        #: how many freshness bounds past staleness LKG answers stay
+        #: servable — the budget controller tightens this toward 1.0
+        #: when the freshness error budget is spent (utils/control.py)
+        self.lkg_bound_multiple = DEFAULT_LKG_BOUND_MULTIPLE
         self.counters = counters if counters is not None else trace.COUNTERS
         self._lock = threading.Lock()
         # optional forecast.Forecaster (docs/forecast.md): while telemetry
@@ -126,7 +130,7 @@ class DegradedModeController:
             bound = self.cache.freshness_bound()
         if bound is None:
             return None
-        return bound * DEFAULT_LKG_BOUND_MULTIPLE
+        return bound * self.lkg_bound_multiple
 
     def _within_lkg_bound(self) -> bool:
         """Every registered metric still has retained data younger than
@@ -267,6 +271,7 @@ class DegradedModeController:
         prioritize_action, _ = self.prioritize_decision()
         return {
             "mode": self.mode,
+            "lkg_bound_multiple": self.lkg_bound_multiple,
             "degraded": sorted(self.degraded_subsystems()),
             "telemetry": {"ok": telemetry_ok, "reason": telemetry_reason},
             "kube_api": {"ok": kube_ok, "reason": kube_reason},

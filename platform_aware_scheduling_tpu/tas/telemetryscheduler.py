@@ -144,6 +144,15 @@ class MetricsExtender:
         # touches the request path either way (docs/observability.md
         # "SLOs & error budgets")
         self.slo = None
+        # opt-in utils.control.BudgetController, set by assembly when
+        # --sloControl=on (requires --slo=on): subscribes to the SLO
+        # engine's post-tick hook and steps the attached knobs; the
+        # front-ends serve GET /debug/control (404 while this is None)
+        # and /metrics gains the pas_control_* families.  Off (None)
+        # constructs nothing and leaves the wire byte-identical — the
+        # controller only ever mutates knobs other components already
+        # read live (docs/observability.md "Budget feedback control")
+        self.control = None
         # opt-in utils.record.FlightRecorder, set by assembly when
         # --flightRecorder=on: the verbs append one anonymized arrival
         # event each (universe digest + candidate count, never names),
@@ -389,6 +398,8 @@ class MetricsExtender:
         engine is wired — its pas_slo_* gauges (the engine owns its own
         CounterSet precisely so --slo=off emits nothing)."""
         counter_sets = [self.slo.counters] if self.slo is not None else []
+        if self.control is not None:
+            counter_sets.append(self.control.counters)
         if self.flight is not None:
             counter_sets.append(self.flight.counters)
         return trace.exposition(

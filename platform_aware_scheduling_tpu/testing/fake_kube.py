@@ -328,6 +328,10 @@ class FakeKubeClient:
         if self.fail_next_evict is not None:
             exc, self.fail_next_evict = self.fail_next_evict, None
             raise exc
+        # plan-driven faults like every other verb: a scenario can break
+        # the eviction API for a window (twin control head-to-heads drive
+        # the eviction-safety burn through this)
+        self._fault("evict_pod")
         key = (namespace, pod_name)
         with self._lock:
             if key not in self._pods:

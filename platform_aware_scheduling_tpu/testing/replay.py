@@ -39,9 +39,10 @@ actually replay needs the full stack.
 
 from __future__ import annotations
 
+import io
 import json
 from bisect import bisect_right
-from typing import Dict, List, Optional, Union
+from typing import Dict, Iterable, List, Optional, Union
 
 import numpy as np
 
@@ -58,9 +59,12 @@ _REPLAY_VERBS = ("prioritize", "filter")
 
 #: endpoint safety rails: /debug/whatif builds a real twin, so a spec
 #: cannot ask for more than this off one POST (CLI callers can override
-#: nothing here — captures themselves are ring-bounded)
+#: nothing here — captures themselves are ring-bounded).  The tick cap
+#: sits at 20000 because :func:`_parse_jsonl_lines` streams the JSONL
+#: instead of materializing text + events side by side — the replay
+#: loop itself is O(ticks) in time, not memory
 MAX_REPLAY_NODES = 4096
-MAX_REPLAY_TICKS = 2000
+MAX_REPLAY_TICKS = 20000
 
 #: a replay node hosts at most this many synthesized pods: one below
 #: the twin's node_cap (4) so eviction rebinding always has headroom
@@ -181,45 +185,63 @@ class Capture:
         }
 
 
+def _parse_jsonl_lines(lines: Iterable) -> Capture:
+    """Stream JSONL capture lines into a :class:`Capture` without
+    materializing the source text: each line is decoded (if bytes),
+    parsed, and either claimed as the header (the first object with
+    ``"format"`` and no ``"kind"``) or appended as an event.  Only the
+    parsed event dicts are held — the raw capture is consumed line by
+    line, which is what lets ``MAX_REPLAY_TICKS`` sit at 20000 without
+    a 20000-tick capture doubling its footprint during parse."""
+    header: Optional[Dict] = None
+    events: List[Dict] = []
+    for i, line in enumerate(lines):
+        if isinstance(line, bytes):
+            try:
+                line = line.decode("utf-8")
+            except UnicodeDecodeError as exc:
+                raise CaptureError(
+                    f"capture line {i + 1} is not utf-8: {exc}"
+                ) from exc
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError as exc:
+            raise CaptureError(
+                f"capture line {i + 1} is not JSON: {exc}"
+            ) from exc
+        if not isinstance(obj, dict):
+            raise CaptureError(
+                f"capture line {i + 1} is not an object"
+            )
+        if header is None and "format" in obj and "kind" not in obj:
+            header = obj
+        else:
+            events.append(obj)
+    if header is None and not events:
+        raise CaptureError("capture is empty")
+    return Capture(events, header=header)
+
+
 def parse_capture(
-    source: Union[bytes, str, Dict, List, FlightRecorder]
+    source: Union[bytes, str, Dict, List, FlightRecorder, Iterable]
 ) -> Capture:
     """Parse any capture shape the system hands around — the
-    ``GET /debug/record`` JSONL (bytes or text), a decoded
-    ``{"format": ..., "events": [...]}`` object, a bare event list, or
-    a live :class:`FlightRecorder` — into a :class:`Capture`.  Raises
+    ``GET /debug/record`` JSONL (bytes, text, or an open file / line
+    iterable), a decoded ``{"format": ..., "events": [...]}`` object,
+    a bare event list, or a live :class:`FlightRecorder` — into a
+    :class:`Capture`.  JSONL input is streamed line by line (see
+    :func:`_parse_jsonl_lines`), so large captures parse without the
+    whole-text-then-list double footprint.  Raises
     :class:`CaptureError` on anything unreplayable."""
     if isinstance(source, FlightRecorder):
         return Capture(source.events(), header=source.snapshot())
     if isinstance(source, bytes):
-        try:
-            source = source.decode("utf-8")
-        except UnicodeDecodeError as exc:
-            raise CaptureError(f"capture is not utf-8: {exc}") from exc
+        return _parse_jsonl_lines(io.BytesIO(source))
     if isinstance(source, str):
-        header: Optional[Dict] = None
-        events: List[Dict] = []
-        for i, line in enumerate(source.splitlines()):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except ValueError as exc:
-                raise CaptureError(
-                    f"capture line {i + 1} is not JSON: {exc}"
-                ) from exc
-            if not isinstance(obj, dict):
-                raise CaptureError(
-                    f"capture line {i + 1} is not an object"
-                )
-            if header is None and "format" in obj and "kind" not in obj:
-                header = obj
-            else:
-                events.append(obj)
-        if header is None and not events:
-            raise CaptureError("capture is empty")
-        return Capture(events, header=header)
+        return _parse_jsonl_lines(io.StringIO(source))
     if isinstance(source, dict):
         events = source.get("events")
         if not isinstance(events, list):
@@ -230,6 +252,10 @@ def parse_capture(
         return Capture(events, header=header)
     if isinstance(source, list):
         return Capture(source)
+    # file-like / generator of lines, checked last: dict and list are
+    # iterable too, and those shapes mean decoded JSON, not JSONL
+    if hasattr(source, "__iter__"):
+        return _parse_jsonl_lines(source)
     raise CaptureError(
         f"cannot parse a capture from {type(source).__name__}"
     )

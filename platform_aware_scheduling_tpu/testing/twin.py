@@ -66,6 +66,42 @@ from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 GAS_NODES = 4  # the GAS lane's GPU nodes, constant across scales
 
 
+class AdmissionQueue:
+    """The twin's stand-in for AsyncServer's bounded admission queue
+    (serving/dispatcher.py), with the two failure modes a real queue
+    has and the legacy per-tick ``serving_capacity`` shed model lacks:
+
+      * **early shed**: past ``max_queue_depth`` (live-read — this is
+        the budget controller's admission knob), a request is rejected
+        the tick it arrives — the cheap 503 + Retry-After path; the
+        client backs off, so a shed never re-enters demand;
+      * **queue timeout**: a request that waits more than
+        ``timeout_ticks`` without being served expires client-side —
+        and with ``retry_storm`` on, each first-time timeout RETRIES
+        once next tick, the metastable amplification that makes a deep
+        queue under sustained overload strictly worse than shedding
+        (both outcomes count into ``pas_serving_rejected_total``, so
+        the availability SLO sees them identically — the ledger
+        difference is purely how MANY each policy produces).
+    """
+
+    def __init__(
+        self,
+        max_queue_depth: int = 64,
+        timeout_ticks: int = 2,
+        retry_storm: bool = False,
+    ):
+        self.max_queue_depth = max(1, int(max_queue_depth))
+        self.timeout_ticks = max(1, int(timeout_ticks))
+        self.retry_storm = bool(retry_storm)
+        #: queued entries: [age_ticks, verb, body, is_retry]
+        self.backlog: List[List] = []
+        #: timeouts carried into the next tick's demand (retry storm)
+        self.retries: List[Tuple[str, bytes]] = []
+        self.timeouts = 0
+        self.sheds = 0
+
+
 def _prioritize_body(pod_name: str, names: List[str]) -> bytes:
     return json.dumps(
         {
@@ -146,6 +182,10 @@ class TwinCluster(HAHarness):
         lease_duration_s: float = 15.0,
         serving_capacity: Optional[int] = None,
         vectorized: bool = True,
+        admission_depth: Optional[int] = None,
+        admission_timeout_ticks: int = 2,
+        retry_storm: bool = False,
+        control: bool = False,
     ):
         super().__init__(
             replicas=replicas,
@@ -182,6 +222,19 @@ class TwinCluster(HAHarness):
         #: a what-if load multiplier exactly as production would
         self.serving_capacity = serving_capacity
         self.serving_counters = CounterSet()
+        #: opt-in queued-admission model (None = the legacy capacity
+        #: shed path above, byte-identical for every existing scenario):
+        #: a bounded backlog with queue timeouts and optional retry
+        #: amplification, serving ``serving_capacity`` requests per tick
+        #: through the real handlers — the surface the budget
+        #: controller's admission knob actuates in the head-to-heads
+        self.admission: Optional[AdmissionQueue] = None
+        if admission_depth is not None:
+            self.admission = AdmissionQueue(
+                max_queue_depth=admission_depth,
+                timeout_ticks=admission_timeout_ticks,
+                retry_storm=retry_storm,
+            )
         #: vectorized per-tick load model (numpy bincount over interned
         #: node ordinals + memoized NodeMetric publication); the legacy
         #: dict path stays selectable so benchmarks/twin_load.py can
@@ -323,6 +376,41 @@ class TwinCluster(HAHarness):
                     stack.extender.slo = self.engine
             if self.gas is not None:
                 self.gas.slo = self.engine
+        # -- the budget controller (utils/control.py): subscribed to the
+        # engine, actuating the admission queue plus the FIRST replica's
+        # rebalancer/degraded knobs (single-replica head-to-heads; a
+        # restarted replica's fresh stack is not re-attached).  control
+        # defaults off so every pre-existing scenario runs the identical
+        # uncontrolled program
+        self.controller = None
+        if control:
+            if self.engine is None:
+                raise ValueError("control=True requires slo=True")
+            from platform_aware_scheduling_tpu.utils.control import (
+                BudgetController,
+            )
+            from platform_aware_scheduling_tpu.utils.decisions import (
+                DecisionLog,
+            )
+
+            self.controller = BudgetController(
+                self.engine, decision_log=DecisionLog()
+            )
+            if self.admission is not None:
+                # the floor is the per-tick drain rate: a queue shorter
+                # than what the server can serve each tick would starve
+                # a fully-loaded server — shedding must cap WAITING,
+                # never throughput
+                self.controller.attach_admission(
+                    self.admission,
+                    floor=max(2, self.serving_capacity or 2),
+                )
+            stack = next(s for s in self.replicas if s is not None)
+            self.controller.attach_rebalancer(stack.rebalancer)
+            self.controller.attach_degraded(stack.degraded)
+            for stack in self.replicas:
+                if stack is not None:
+                    stack.extender.control = self.controller
 
     # -- signal plumbing -------------------------------------------------------
 
@@ -494,6 +582,9 @@ class TwinCluster(HAHarness):
         live = self.live()
         if not live or self.gang:
             return
+        if self.admission is not None:
+            self._drive_queued_traffic(live[0].extender)
+            return
         if self._bodies is None:
             names = self.live_node_names()
             self._bodies = [
@@ -537,6 +628,78 @@ class TwinCluster(HAHarness):
                         _gas_filter_body("twin-gas-pod", self._gas_names),
                     )
                 )
+                if response.status != 200:
+                    self.traffic["errors"] += 1
+            except Exception:
+                self.traffic["errors"] += 1
+
+    def _drive_queued_traffic(self, extender) -> None:
+        """The queued-admission tick: age -> timeout -> admit -> serve.
+        Serving still goes through the REAL verb handlers (those are the
+        good events the availability SLO counts); sheds and timeouts
+        both land on ``pas_serving_rejected_total``.  The GAS lane is
+        not modeled here — the head-to-head scenarios run gas=False."""
+        q = self.admission
+        # 1. everything queued last tick has now waited one tick longer
+        for entry in q.backlog:
+            entry[0] += 1
+        # 2. queue timeouts: the client's deadline expired while the
+        # request sat unserved — a bad event that (retry storm) also
+        # re-enters demand once, the amplification a deep queue invites
+        still: List[List] = []
+        retry_next: List[Tuple[str, bytes]] = []
+        for age, verb, body, is_retry in q.backlog:
+            if age > q.timeout_ticks:
+                q.timeouts += 1
+                self.traffic["errors"] += 1
+                self.serving_counters.inc("pas_serving_rejected_total")
+                if q.retry_storm and not is_retry:
+                    retry_next.append((verb, body))
+            else:
+                still.append([age, verb, body, is_retry])
+        q.backlog = still
+        # 3. admit: last tick's retries, then this tick's fresh demand.
+        # A full queue sheds instantly (503 + Retry-After — the client
+        # backs off, so a shed never retries)
+        demand: List[Tuple[str, bytes, bool]] = [
+            (verb, body, True) for verb, body in q.retries
+        ]
+        q.retries = retry_next
+        if self._bodies is None:
+            names = self.live_node_names()
+            self._bodies = [
+                _prioritize_body(f"twin-pod-{i}", names)
+                for i in range(max(1, self.requests_per_tick))
+            ]
+        for i in range(self.requests_per_tick):
+            body = self._bodies[i % len(self._bodies)]
+            demand.append(("prioritize", body, False))
+            demand.append(("filter", body, False))
+        for verb, body, is_retry in demand:
+            self.traffic["requests"] += 1
+            if len(q.backlog) >= q.max_queue_depth:
+                q.sheds += 1
+                self.traffic["errors"] += 1
+                self.serving_counters.inc("pas_serving_rejected_total")
+                continue
+            q.backlog.append([0, verb, body, is_retry])
+        # 4. serve the oldest up to capacity through the real handlers
+        capacity = (
+            self.serving_capacity
+            if self.serving_capacity is not None
+            else len(q.backlog)
+        )
+        served = 0
+        while q.backlog and served < capacity:
+            _age, verb, body, _is_retry = q.backlog.pop(0)
+            served += 1
+            path = (
+                "/scheduler/prioritize"
+                if verb == "prioritize"
+                else "/scheduler/filter"
+            )
+            try:
+                response = getattr(extender, verb)(_request(path, body))
                 if response.status != 200:
                     self.traffic["errors"] += 1
             except Exception:
@@ -754,7 +917,7 @@ class Scenario:
                 self.apply(twin, t)
                 twin.tick()
             checks = self.checks(twin)
-            return {
+            result = {
                 "name": self.name,
                 "passed": all(c["ok"] for c in checks),
                 "ticks": total,
@@ -762,7 +925,19 @@ class Scenario:
                 "traffic": dict(twin.traffic),
                 "checks": checks,
                 "judgment": twin.judgment(),
+                "actuations": (
+                    twin.controller.actuation_count()
+                    if getattr(twin, "controller", None) is not None
+                    else 0
+                ),
             }
+            if twin.admission is not None:
+                result["admission"] = {
+                    "sheds": twin.admission.sheds,
+                    "timeouts": twin.admission.timeouts,
+                    "final_depth": twin.admission.max_queue_depth,
+                }
+            return result
         finally:
             twin.close()
 
@@ -1247,6 +1422,225 @@ class GangWave(Scenario):
             )
         )
         return checks
+
+
+class ControlMetricStorm(Scenario):
+    """The availability head-to-head program: a metric-API outage AND a
+    demand surge land together on the queued-admission model, with a
+    retry storm armed (each queue timeout retries once).  A static deep
+    queue turns the surge into timeouts, and timeouts into MORE demand —
+    the metastable amplification; a self-tuning run tightens the
+    admission depth as the availability budget burns, converting the
+    excess into cheap early sheds that never retry.  Both outcomes are
+    bad availability events, so the final error-budget ledger is the
+    honest comparison: fewer bad events, strictly more budget left.
+    Run twice (control False/True) by :func:`control_headtohead`."""
+
+    name = "control_metric_storm"
+    healthy_ticks = 6
+    surge_ticks = 12
+    baseline_requests = 2
+    surge_requests = 8
+
+    def __init__(self, control: bool = False):
+        self.control = bool(control)
+
+    def build(self, scale: Dict) -> TwinCluster:
+        scale = dict(scale)
+        scale.update(
+            gas=False,
+            control=self.control,
+            serving_capacity=4,
+            requests_per_tick=self.baseline_requests,
+            admission_depth=64,
+            admission_timeout_ticks=2,
+            retry_storm=True,
+        )
+        return TwinCluster(**scale)
+
+    def ticks(self, scale: Dict) -> int:
+        return self.healthy_ticks + self.surge_ticks + 8
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.healthy_ticks:
+            twin.mark_storm()
+            twin.plan.outage("get_node_metric", status=503)
+            twin.requests_per_tick = self.surge_requests
+        if t == self.healthy_ticks + self.surge_ticks:
+            twin.plan.clear("get_node_metric")
+            twin.requests_per_tick = self.baseline_requests
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        q = twin.admission
+        stressed = q is not None and (q.sheds + q.timeouts) > 0
+        checks = [
+            self._check(
+                "admission_stressed",
+                stressed,
+                f"sheds {q.sheds}, timeouts {q.timeouts}"
+                if q is not None
+                else "no admission model",
+            )
+        ]
+        actuations = (
+            twin.controller.actuation_count()
+            if twin.controller is not None
+            else 0
+        )
+        if self.control:
+            checks.append(
+                self._check(
+                    "controller_engaged",
+                    actuations > 0,
+                    f"{actuations} actuations under the storm",
+                )
+            )
+        else:
+            checks.append(
+                self._check(
+                    "static_config_untouched",
+                    actuations == 0,
+                    "no controller in the static run",
+                )
+            )
+        return checks
+
+
+class ControlDeploymentWave(Scenario):
+    """The eviction-safety head-to-head program: the deployment wave
+    lands exactly as in :class:`DeploymentWave`, but the eviction API is
+    down for a window starting with the wave.  A static rebalancer slams
+    its full churn budget into the broken dependency every cycle (every
+    attempt a bad eviction-safety event, and five consecutive failures
+    trip the kube circuit — collateral degradation); a self-tuning run
+    throttles ``max_moves`` down and the drift hysteresis up as the
+    safety budget burns, backing off the dependency, then drains the
+    wave after the API heals.  Run twice by :func:`control_headtohead`;
+    the ledger compared is eviction_safety's."""
+
+    name = "control_deployment_wave"
+    wave_start = DeploymentWave.wave_start
+    ramp_ticks = DeploymentWave.ramp_ticks
+    peak_base = DeploymentWave.peak_base
+    outage_start = DeploymentWave.wave_start
+    outage_ticks = 16
+
+    def __init__(self, control: bool = False):
+        self.control = bool(control)
+
+    def build(self, scale: Dict) -> TwinCluster:
+        scale = dict(scale)
+        scale.update(gas=False, control=self.control)
+        return TwinCluster(**scale)
+
+    def ticks(self, scale: Dict) -> int:
+        return 44
+
+    _hot = DeploymentWave._hot
+    _wave_apply = DeploymentWave.apply
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        self._wave_apply(twin, t)
+        if t == self.outage_start:
+            twin.plan.outage("evict_pod", status=503)
+        if t == self.outage_start + self.outage_ticks:
+            twin.plan.clear("evict_pod")
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        residual = twin.violating_nodes()
+        checks = [
+            self._check(
+                "wave_converged",
+                not residual,
+                f"violating nodes at end: {residual}",
+            ),
+            self._check(
+                "rebalancer_engaged",
+                len(twin.evictions()) > 0,
+                f"{len(twin.evictions())} evictions after the API healed",
+            ),
+        ]
+        actuations = (
+            twin.controller.actuation_count()
+            if twin.controller is not None
+            else 0
+        )
+        if self.control:
+            checks.append(
+                self._check(
+                    "controller_engaged",
+                    actuations > 0,
+                    f"{actuations} actuations under the outage",
+                )
+            )
+        return checks
+
+
+def control_headtohead(
+    num_nodes: int = 16,
+    pods: Optional[int] = None,
+    period_s: float = 5.0,
+) -> Dict:
+    """The budget controller's acceptance A/B (docs/observability.md
+    "Budget feedback control"): each head-to-head program runs twice on
+    identical twins — static configuration vs self-tuning — and the
+    verdict compares the trigger SLO's FINAL error-budget ledger.  The
+    self-tuning run must finish strictly better on both programs, and a
+    quiet diurnal day with the controller armed must end with zero
+    actuations (a controller that fidgets on a healthy cluster is
+    itself a defect)."""
+    scale = {
+        "num_nodes": num_nodes,
+        "pods": pods if pods is not None else num_nodes,
+        "period_s": period_s,
+    }
+    out: Dict = {"scenarios": {}}
+    for key, cls, slo_name in (
+        ("metric_storm", ControlMetricStorm, "verb_availability"),
+        ("deployment_wave", ControlDeploymentWave, "eviction_safety"),
+    ):
+        static = cls(control=False).run(dict(scale))
+        tuned = cls(control=True).run(dict(scale))
+        static_entry = static["judgment"].get(slo_name) or {}
+        tuned_entry = tuned["judgment"].get(slo_name) or {}
+        static_budget = static_entry.get("error_budget_remaining")
+        tuned_budget = tuned_entry.get("error_budget_remaining")
+        out["scenarios"][key] = {
+            "slo": slo_name,
+            "static": {
+                "budget": static_budget,
+                "errors": static["traffic"]["errors"],
+                "actuations": static["actuations"],
+                "passed": static["passed"],
+                "checks": static["checks"],
+            },
+            "self_tuning": {
+                "budget": tuned_budget,
+                "errors": tuned["traffic"]["errors"],
+                "actuations": tuned["actuations"],
+                "passed": tuned["passed"],
+                "checks": tuned["checks"],
+            },
+            "strictly_better": bool(
+                static_budget is not None
+                and tuned_budget is not None
+                and tuned_budget > static_budget
+            ),
+        }
+    # the null hypothesis with the controller ARMED: a healthy diurnal
+    # day must produce zero actuations — hysteresis means quiet
+    quiet_scale = dict(scale)
+    quiet_scale["control"] = True
+    quiet = DiurnalLoad().run(quiet_scale)
+    out["diurnal_quiet"] = {
+        "actuations": quiet["actuations"],
+        "passed": quiet["passed"],
+        "ok": quiet["actuations"] == 0 and quiet["passed"],
+    }
+    out["all_strictly_better"] = all(
+        entry["strictly_better"] for entry in out["scenarios"].values()
+    )
+    return out
 
 
 DEFAULT_SCENARIOS: Tuple[Scenario, ...] = (

@@ -1,0 +1,474 @@
+"""SLO burn-rate feedback control: the budget controller that closes
+the observability loop (docs/observability.md "Budget feedback
+control").
+
+The :class:`~platform_aware_scheduling_tpu.utils.slo.SLOEngine` judges
+— burn rates, error budgets, pages — and until this module nothing
+*acted* on the judgment.  :class:`BudgetController` subscribes to the
+engine's post-tick hook (same injectable clock, one evaluation per
+engine tick) and drives four feedback paths through explicit bounded
+actuators:
+
+  * **admission shedding** (``verb_availability``): the serving layer's
+    admission queue depth steps down a declared ladder as the
+    availability budget burns — cheap early 503s before expensive queue
+    collapse — and steps back up hysteretically on recovery.
+  * **rebalancer aggressiveness** (``eviction_safety``): ``max_moves``
+    steps down and the drift hysteresis ``K`` steps up while eviction
+    attempts are failing (PDB denials, flaky eviction API), so the
+    actuator backs off a misbehaving dependency instead of burning the
+    safety budget slamming into it.
+  * **degraded extrapolation bounds** (``telemetry_freshness``): the
+    forecaster's uncertainty-band bound, its extrapolation-horizon cap,
+    and the degraded controller's last-known-good age multiple all
+    tighten once the freshness budget is gone — stale data gets trusted
+    *less*, not longer, when staleness is already over budget.
+  * **trend pre-arming**: a predicted storm (the forecaster's trend
+    signal) tightens the shed knob ONE step before any budget burns,
+    so the first surge tick meets a queue that is already defensive.
+
+Every actuation is itself observed: a ``pas_control_*`` gauge per knob,
+an actuation counter labeled ``knob``/``direction``/``slo``, a
+decision-provenance record, and a bounded recent-actuation ring served
+by ``GET /debug/control`` on both front-ends.  The controller is
+strictly one-step-per-knob-per-engine-tick (rate limit), every knob
+clamps to its declared ladder ends, and with ``--sloControl=off``
+nothing is constructed — the request path never sees the controller
+either way (it only ever mutates knobs other components already read
+live).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from platform_aware_scheduling_tpu.utils import decisions, klog
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+#: tighten while the trigger SLO's remaining error budget sits below
+#: this fraction (or while it pages) …
+DEFAULT_TIGHTEN_BUDGET = 0.25
+#: … loosen one step only after LOOSEN_HOLD_TICKS consecutive ticks
+#: with the budget back above this fraction and no alert — the
+#: hysteresis gap (loosen > tighten) is what prevents flapping at the
+#: threshold
+DEFAULT_LOOSEN_BUDGET = 0.50
+DEFAULT_LOOSEN_HOLD_TICKS = 3
+
+#: recent-actuation ring served by /debug/control
+_RECENT = 64
+
+DIRECTION_TIGHTEN = "tighten"
+DIRECTION_LOOSEN = "loosen"
+
+#: trigger label for trend pre-arming (not an SLO name: the whole point
+#: is that it fires BEFORE any SLO burns)
+TRIGGER_TREND = "trend"
+
+
+def _ladder(values: Sequence) -> Tuple:
+    """Validate a knob ladder: at least two distinct settings, loosest
+    (baseline) first, strictly monotonic toward the tight end."""
+    vals = tuple(values)
+    if len(vals) < 2:
+        raise ValueError("a knob ladder needs >= 2 settings")
+    deltas = [b - a for a, b in zip(vals, vals[1:])]
+    if not (all(d > 0 for d in deltas) or all(d < 0 for d in deltas)):
+        raise ValueError(f"knob ladder must be strictly monotonic: {vals}")
+    return vals
+
+
+class Knob:
+    """One bounded actuation point: a ladder of allowed settings from
+    the baseline (index 0, the operator-configured value) to the
+    tightest defensive posture (the last index).  ``write`` applies a
+    setting to the live component; ``read`` is only used for the
+    snapshot.  The ladder IS the clamp: the controller can only ever
+    select an index in ``[0, len(ladder) - 1]``."""
+
+    def __init__(
+        self,
+        name: str,
+        slo: str,
+        ladder: Sequence,
+        write: Callable[[object], None],
+        read: Optional[Callable[[], object]] = None,
+    ):
+        self.name = name
+        self.slo = slo
+        self.ladder = _ladder(ladder)
+        self.write = write
+        self.read = read
+        self.level = 0  # index into the ladder; 0 == baseline
+        self.last_step_tick = -1  # rate limit: one step per engine tick
+        self.steps = 0  # lifetime actuation count
+
+    @property
+    def setting(self):
+        return self.ladder[self.level]
+
+    @property
+    def baseline(self):
+        return self.ladder[0]
+
+    @property
+    def bounds(self) -> Tuple:
+        lo, hi = self.ladder[0], self.ladder[-1]
+        return (lo, hi) if lo <= hi else (hi, lo)
+
+    def step(self, direction: str, tick: int) -> bool:
+        """Move one ladder index (tighten -> higher index); clamps at
+        the ends and refuses a second step within the same engine tick.
+        Returns whether the setting actually moved."""
+        if self.last_step_tick == tick:
+            return False
+        delta = 1 if direction == DIRECTION_TIGHTEN else -1
+        level = min(len(self.ladder) - 1, max(0, self.level + delta))
+        if level == self.level:
+            return False
+        self.level = level
+        self.last_step_tick = tick
+        self.steps += 1
+        self.write(self.ladder[level])
+        return True
+
+
+class BudgetController:
+    """Reads the SLO engine's per-tick evaluations and steps the
+    attached knobs.  Construct with the engine, attach actuators, and
+    either let the engine drive it (``engine.subscribe`` happens here)
+    or call :meth:`on_tick` directly with an evaluation dict."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        trend_source: Optional[Callable[[], Tuple[bool, str]]] = None,
+        tighten_budget: float = DEFAULT_TIGHTEN_BUDGET,
+        loosen_budget: float = DEFAULT_LOOSEN_BUDGET,
+        loosen_hold_ticks: int = DEFAULT_LOOSEN_HOLD_TICKS,
+        decision_log=None,
+    ):
+        if loosen_budget < tighten_budget:
+            raise ValueError(
+                "loosen_budget must sit at or above tighten_budget "
+                "(the hysteresis gap prevents flapping)"
+            )
+        self.engine = engine
+        self.trend_source = trend_source
+        self.tighten_budget = float(tighten_budget)
+        self.loosen_budget = float(loosen_budget)
+        self.loosen_hold_ticks = max(1, int(loosen_hold_ticks))
+        self.decision_log = (
+            decision_log if decision_log is not None else decisions.DECISIONS
+        )
+        self.enabled = True
+        # controller-local metrics, merged into /metrics only while the
+        # controller is wired — the same off-path convention the SLO
+        # engine set (utils/slo.py): --sloControl=off emits nothing
+        self.counters = CounterSet()
+        self.knobs: Dict[str, Knob] = {}
+        self._hold: Dict[str, int] = {}  # slo -> consecutive healthy ticks
+        self._recent: deque = deque(maxlen=_RECENT)
+        self._ticks = 0
+        self._prearmed = False
+        self._lock = threading.Lock()
+        if engine is not None:
+            engine.subscribe(self.on_tick)
+
+    # -- actuator attachment ---------------------------------------------------
+
+    def add_knob(self, knob: Knob) -> Knob:
+        with self._lock:
+            if knob.name in self.knobs:
+                raise ValueError(f"duplicate knob {knob.name!r}")
+            self.knobs[knob.name] = knob
+        self.counters.set_gauge(
+            "pas_control_knob_setting",
+            float(knob.setting),
+            labels={"knob": knob.name},
+        )
+        return knob
+
+    def attach_admission(self, target, floor: int = 4) -> Knob:
+        """The shed knob: any object exposing a live-read
+        ``max_queue_depth`` (serving.MicroBatchDispatcher, the twin's
+        admission model).  Tighten halves the depth toward ``floor``."""
+        baseline = int(target.max_queue_depth)
+        ladder: List[int] = [baseline]
+        while ladder[-1] // 2 >= max(1, int(floor)):
+            ladder.append(ladder[-1] // 2)
+        if len(ladder) < 2:
+            ladder = [baseline, max(1, int(floor))]
+
+        def write(value, target=target):
+            target.max_queue_depth = int(value)
+
+        return self.add_knob(
+            Knob(
+                "admission_queue_depth",
+                "verb_availability",
+                ladder,
+                write,
+                read=lambda: target.max_queue_depth,
+            )
+        )
+
+    def attach_rebalancer(self, rebalancer) -> List[Knob]:
+        """The aggressiveness knobs: churn budget down, drift
+        hysteresis up, through Rebalancer.set_aggressiveness (which
+        validates and clamps on its side too)."""
+        moves = int(rebalancer.replanner.max_moves)
+        k = int(rebalancer.drift.k)
+        move_ladder = sorted(
+            {max(1, moves), max(1, moves // 2), max(1, moves // 4), 1},
+            reverse=True,
+        )
+        k_ladder = sorted({k, k + 1, k + 2, k * 2 + 2})
+        knobs = [
+            Knob(
+                "rebalance_max_moves",
+                "eviction_safety",
+                move_ladder,
+                lambda v: rebalancer.set_aggressiveness(max_moves=int(v)),
+                read=lambda: rebalancer.replanner.max_moves,
+            ),
+            Knob(
+                "drift_hysteresis_k",
+                "eviction_safety",
+                k_ladder,
+                lambda v: rebalancer.set_aggressiveness(hysteresis_k=int(v)),
+                read=lambda: rebalancer.drift.k,
+            ),
+        ]
+        return [self.add_knob(knob) for knob in knobs]
+
+    def attach_forecaster(self, forecaster) -> List[Knob]:
+        """The extrapolation-bound knobs: band bound and horizon cap
+        tighten through Forecaster.set_extrapolation_bounds, which
+        clears the per-fit memoized verdict so the new bound applies to
+        the CURRENT fit."""
+        band = float(forecaster.band_bound)
+        band_ladder = [band, band * 0.5, band * 0.25]
+        window = max(2, int(forecaster.window))
+        horizon_ladder = sorted(
+            {window, max(1, window // 2), max(1, window // 4)},
+            reverse=True,
+        )
+        knobs = [
+            Knob(
+                "forecast_band_bound",
+                "telemetry_freshness",
+                band_ladder,
+                lambda v: forecaster.set_extrapolation_bounds(
+                    band_bound=float(v)
+                ),
+                read=lambda: forecaster.band_bound,
+            ),
+            Knob(
+                "forecast_horizon_cap",
+                "telemetry_freshness",
+                horizon_ladder,
+                lambda v: forecaster.set_extrapolation_bounds(
+                    horizon_cap=int(v)
+                ),
+                read=lambda: forecaster.horizon_cap or forecaster.window,
+            ),
+        ]
+        return [self.add_knob(knob) for knob in knobs]
+
+    def attach_degraded(self, degraded) -> Knob:
+        """The last-known-good trust knob: how many freshness bounds of
+        staleness degraded mode keeps serving from — tightens toward
+        1.0 once staleness is already over budget."""
+        multiple = float(degraded.lkg_bound_multiple)
+        ladder = [multiple]
+        for candidate in (multiple * 2 / 3, multiple / 2, 1.0):
+            if candidate < ladder[-1] - 1e-9 and candidate >= 1.0:
+                ladder.append(round(candidate, 3))
+        if len(ladder) < 2:
+            ladder = [multiple, max(1.0, multiple / 2)]
+
+        def write(value, degraded=degraded):
+            degraded.lkg_bound_multiple = float(value)
+
+        return self.add_knob(
+            Knob(
+                "lkg_bound_multiple",
+                "telemetry_freshness",
+                ladder,
+                write,
+                read=lambda: degraded.lkg_bound_multiple,
+            )
+        )
+
+    # -- the control loop ------------------------------------------------------
+
+    def on_tick(self, evaluations: Dict[str, Dict]) -> None:
+        """One control pass per engine tick (the engine invokes this
+        OUTSIDE its lock).  Never raises: a controller crash must not
+        take the judge down with it."""
+        try:
+            self._control_pass(evaluations)
+        except Exception as exc:
+            klog.error("budget controller pass failed: %r", exc)
+
+    def _control_pass(self, evaluations: Dict[str, Dict]) -> None:
+        with self._lock:
+            self._ticks += 1
+            tick = self._ticks
+            self.counters.inc("pas_control_ticks_total")
+            by_slo: Dict[str, List[Knob]] = {}
+            for knob in self.knobs.values():
+                by_slo.setdefault(knob.slo, []).append(knob)
+            for slo_name, knobs in by_slo.items():
+                evaluation = evaluations.get(slo_name)
+                if evaluation is None:
+                    continue
+                budget = float(
+                    evaluation.get("error_budget_remaining", 1.0)
+                )
+                alert = evaluation.get("alert", "ok")
+                if alert == "page" or budget < self.tighten_budget:
+                    self._hold[slo_name] = 0
+                    for knob in knobs:
+                        self._actuate(
+                            knob,
+                            DIRECTION_TIGHTEN,
+                            slo_name,
+                            tick,
+                            f"budget {budget:.3f} below "
+                            f"{self.tighten_budget} (alert {alert})",
+                        )
+                elif alert == "ok" and budget >= self.loosen_budget:
+                    held = self._hold.get(slo_name, 0) + 1
+                    if held >= self.loosen_hold_ticks and any(
+                        knob.level > 0 for knob in knobs
+                    ):
+                        self._hold[slo_name] = 0
+                        for knob in knobs:
+                            self._actuate(
+                                knob,
+                                DIRECTION_LOOSEN,
+                                slo_name,
+                                tick,
+                                f"budget {budget:.3f} healthy for "
+                                f"{held} ticks",
+                            )
+                    else:
+                        self._hold[slo_name] = held
+                else:
+                    # the hysteresis band between the thresholds: hold
+                    # position, reset the recovery streak
+                    self._hold[slo_name] = 0
+            self._prearm_pass(evaluations, tick)
+
+    def _prearm_pass(self, evaluations: Dict[str, Dict], tick: int) -> None:
+        """Trend pre-arming: a predicted storm tightens the shed knob
+        one step BEFORE the availability budget burns (PR 8 meets
+        PR 10).  Only from baseline — once armed (or once real burn has
+        taken over), the ordinary hysteresis owns the knob."""
+        knob = self.knobs.get("admission_queue_depth")
+        if knob is None or self.trend_source is None:
+            self.counters.set_gauge("pas_control_prearmed", 0.0)
+            return
+        try:
+            storm, why = self.trend_source()
+        except Exception:
+            storm, why = False, "trend source failed"
+        if storm and knob.level == 0:
+            if self._actuate(
+                knob, DIRECTION_TIGHTEN, TRIGGER_TREND, tick,
+                f"predicted storm: {why}",
+            ):
+                self._prearmed = True
+        elif not storm and knob.level == 0:
+            self._prearmed = False
+        self.counters.set_gauge(
+            "pas_control_prearmed", 1.0 if self._prearmed else 0.0
+        )
+
+    def _actuate(
+        self, knob: Knob, direction: str, trigger: str, tick: int,
+        reason: str,
+    ) -> bool:
+        before = knob.setting
+        if not knob.step(direction, tick):
+            return False
+        after = knob.setting
+        self.counters.inc(
+            "pas_control_actuations_total",
+            labels={"knob": knob.name, "direction": direction,
+                    "slo": trigger},
+        )
+        self.counters.set_gauge(
+            "pas_control_knob_setting",
+            float(after),
+            labels={"knob": knob.name},
+        )
+        record = {
+            "tick": tick,
+            "knob": knob.name,
+            "direction": direction,
+            "trigger": trigger,
+            "from": before,
+            "to": after,
+            "level": knob.level,
+            "reason": reason,
+        }
+        self._recent.append(record)
+        try:
+            self.decision_log.record_control(dict(record))
+        except Exception as exc:
+            klog.error("control decision record failed: %r", exc)
+        return True
+
+    # -- introspection ---------------------------------------------------------
+
+    def actuation_count(self) -> int:
+        with self._lock:
+            return sum(knob.steps for knob in self.knobs.values())
+
+    def snapshot(self) -> Dict:
+        """The GET /debug/control payload: every knob's live setting,
+        baseline, ladder bounds and level, plus the recent-actuation
+        provenance ring."""
+        with self._lock:
+            knobs = []
+            for knob in self.knobs.values():
+                lo, hi = knob.bounds
+                live = knob.setting
+                if knob.read is not None:
+                    try:
+                        live = knob.read()
+                    except Exception:
+                        pass
+                knobs.append({
+                    "name": knob.name,
+                    "slo": knob.slo,
+                    "setting": live,
+                    "baseline": knob.baseline,
+                    "min": lo,
+                    "max": hi,
+                    "level": knob.level,
+                    "levels": len(knob.ladder),
+                    "steps": knob.steps,
+                })
+            return {
+                "enabled": True,
+                "ticks": self._ticks,
+                "prearmed": self._prearmed,
+                "thresholds": {
+                    "tighten_budget": self.tighten_budget,
+                    "loosen_budget": self.loosen_budget,
+                    "loosen_hold_ticks": self.loosen_hold_ticks,
+                },
+                "knobs": knobs,
+                "recent": list(self._recent),
+            }
+
+    def to_json(self) -> bytes:
+        return (json.dumps(self.snapshot(), indent=1) + "\n").encode()

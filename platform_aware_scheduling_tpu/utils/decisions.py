@@ -455,6 +455,23 @@ class DecisionLog:
         record.outcome = {"completed": True}
         self.add(record)
 
+    def record_control(self, detail: Dict) -> None:
+        """One budget-controller actuation (utils/control.py): the knob,
+        direction, trigger SLO and before/after settings, pod-less and
+        born closed like a rebalance cycle summary — an actuation is its
+        own outcome."""
+        if not self.enabled:
+            return
+        record = DecisionRecord(
+            verb="control",
+            pod_namespace="-",
+            pod_name=str(detail.get("knob", "control")),
+            path=str(detail.get("direction", "")),
+            detail=detail,
+        )
+        record.outcome = {"completed": True}
+        self.add(record)
+
     # -- outcome feedback ------------------------------------------------------
 
     def observe_bind(self, namespace: str, name: str, node: str) -> None:

@@ -322,6 +322,29 @@ class SLOEngine:
         self._fresh_total_s = 0.0
         self._last_tick_t: Optional[float] = None
         self._ticks = 0
+        # post-tick subscribers (utils/control.py): invoked with each
+        # tick's evaluation dict AFTER the engine lock releases, so a
+        # subscriber may freely read engine state (snapshot/judge)
+        # without deadlocking the evaluation pass
+        self._subscribers: List[Callable[[Dict[str, Dict]], None]] = []
+
+    def subscribe(
+        self, callback: Callable[[Dict[str, Dict]], None]
+    ) -> None:
+        """Register a post-tick hook: ``callback(evaluations)`` runs
+        after every :meth:`tick`, outside the engine lock, on the
+        ticking thread.  Exceptions are logged, never propagated — a
+        broken subscriber must not take the judge down."""
+        with self._lock:
+            if callback not in self._subscribers:
+                self._subscribers.append(callback)
+
+    def unsubscribe(
+        self, callback: Callable[[Dict[str, Dict]], None]
+    ) -> None:
+        with self._lock:
+            if callback in self._subscribers:
+                self._subscribers.remove(callback)
 
     # -- measurement -----------------------------------------------------------
 
@@ -433,7 +456,16 @@ class SLOEngine:
             # this very tick as its own "then" point
             for ring in self._rings.values():
                 ring.append(now, snapshot)
-            return results
+            subscribers = list(self._subscribers)
+        # subscribers run OUTSIDE the lock: the budget controller reads
+        # engine state (and other threads may be scraping snapshot())
+        # while it reacts to this very evaluation
+        for callback in subscribers:
+            try:
+                callback(results)
+            except Exception as exc:
+                klog.error("slo tick subscriber failed: %r", exc)
+        return results
 
     def _evaluate(self, slo: SLO, now: float, now_m: _Measurement) -> Dict:
         burn: Dict[str, float] = {}

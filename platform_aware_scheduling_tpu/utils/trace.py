@@ -154,7 +154,7 @@ declare("pas_degraded", "gauge", "1 while the named subsystem runs degraded: tel
 # decision provenance (utils/decisions.py: per-decision explain records,
 # placement-quality feedback, /debug/decisions; docs/observability.md
 # "Decision provenance")
-declare("pas_decision_records_total", "counter", "Scheduling decisions recorded into the decision log (label: verb in filter/prioritize/gas_filter/rebalance).")
+declare("pas_decision_records_total", "counter", "Scheduling decisions recorded into the decision log (label: verb in filter/prioritize/gas_filter/rebalance/control).")
 declare("pas_decision_filtered_nodes_total", "counter", "Nodes filtered out of scheduling decisions, by reason class (label: reason in rule_violation/fail_closed/gas_unknown_node/gas_no_gpus/gas_capacity/gas_error/gang_reserved/gang_infeasible).")
 declare("pas_decision_open", "gauge", "Decision records currently awaiting outcome feedback (pod bind / rebalance).")
 declare("pas_decision_closed_total", "counter", "Decision records closed by a pod-bind observation.")
@@ -194,6 +194,14 @@ declare("pas_slo_compliance", "gauge", "Good-event fraction over the budget wind
 declare("pas_slo_error_budget_remaining", "gauge", "Fraction of the error budget left over the budget window: 1 - burn_rate(budget window); negative means overspent (label: slo).")
 declare("pas_slo_burn_rate", "gauge", "Error-budget burn rate per sliding window: bad fraction / (1 - objective); 1.0 spends the budget exactly by window end (labels: slo, window).")
 declare("pas_slo_breaches_total", "counter", "Alert-tier entries per SLO, edge-triggered: page when both fast windows burn past page_burn, warn when both slow windows burn past warn_burn (labels: slo, tier).")
+# budget feedback control (utils/control.py; docs/observability.md
+# "Budget feedback control").  These families live in the controller's
+# own CounterSet and appear on /metrics only where one is wired
+# (--sloControl=on) — the off path registers nothing.
+declare("pas_control_knob_setting", "gauge", "Current setting of each budget-controller knob (label: knob); equals the knob's baseline while no actuation has tightened it.")
+declare("pas_control_actuations_total", "counter", "Budget-controller knob steps taken (labels: knob, direction in tighten/loosen, slo = trigger SLO or 'trend' for pre-arming).")
+declare("pas_control_ticks_total", "counter", "Budget-controller evaluation passes completed (one per SLO engine tick while wired).")
+declare("pas_control_prearmed", "gauge", "1 while the shed knob is tightened by the forecaster's trend signal ahead of any budget burn, else 0.")
 # flight recorder + what-if serving (utils/record.py, testing/replay.py;
 # docs/observability.md "Flight recorder & what-if").  The pas_record_*
 # families live in the recorder's own CounterSet and appear on /metrics

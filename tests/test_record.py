@@ -497,6 +497,38 @@ class TestParseCapture:
         for capture in (from_jsonl, from_dict):
             assert capture.stats() == from_rec.stats()
 
+    def test_long_capture_streams_past_the_old_tick_cap(self):
+        """The streaming parser contract (ISSUE 15): a capture longer
+        than the pre-lift 2000-tick cap round-trips through every JSONL
+        shape — str, bytes, and a lazy line generator (an open file) —
+        without materializing the text, and ReplayScenario accepts the
+        full timeline under the lifted 20000-tick cap."""
+        assert replay.MAX_REPLAY_TICKS == 20000
+        ticks = 5000
+        lines = [
+            json.dumps({"format": FORMAT, "dropped": 0})
+        ]
+        for tick in range(ticks):
+            lines.append(json.dumps({
+                "kind": "telemetry",
+                "t": tick * 5.0,
+                "metric": METRIC,
+                "nodes": 4,
+                "deciles": [100.0] * 11,
+            }))
+        text = "\n".join(lines) + "\n"
+        from_str = replay.parse_capture(text)
+        from_bytes = replay.parse_capture(text.encode("utf-8"))
+        # a generator of lines — the open-file shape; nothing concatenated
+        from_stream = replay.parse_capture(
+            line + "\n" for line in lines
+        )
+        assert from_str.tick_count == ticks
+        for capture in (from_bytes, from_stream):
+            assert capture.stats() == from_str.stats()
+        scenario = replay.ReplayScenario(from_str, num_nodes=4)
+        assert scenario.ticks_n == ticks  # not clamped at the old 2000
+
 
 class TestWhatif:
     def test_spec_validation(self):
