@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record test-control bench-control bench-replay test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record test-control test-admission bench-control bench-admission bench-replay test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
 
 all: test
 
@@ -134,6 +134,21 @@ test-control:
 # (benchmarks/control_load.py); exits nonzero unless strictly better
 bench-control:
 	python -m benchmarks.control_load
+
+# priority-aware admission plane suite (docs/admission.md): class
+# ladder + bounded queue semantics, backfill/fairness, gang-atomic
+# preemption with fenced-refusal containment, flag fail-fast,
+# /debug/admission + off-path byte-identity, torus parity, and the
+# acceptance scenarios over real sockets on both front-ends
+test-admission:
+	python -m pytest tests/test_admission.py -q -m 'not slow'
+
+# the admission plane's head-to-head alone: preemption cascade ON vs
+# OFF through the real verbs + the quiet-diurnal null + gate overhead
+# (benchmarks/admission_load.py); exits nonzero unless ON is strictly
+# better and the quiet day stays silent
+bench-admission:
+	python -m benchmarks.admission_load
 
 # replay throughput (legacy vs vectorized twin load model) + the
 # what-if demo: 2x load must degrade the availability verdict a 1x

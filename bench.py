@@ -170,7 +170,7 @@ def _detail_path(round_override=None) -> str:
 def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
     chaos=None, decisions=None, gang=None, forecast=None, ha=None,
-    twin=None, record=None, control=None,
+    twin=None, record=None, control=None, admission=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -373,6 +373,16 @@ def assemble_line(
         from benchmarks import control_load as _control_load
 
         result["control"] = _control_load.compact(control)
+    if admission is not None:
+        # full head-to-head (checks, judgments, plane snapshots) to
+        # disk; the line keeps the HIGH class's final ledgers ON vs OFF,
+        # the quiet-day null, and the per-review gate tax — the ISSUE 16
+        # acceptance surface (benchmarks/admission_load.py;
+        # docs/admission.md)
+        detail["admission"] = admission
+        from benchmarks import admission_load as _admission_load
+
+        result["admission"] = _admission_load.compact(admission)
     if record is not None:
         # full pair-ratio lists + capture scrape to disk; the line keeps
         # the hermetic per-request delta (the stable number) next to the
@@ -703,6 +713,28 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"control bench failed: {exc}", file=sys.stderr)
 
+    # --- priority-aware admission plane: preemption cascade ON vs OFF
+    # through the real verbs + the quiet-diurnal null + the per-review
+    # gate tax (benchmarks/admission_load.py; docs/admission.md) ---
+    admission_out = None
+    try:
+        from benchmarks import admission_load
+
+        admission_out = admission_load.run()
+        on = admission_out["preemption_on"]
+        off = admission_out["preemption_off"]
+        print(
+            f"admission: high-class budget ON {on['budget']} vs OFF "
+            f"{off['budget']} "
+            f"({'better' if admission_out['strictly_better'] else 'NOT BETTER'}); "
+            f"quiet diurnal ok={admission_out['diurnal_quiet']['ok']}; "
+            f"gate {admission_out['gate_overhead']['mean_us']} us/review "
+            f"({admission_out['wall_s']}s wall)",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"admission bench failed: {exc}", file=sys.stderr)
+
     # --- flight recorder: hermetic per-request delta (gc-fenced
     # interleaved on/off batches — the stable pin) + spawned wire p99
     # A/B at 10k nodes (benchmarks/http_load.py;
@@ -747,7 +779,7 @@ def main():
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
         decisions_out, gang, forecast_out, ha_out, twin_out, record_out,
-        control_out,
+        control_out, admission_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

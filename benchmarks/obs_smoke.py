@@ -202,6 +202,52 @@ def check_front_end(serving: str) -> str:
         control_note = (
             f"control actuations={controller.actuation_count()}"
         )
+        # admission plane: 404 while off (--admission=off), then 200
+        # with queue state once a plane is wired — and its families
+        # must appear on /metrics only from that moment
+        assert "/debug/admission" in paths, (
+            f"{serving}: index missing admission"
+        )
+        status, _payload = _get(port, "/debug/admission")
+        assert status == 404, (
+            f"{serving}: /debug/admission must 404 while off -> {status}"
+        )
+        status, payload = _get(port, "/metrics")
+        assert status == 200
+        families = trace.parse_prometheus_text(payload.decode())
+        assert "pas_admission_queued_total" not in families
+        from platform_aware_scheduling_tpu.admission import AdmissionPlane
+        from platform_aware_scheduling_tpu.testing.builders import make_pod
+        from platform_aware_scheduling_tpu.utils import decisions
+        from platform_aware_scheduling_tpu.utils import (
+            labels as shared_labels,
+        )
+
+        plane = AdmissionPlane()
+        server.scheduler.admission = plane
+        waiting = make_pod(
+            "smoke-batch",
+            labels={shared_labels.PRIORITY_LABEL: "batch"},
+        )
+        plane.review(
+            waiting,
+            ["node-0"],
+            {"node-0": "capacity"},
+            {"node-0": decisions.CODE_GANG_INFEASIBLE},
+        )
+        status, payload = _get(port, "/debug/admission")
+        assert status == 200, f"{serving}: /debug/admission -> {status}"
+        admission_snap = json.loads(payload)
+        assert admission_snap["enabled"] is True
+        assert admission_snap["depth"] == 1, admission_snap
+        assert admission_snap["counters"]["queued"] == 1.0
+        status, payload = _get(port, "/metrics")
+        assert status == 200
+        families = trace.parse_prometheus_text(payload.decode())
+        assert "pas_admission_queued_total" in families, (
+            f"{serving}: wired plane's families missing from /metrics"
+        )
+        assert "pas_admission_queue_depth" in families
         # wire-path caches: 200 with universe/skeleton state on a device
         # extender (404 belongs to host-only assemblies, pinned in tests)
         assert "/debug/wire" in paths, f"{serving}: index missing wire"

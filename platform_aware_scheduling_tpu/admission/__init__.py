@@ -1,0 +1,27 @@
+"""Priority-aware admission plane (docs/admission.md).
+
+Everything the extender did before this package was admit-or-reject at
+Filter time.  The admission plane adds the third answer — *wait, in
+order*: a bounded queue over capacity-class Filter failures, priority
+classes from the ``pas-priority`` pod label, backfill so small work
+flows around a large gang's pending reservation, per-class fairness so
+batch work cannot starve forever, and gang-atomic preemption so a
+high-priority gang can displace lower-priority work through the
+``SafeActuator``'s fenced, breaker-gated eviction path.
+
+``AdmissionPlane`` (plane.py) is the opt-in collaborator both
+front-ends consult (``--admission=on``); ``PreemptionPlanner``
+(preempt.py) is its optional sharp edge (``--preemption=on``, requires
+``--gang=on``).  The off path constructs neither and stays
+byte-identical on the wire.
+"""
+
+from platform_aware_scheduling_tpu.admission.plane import (  # noqa: F401
+    DEFAULT_CLASSES,
+    DEFAULT_CLASS,
+    AdmissionPlane,
+    blocked_reason,
+)
+from platform_aware_scheduling_tpu.admission.preempt import (  # noqa: F401
+    PreemptionPlanner,
+)

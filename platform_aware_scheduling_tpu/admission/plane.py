@@ -1,0 +1,527 @@
+"""The bounded, priority-ordered admission queue both front-ends consult.
+
+The extender model is retry-driven: kube-scheduler re-runs Filter for a
+pending pod until it passes, so the queue is a *gatekeeper over
+retries*, not a dispatcher — it never holds a request open.  One
+``review`` call per Filter decision classifies the outcome:
+
+  * **Filter passed** — the gate decides whether this pod may actually
+    take the capacity now.  Head-of-line order is (class, arrival);
+    a pod behind a higher-priority waiter is held (every candidate
+    fails with ``CODE_ADMISSION_BLOCKED``) unless **backfill** applies
+    (the waiter's demand stays covered: it either already holds a gang
+    reservation or enough eligible nodes remain after this admission)
+    or **fairness** does (the streak class has taken ``fairness_streak``
+    consecutive admissions while another class waits — the per-class cap
+    that keeps batch work from starving forever).
+
+  * **Filter failed, every reason capacity-class** (the queueable set in
+    utils/decisions.py) — the pod enqueues (bounded depth: overflow
+    sheds the worst-ranked entry, or the arrival itself when it ranks
+    worst), its consult count ages toward the starvation threshold, and
+    an infeasible *gang* above another class's holdings arms the
+    preemption planner (preempt.py).
+
+  * **Filter failed with any policy/error-class reason** — terminal:
+    the queue never retries a ``dontschedule`` rejection; a queued entry
+    that turns terminal is dropped.
+
+Wire contract: the plane only ever *substitutes one failure for
+another* (the admission-blocked hold) or passes the verdict through
+untouched — it never invents an admit, so ``--admission=off`` responses
+are byte-identical to a build without the plane.  All
+``pas_admission_*`` families live in the plane's own CounterSet and
+appear on /metrics only where a plane is wired — the off path registers
+nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from platform_aware_scheduling_tpu.gang.group import GangSpec
+from platform_aware_scheduling_tpu.kube.objects import Pod
+from platform_aware_scheduling_tpu.utils import decisions, klog
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
+from platform_aware_scheduling_tpu.utils.tracing import CounterSet
+
+#: class ladder, most important first (rank 0 outranks rank 1, ...)
+DEFAULT_CLASSES = ("high", "normal", "batch")
+DEFAULT_CLASS = "normal"
+DEFAULT_MAX_DEPTH = 64
+#: consecutive same-class admissions before a waiting other class must
+#: be let through (the anti-starvation cap)
+DEFAULT_FAIRNESS_STREAK = 8
+#: queue consults after which every further consult counts as a
+#: starvation event (the per-class availability SLO's bad signal)
+DEFAULT_STARVE_CONSULTS = 16
+#: bound on remembered gang -> class associations (preemption victim
+#: classing); far above any live gang count, just an leak stop
+_GANG_CLASS_CAP = 4096
+
+
+def blocked_reason(klass: str, depth: int) -> str:
+    """The Filter FailedNodes reason for an admission hold — one
+    formatter so the wire string can never fork between front-ends."""
+    return (
+        f"admission: queued behind higher-priority work "
+        f"(class={klass}, depth={depth})"
+    )
+
+
+class _Entry:
+    """One queued pod (all access under the plane's lock)."""
+
+    __slots__ = (
+        "pod_key",
+        "namespace",
+        "name",
+        "klass",
+        "rank",
+        "seq",
+        "gang_id",
+        "size",
+        "enqueued_at",
+        "consults",
+    )
+
+    def __init__(
+        self,
+        pod_key: str,
+        namespace: str,
+        name: str,
+        klass: str,
+        rank: int,
+        seq: int,
+        gang_id: Optional[str],
+        size: int,
+        now: float,
+    ):
+        self.pod_key = pod_key
+        self.namespace = namespace
+        self.name = name
+        self.klass = klass
+        self.rank = rank
+        self.seq = seq
+        self.gang_id = gang_id
+        self.size = size
+        self.enqueued_at = now
+        self.consults = 0
+
+    def order(self) -> Tuple[int, int]:
+        return (self.rank, self.seq)
+
+    def to_dict(self, now: float) -> Dict:
+        return {
+            "pod": self.pod_key,
+            "class": self.klass,
+            "seq": self.seq,
+            "gang": self.gang_id,
+            "size": self.size,
+            "waiting_s": round(max(0.0, now - self.enqueued_at), 3),
+            "consults": self.consults,
+        }
+
+
+class AdmissionPlane:
+    """The admission gatekeeper: priority classes, the bounded queue,
+    backfill and fairness, and the preemption trigger.
+
+    Collaborators (set by assembly, all optional):
+
+      * ``gangs`` — gang.GangTracker: reservation state for backfill's
+        covered-demand check and (via the planner) preemption;
+      * ``preemption`` — preempt.PreemptionPlanner (``--preemption=on``).
+    """
+
+    def __init__(
+        self,
+        classes: Sequence[str] = DEFAULT_CLASSES,
+        default_class: str = DEFAULT_CLASS,
+        max_depth: int = DEFAULT_MAX_DEPTH,
+        fairness_streak: int = DEFAULT_FAIRNESS_STREAK,
+        starve_consults: int = DEFAULT_STARVE_CONSULTS,
+        clock: Callable[[], float] = time.monotonic,
+        decision_log: Optional[decisions.DecisionLog] = None,
+    ):
+        self.classes = tuple(classes)
+        if len(self.classes) < 1 or len(set(self.classes)) != len(
+            self.classes
+        ):
+            raise ValueError(f"malformed class ladder: {classes!r}")
+        if default_class not in self.classes:
+            raise ValueError(
+                f"default class {default_class!r} not in {self.classes}"
+            )
+        self.default_class = default_class
+        self._rank = {name: i for i, name in enumerate(self.classes)}
+        self.max_depth = max(1, int(max_depth))
+        self.fairness_streak = max(1, int(fairness_streak))
+        self.starve_consults = max(1, int(starve_consults))
+        self._clock = clock
+        self.decision_log = (
+            decision_log if decision_log is not None else decisions.DECISIONS
+        )
+        self.counters = CounterSet()
+        self.gangs = None  # gang.GangTracker (assembly, --gang=on)
+        self.preemption = None  # PreemptionPlanner (--preemption=on)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        self._seq = 0
+        # fairness streak: which class took the last admission and how
+        # many it has taken consecutively
+        self._streak_class: Optional[str] = None
+        self._streak = 0
+        # gang id -> class name, learned from member pods: the
+        # preemption planner's victim census classes gangs through this
+        self._gang_class: Dict[str, str] = {}
+
+    # -- classification --------------------------------------------------------
+
+    def classify(self, pod: Pod) -> Tuple[str, int]:
+        """(class name, rank) for a pod; unlabeled or unknown-class pods
+        take the default class (utils/labels.priority_class_for is the
+        single validator)."""
+        klass = shared_labels.priority_class_for(
+            pod.get_labels(), self._rank
+        )
+        if klass is None:
+            klass = self.default_class
+        return klass, self._rank[klass]
+
+    def rank_of_gang(self, gang_id: str) -> int:
+        """The remembered class rank of a gang (victim census); a gang
+        the plane never saw a member of takes the default class."""
+        with self._lock:
+            klass = self._gang_class.get(gang_id, self.default_class)
+        return self._rank.get(klass, self._rank[self.default_class])
+
+    def class_of_gang(self, gang_id: str) -> str:
+        with self._lock:
+            return self._gang_class.get(gang_id, self.default_class)
+
+    def _note_gang_class(self, gang_id: Optional[str], klass: str) -> None:
+        if gang_id is None:
+            return
+        with self._lock:
+            if len(self._gang_class) >= _GANG_CLASS_CAP:
+                self._gang_class.clear()  # crude, bounded, never wrong
+            self._gang_class[gang_id] = klass
+
+    # -- the consult -----------------------------------------------------------
+
+    def review(
+        self,
+        pod: Pod,
+        candidates: List[str],
+        failed: Dict[str, str],
+        codes: Dict[str, int],
+    ) -> Optional[Tuple[Dict[str, str], Dict[str, int]]]:
+        """One Filter decision through the gate (module doc).  Returns
+        None when the verdict stands, or a replacement ``(failed,
+        codes)`` pair failing every candidate when the pod is held.
+        Never turns a failure into an admit."""
+        spec = GangSpec.from_pod(pod)
+        klass, rank = self.classify(pod)
+        self._note_gang_class(
+            spec.gang_id if spec is not None else None, klass
+        )
+        pod_key = f"{pod.namespace}/{pod.name}"
+        size = spec.size if spec is not None else 1
+        eligible = [name for name in candidates if name not in failed]
+        if eligible:
+            return self._gate(pod, pod_key, klass, rank, size, eligible)
+        return self._capacity_miss(
+            pod, pod_key, spec, klass, rank, size, candidates, codes
+        )
+
+    def _gate(
+        self,
+        pod: Pod,
+        pod_key: str,
+        klass: str,
+        rank: int,
+        size: int,
+        eligible: List[str],
+    ) -> Optional[Tuple[Dict[str, str], Dict[str, int]]]:
+        """Filter passed: may the pod take the capacity now?"""
+        now = self._clock()
+        with self._lock:
+            entry = self._entries.get(pod_key)
+            my_order = entry.order() if entry is not None else (rank, 1 << 60)
+            blockers = [
+                e
+                for e in self._entries.values()
+                if e.pod_key != pod_key and e.order() < my_order
+            ]
+            if not blockers:
+                self._admit_locked(pod_key, klass, event=None)
+                return None
+            # fairness: the streak class has monopolized admissions while
+            # other classes wait — let this one through and reset
+            if (
+                self._streak_class is not None
+                and self._streak_class != klass
+                and self._streak >= self.fairness_streak
+            ):
+                self._admit_locked(pod_key, klass, event="fairness")
+                return None
+            # backfill: admitting this pod must leave the head waiter's
+            # demand covered — either the head already holds its slice
+            # (gang reservation: the overlay protects it from this pod's
+            # eligible set entirely), or enough eligible nodes remain
+            head = min(blockers, key=lambda e: e.order())
+            head_unmet = head.size
+            if head.gang_id is not None and self.gangs is not None:
+                state = self.gangs.gang_state(head.gang_id)
+                if state in ("reserved", "bound", "draining"):
+                    head_unmet = 0
+            if len(eligible) - head_unmet >= size:
+                self._admit_locked(pod_key, klass, event="backfill")
+                return None
+            self.counters.inc(
+                "pas_admission_blocked_total", labels={"class": klass}
+            )
+            if entry is None:
+                # it must wait its turn: enqueue so its arrival order is
+                # pinned from THIS consult, not a later retry
+                self._enqueue_locked(pod, pod_key, klass, rank, size, now)
+            depth = len(self._entries)
+            head_class = head.klass
+        failed = {
+            name: blocked_reason(head_class, depth) for name in eligible
+        }
+        codes = {
+            name: decisions.CODE_ADMISSION_BLOCKED for name in eligible
+        }
+        return failed, codes
+
+    def _capacity_miss(
+        self,
+        pod: Pod,
+        pod_key: str,
+        spec: Optional[GangSpec],
+        klass: str,
+        rank: int,
+        size: int,
+        candidates: List[str],
+        codes: Dict[str, int],
+    ) -> None:
+        """Filter failed everywhere: enqueue if (and only if) every
+        reason is capacity-class."""
+        reason_counts: Dict[int, int] = {}
+        for code in codes.values():
+            reason_counts[code] = reason_counts.get(code, 0) + 1
+        queueable = candidates and decisions.queueable_counts(reason_counts)
+        arm_preemption = False
+        with self._lock:
+            entry = self._entries.get(pod_key)
+            if not queueable:
+                if entry is not None:
+                    # a queued pod whose failure turned terminal (policy
+                    # now rejects it) leaves: the queue never retries a
+                    # dontschedule rejection
+                    del self._entries[pod_key]
+                    self.counters.inc(
+                        "pas_admission_rejected_total",
+                        labels={"class": entry.klass, "reason": "terminal"},
+                    )
+                    self._publish_depth_locked()
+                    detail = {
+                        "pod": pod_key,
+                        "event": "terminal",
+                        "class": entry.klass,
+                    }
+                else:
+                    detail = None
+            elif entry is not None:
+                entry.consults += 1
+                if entry.consults >= self.starve_consults:
+                    # every consult past the threshold is one starvation
+                    # event — the bad half of the class availability SLO
+                    self.counters.inc(
+                        "pas_admission_starved_total",
+                        labels={"class": klass},
+                    )
+                arm_preemption = (
+                    spec is not None and self.preemption is not None
+                )
+                detail = None
+            else:
+                shed = self._make_room_locked(rank)
+                if shed is False:
+                    # the queue is full of equal-or-better work: this
+                    # arrival is the one that sheds
+                    self.counters.inc(
+                        "pas_admission_rejected_total",
+                        labels={"class": klass, "reason": "overflow"},
+                    )
+                    detail = {
+                        "pod": pod_key,
+                        "event": "overflow_shed",
+                        "class": klass,
+                    }
+                else:
+                    self._enqueue_locked(
+                        pod, pod_key, klass, rank, size, self._clock()
+                    )
+                    arm_preemption = (
+                        spec is not None and self.preemption is not None
+                    )
+                    detail = {
+                        "pod": pod_key,
+                        "event": "enqueue",
+                        "class": klass,
+                        "depth": len(self._entries),
+                    }
+                    if isinstance(shed, _Entry):
+                        detail["shed"] = shed.pod_key
+        if detail is not None and self.decision_log is not None:
+            self.decision_log.record_admission(detail)
+        if arm_preemption:
+            # planning runs OUTSIDE the plane lock: it walks the gang
+            # tracker and may call the cluster through the actuator
+            self.preemption.maybe_preempt(pod, klass, rank)
+        return None
+
+    # -- queue internals (under the lock) --------------------------------------
+
+    def _enqueue_locked(
+        self,
+        pod: Pod,
+        pod_key: str,
+        klass: str,
+        rank: int,
+        size: int,
+        now: float,
+    ) -> _Entry:
+        self._seq += 1
+        spec = GangSpec.from_pod(pod)
+        entry = _Entry(
+            pod_key=pod_key,
+            namespace=pod.namespace,
+            name=pod.name,
+            klass=klass,
+            rank=rank,
+            seq=self._seq,
+            gang_id=spec.gang_id if spec is not None else None,
+            size=size,
+            now=now,
+        )
+        self._entries[pod_key] = entry
+        self.counters.inc(
+            "pas_admission_queued_total", labels={"class": klass}
+        )
+        self._publish_depth_locked()
+        return entry
+
+    def _make_room_locked(self, rank: int):
+        """Bounded depth: True when room exists, the shed _Entry when a
+        worse-ranked entry was dropped to make room, False when the
+        arrival itself should shed."""
+        if len(self._entries) < self.max_depth:
+            return True
+        worst = max(self._entries.values(), key=lambda e: e.order())
+        if worst.rank <= rank:
+            return False
+        del self._entries[worst.pod_key]
+        self.counters.inc(
+            "pas_admission_rejected_total",
+            labels={"class": worst.klass, "reason": "overflow"},
+        )
+        klog.v(1).info_s(
+            f"admission queue full: shed {worst.pod_key} "
+            f"(class={worst.klass}) for a class-rank-{rank} arrival",
+            component="admission",
+        )
+        return worst
+
+    def _admit_locked(
+        self, pod_key: str, klass: str, event: Optional[str]
+    ) -> None:
+        entry = self._entries.pop(pod_key, None)
+        if entry is not None:
+            self._publish_depth_locked()
+        self.counters.inc(
+            "pas_admission_admitted_total", labels={"class": klass}
+        )
+        if event == "backfill":
+            self.counters.inc(
+                "pas_admission_backfill_total", labels={"class": klass}
+            )
+        if self._streak_class == klass:
+            self._streak += 1
+        else:
+            self._streak_class = klass
+            self._streak = 1
+        if event is not None and self.decision_log is not None:
+            self.decision_log.record_admission(
+                {"pod": pod_key, "event": event, "class": klass}
+            )
+
+    def _publish_depth_locked(self) -> None:
+        depths = {name: 0 for name in self.classes}
+        for entry in self._entries.values():
+            depths[entry.klass] = depths.get(entry.klass, 0) + 1
+        for name, depth in depths.items():
+            self.counters.set_gauge(
+                "pas_admission_queue_depth",
+                float(depth),
+                labels={"class": name},
+            )
+
+    # -- outcome feedback ------------------------------------------------------
+
+    def observe_bind(self, namespace: str, name: str) -> None:
+        """A pod landed: whatever the queue thought about it is moot."""
+        with self._lock:
+            if self._entries.pop(f"{namespace}/{name}", None) is not None:
+                self._publish_depth_locked()
+
+    # -- the debug surface -----------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        now = self._clock()
+        with self._lock:
+            entries = sorted(
+                self._entries.values(), key=lambda e: e.order()
+            )
+            out = {
+                "enabled": True,
+                "classes": list(self.classes),
+                "default_class": self.default_class,
+                "max_depth": self.max_depth,
+                "fairness_streak": self.fairness_streak,
+                "starve_consults": self.starve_consults,
+                "depth": len(entries),
+                "streak": {
+                    "class": self._streak_class,
+                    "count": self._streak,
+                },
+                "queue": [e.to_dict(now) for e in entries],
+            }
+        out["preemption"] = (
+            self.preemption.snapshot() if self.preemption is not None else None
+        )
+        # cumulative totals (summed over classes), so one /debug/admission
+        # read answers "has this plane ever queued/blocked/preempted?"
+        # without a /metrics scrape — the twin's quiet-day pin reads these
+        get = self.counters.get
+        out["counters"] = {
+            "queued": get("pas_admission_queued_total", kind="counter"),
+            "admitted": get("pas_admission_admitted_total", kind="counter"),
+            "blocked": get("pas_admission_blocked_total", kind="counter"),
+            "backfills": get("pas_admission_backfill_total", kind="counter"),
+            "starved": get("pas_admission_starved_total", kind="counter"),
+            "rejected": get("pas_admission_rejected_total", kind="counter"),
+            "preemptions": get(
+                "pas_preemption_reservations_total", kind="counter"
+            ),
+        }
+        return out
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.snapshot()).encode() + b"\n"

@@ -105,6 +105,172 @@ def add_gang_flags(parser: argparse.ArgumentParser) -> None:
                         "tracker relists nodes (Go duration)")
 
 
+def add_admission_flags(
+    parser: argparse.ArgumentParser, preemption: bool = True
+) -> None:
+    """Priority-aware admission plane flag surface (docs/admission.md).
+    One helper for both mains; GAS passes ``preemption=False`` — with
+    no gang tracker there are no whole-gang victims to evict."""
+    parser.add_argument("--admission", default="off", choices=["off", "on"],
+                        help="priority-aware admission plane: pods carry "
+                        "a pas-priority class label, capacity-class "
+                        "Filter failures enqueue into a bounded per-class "
+                        "queue, lower-priority pods are held behind "
+                        "queued higher-priority work (with backfill and "
+                        "per-class fairness), and the front-ends serve "
+                        "GET /debug/admission.  Bypasses the Filter "
+                        "response cache while on (the verdict is per-pod "
+                        "queue state).  Off (the default) constructs "
+                        "nothing and leaves the wire byte-identical")
+    parser.add_argument("--admissionClasses", default="high,normal,batch",
+                        help="comma-separated priority class ladder, most "
+                        "important first (the pas-priority label values)")
+    parser.add_argument("--admissionDefaultClass", default="normal",
+                        help="class assigned to unlabeled (or unknown-"
+                        "label) pods; must appear in --admissionClasses")
+    parser.add_argument("--admissionDepth", type=int, default=64,
+                        help="bounded queue depth; overflow sheds the "
+                        "worst-ranked entry (or rejects the arrival when "
+                        "it ranks worst)")
+    parser.add_argument("--admissionFairnessStreak", type=int, default=8,
+                        help="consecutive same-class admissions before a "
+                        "waiting other class must be let through")
+    parser.add_argument("--admissionStarveConsults", type=int, default=16,
+                        help="queue consults after which each further "
+                        "consult counts one pas_admission_starved_total "
+                        "event (the class availability SLO's bad signal)")
+    if preemption:
+        parser.add_argument("--preemption", default="off",
+                            choices=["off", "on"],
+                            help="gang-aware preemption: a starving "
+                            "higher-priority gang may displace strictly "
+                            "lower-class gangs — whole gangs only, "
+                            "all-or-nothing through the SafeActuator's "
+                            "fenced atomic gang path, the freed slice "
+                            "reserved before the victims finish "
+                            "draining.  Requires --admission=on and "
+                            "--gang=on")
+        parser.add_argument("--preemptionMaxVictims", type=int, default=8,
+                            help="max victim PODS one preemption plan "
+                            "may evict (the budget controller's "
+                            "aggressiveness knob steps this down under "
+                            "availability burn)")
+        parser.add_argument("--preemptionRetry", default="5s",
+                            help="min interval between plans for the "
+                            "same target gang (Go duration)")
+        parser.add_argument("--preemptionRate", type=float, default=0.5,
+                            help="preemption evictions per second "
+                            "(token bucket, separate from the "
+                            "rebalancer's)")
+        parser.add_argument("--preemptionBurst", type=int, default=8,
+                            help="preemption eviction burst; a victim "
+                            "gang larger than this can never be evicted "
+                            "atomically")
+        parser.add_argument("--preemptionCooldown", default="5m",
+                            help="per-pod eviction cooldown for the "
+                            "preemption actuator (Go duration)")
+
+
+def admission_classes(args) -> tuple:
+    """The parsed --admissionClasses ladder."""
+    return tuple(
+        s.strip() for s in args.admissionClasses.split(",") if s.strip()
+    )
+
+
+def validate_admission_flags(parser: argparse.ArgumentParser, args) -> None:
+    """Fail fast (exit 2 with usage) on contradictory admission wiring
+    instead of silently no-opping at runtime."""
+    if getattr(args, "admission", "off") == "on":
+        classes = admission_classes(args)
+        if not classes or len(set(classes)) != len(classes):
+            parser.error(
+                f"--admissionClasses {args.admissionClasses!r} is not a "
+                f"valid ladder: need at least one class, no duplicates"
+            )
+        if args.admissionDefaultClass not in classes:
+            parser.error(
+                f"--admissionDefaultClass {args.admissionDefaultClass!r} "
+                f"is not in --admissionClasses {args.admissionClasses!r}"
+            )
+    if getattr(args, "preemption", "off") == "on":
+        if getattr(args, "admission", "off") != "on":
+            parser.error(
+                "--preemption=on requires --admission=on: the planner "
+                "triggers from the admission queue's starving gangs; "
+                "without the plane there is no trigger"
+            )
+        if getattr(args, "gang", "off") != "on":
+            parser.error(
+                "--preemption=on requires --gang=on: victims are whole "
+                "gangs from the tracker's census and the freed slice is "
+                "reserved through it; without the tracker there is "
+                "nothing to preempt or reserve"
+            )
+
+
+def build_admission_plane(
+    args, extender, kube_client=None, gang_tracker=None, leadership=None
+):
+    """The AdmissionPlane for --admission=on (None when off), attached
+    as ``extender.admission`` (the verbs, /metrics, and
+    /debug/admission all key off that attr).  With --preemption=on a
+    PreemptionPlanner rides along over its own dedicated SafeActuator —
+    active mode by definition (preemption that cannot evict is just
+    queueing), its own token bucket so a preemption burst cannot starve
+    the rebalancer's budget (or vice versa)."""
+    if getattr(args, "admission", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.admission import (
+        AdmissionPlane,
+        PreemptionPlanner,
+    )
+
+    plane = AdmissionPlane(
+        classes=admission_classes(args),
+        default_class=args.admissionDefaultClass,
+        max_depth=args.admissionDepth,
+        fairness_streak=args.admissionFairnessStreak,
+        starve_consults=args.admissionStarveConsults,
+    )
+    plane.gangs = gang_tracker
+    if (
+        getattr(args, "preemption", "off") == "on"
+        and gang_tracker is not None
+        and kube_client is not None
+    ):
+        from platform_aware_scheduling_tpu.rebalance.actuator import (
+            MODE_ACTIVE,
+            SafeActuator,
+        )
+        from platform_aware_scheduling_tpu.utils.duration import (
+            parse_duration,
+        )
+
+        actuator = SafeActuator(
+            kube_client,
+            mode=MODE_ACTIVE,
+            rate_per_s=args.preemptionRate,
+            burst=args.preemptionBurst,
+            cooldown_s=parse_duration(args.preemptionCooldown),
+        )
+        # NOT actuator.gang_tracker: the rebalancer path's full-gang
+        # auto-release would fight reservation-while-draining — the
+        # planner marks victims DRAINING itself and the tracker's sweep
+        # releases them when the pods are gone
+        actuator.leadership = leadership
+        plane.preemption = PreemptionPlanner(
+            plane,
+            gang_tracker,
+            actuator,
+            max_victims=args.preemptionMaxVictims,
+            retry_s=parse_duration(args.preemptionRetry),
+            leadership=leadership,
+        )
+    extender.admission = plane
+    return plane
+
+
 def add_forecast_flags(
     parser: argparse.ArgumentParser, forecast: bool = True
 ) -> None:
@@ -304,6 +470,11 @@ def build_budget_controller(args, extender, engine):
     degraded = getattr(extender, "degraded", None)
     if degraded is not None:
         controller.attach_degraded(degraded)
+    admission = getattr(extender, "admission", None)
+    if admission is not None and admission.preemption is not None:
+        # preemption aggressiveness: sustained availability burn steps
+        # the per-plan victim budget down (utils/control.py)
+        controller.attach_preemption(admission.preemption)
     extender.control = controller
     return controller
 

@@ -52,6 +52,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_profile_flag(parser)
     common.add_robustness_flags(parser, degraded=False)
     common.add_decision_flags(parser)
+    # queue-only admission: GAS has no gang tracker, so the --preemption
+    # surface is explicitly NOT offered (no dead flags)
+    common.add_admission_flags(parser, preemption=False)
     common.add_forecast_flags(parser, forecast=False)
     common.add_ha_flags(parser, ha=False)
     common.add_slo_flags(parser)
@@ -64,6 +67,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = build_arg_parser()
     args = parser.parse_args(argv)
     common.validate_control_flags(parser, args)
+    common.validate_admission_flags(parser, args)
     klog.set_verbosity(args.v)
     common.configure_decisions(args)
 
@@ -79,6 +83,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # rides each kernel's first compile)
     common.install_cost_visibility()
     extender = GASExtender(kube_client, retry_policy=retry_policy)
+    # admission plane (--admission=on): queue-only here — no gang
+    # tracker, so backfill runs size-only and preemption never attaches
+    common.build_admission_plane(args, extender, kube_client=kube_client)
 
     common.maybe_start_profiler(args.profilePort)
     watch_stop = threading.Event()

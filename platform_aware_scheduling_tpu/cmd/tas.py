@@ -108,6 +108,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_robustness_flags(parser)
     common.add_decision_flags(parser)
     common.add_gang_flags(parser)
+    common.add_admission_flags(parser)
     common.add_forecast_flags(parser)
     common.add_ha_flags(parser)
     common.add_slo_flags(parser)
@@ -305,6 +306,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = build_arg_parser()
     args = parser.parse_args(argv)
     common.validate_control_flags(parser, args)
+    common.validate_admission_flags(parser, args)
     klog.set_verbosity(args.v)
     sync_period_s = parse_duration(args.syncPeriod)
     # decision provenance on/off + ring size, before any verb can record
@@ -328,6 +330,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # cost-analysis capture hangs off each kernel's FIRST compile, which
     # assemble's warm pass triggers — install before assembly
     common.install_cost_visibility()
+    gang_tracker = common.build_gang_tracker(args, kube_client)
     cache, _, extender, controller, _, stop = assemble(
         kube_client,
         metrics_client,
@@ -337,7 +340,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         node_cache_capable=args.nodeCacheCapable,
         breakers=breakers,
         degraded_mode=args.degradedMode,
-        gang_tracker=common.build_gang_tracker(args, kube_client),
+        gang_tracker=gang_tracker,
         forecast_options=common.forecast_options(args, sync_period_s),
         leadership=leadership,
         gang_journal=gang_journal,
@@ -351,6 +354,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             "burst": args.rebalanceBurst,
             "min_available": args.rebalanceMinAvailable,
         },
+    )
+
+    # admission plane (--admission=on; docs/admission.md): the priority
+    # queue both verbs consult, plus — with --preemption=on — the gang
+    # preemption planner over its own dedicated active-mode actuator.
+    # Built BEFORE the budget controller so the preemption-
+    # aggressiveness knob can attach.  Off (the default) builds nothing
+    common.build_admission_plane(
+        args,
+        extender,
+        kube_client=kube_client,
+        gang_tracker=gang_tracker,
+        leadership=leadership,
     )
 
     # SLO engine (--slo=on; docs/observability.md "SLOs & error

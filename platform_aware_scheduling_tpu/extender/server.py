@@ -83,6 +83,7 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/decisions", "description": "scheduling decision provenance records; filters: ?pod=<name>&verb=<verb>&limit=<n> (404 when --decisionLog=off)"},
     {"path": "/debug/rebalance", "description": "last rebalance plan + loop state (404 when --rebalance=off)"},
     {"path": "/debug/gangs", "description": "gang reservations + lifecycle state (404 when --gang=off)"},
+    {"path": "/debug/admission", "description": "admission plane: priority queue entries, fairness streak, preemption planner state (404 when --admission=off)"},
     {"path": "/debug/forecast", "description": "per-metric forecast fits: slopes, horizons, uncertainty bands (404 when --forecast=off)"},
     {"path": "/debug/leader", "description": "leader-election state: role, lease holder, fencing token (404 when --leaderElect is off)"},
     {"path": "/debug/slo", "description": "SLO compliance, error budgets, and multi-window burn rates (404 when --slo=off)"},
@@ -455,6 +456,23 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=gangs.to_json(),
+            )
+        if bare_path == "/debug/admission":
+            # priority queue + preemption planner state
+            # (admission/plane.py); 404 when no plane is wired
+            # (--admission=off)
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            admission = getattr(self.scheduler, "admission", None)
+            if admission is None:
+                return HTTPResponse.json(
+                    b'{"error": "admission plane not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=admission.to_json(),
             )
         if bare_path == "/debug/forecast":
             # forecast fits + extrapolation state (forecast/engine.py);

@@ -30,7 +30,11 @@ The :class:`GangTracker` makes co-scheduling atomic:
     gang re-forms — so an abandoned half-gang can never pin mesh nodes
     forever, and no member of an incomplete gang binds after expiry.
 
-Lifecycle: ``forming -> reserved -> bound -> released``.  All state
+Lifecycle: ``forming -> reserved -> bound -> released``, with a
+``draining`` detour for preemption victims (admission/preempt.py): an
+evicted-whole gang keeps holding its slice while its pods terminate so
+the preemptor's overlapping reservation (``reserve_slice``) is never
+observably free to third parties.  All state
 transitions happen under one short lock; the feasibility solve runs on
 device (host mirror as fallback/control — byte-identical wire behavior,
 pinned by tests/test_gang.py).
@@ -55,6 +59,13 @@ from platform_aware_scheduling_tpu.utils.tracing import (
 STATE_FORMING = "forming"
 STATE_RESERVED = "reserved"
 STATE_BOUND = "bound"
+#: a preempted victim: its whole-gang eviction has been issued and its
+#: pods are terminating.  The gang KEEPS holding its slice (no third pod
+#: may slip into the hole) while the preemptor's overlapping reservation
+#: is already in place (reserve_slice) — reservation-while-draining.
+#: The dead-gang sweep releases it once every member is gone; a wedged
+#: drain is idle-dropped like an abandoned forming gang.
+STATE_DRAINING = "draining"
 STATE_RELEASED = "released"
 
 DEFAULT_TTL_S = 30.0
@@ -281,7 +292,9 @@ class GangTracker:
             bound_gangs = {
                 gang.gang_id: set(gang.bound)
                 for gang in self._gangs.values()
-                if gang.state == STATE_BOUND
+                # draining victims release here too: once every evicted
+                # member is gone the slice belongs to the preemptor alone
+                if gang.state in (STATE_BOUND, STATE_DRAINING)
             }
             if not bound_gangs:
                 return
@@ -330,7 +343,10 @@ class GangTracker:
         for gang in self._gangs.values():
             if gang.gang_id == exclude:
                 continue
-            if gang.state in (STATE_RESERVED, STATE_BOUND):
+            # draining victims still hold: their pods are terminating on
+            # the slice and the overlapping preemptor reservation relies
+            # on nobody else slipping in (reservation-while-draining)
+            if gang.state in (STATE_RESERVED, STATE_BOUND, STATE_DRAINING):
                 for node in gang.reserved_nodes:
                     held[node] = gang.gang_id
         return held
@@ -363,7 +379,10 @@ class GangTracker:
         for gang_id in [
             gid
             for gid, gang in self._gangs.items()
-            if gang.state == STATE_FORMING
+            # a DRAINING victim whose pods never finish terminating must
+            # not pin its slice forever either — same idle bound as an
+            # abandoned forming gang (the sweep handles the normal case)
+            if gang.state in (STATE_FORMING, STATE_DRAINING)
             and (now - gang.last_seen) > idle_bound
         ]:
             self._drop_locked(gang_id)
@@ -393,7 +412,7 @@ class GangTracker:
         held = sum(
             len(gang.reserved_nodes)
             for gang in self._gangs.values()
-            if gang.state in (STATE_RESERVED, STATE_BOUND)
+            if gang.state in (STATE_RESERVED, STATE_BOUND, STATE_DRAINING)
         )
         return float(active), float(held)
 
@@ -648,6 +667,103 @@ class GangTracker:
         self._journal_flush()
         return existed
 
+    # -- preemption support (admission/preempt.py; docs/admission.md) ----------
+
+    def mark_draining(self, gang_id: str) -> bool:
+        """Flip a preemption victim to DRAINING after its whole-gang
+        eviction was issued: the gang keeps holding its slice while its
+        pods terminate (nobody else may slip into the hole), but the
+        planner's census no longer offers it and its members re-enter
+        scheduling as a fresh gang once the sweep releases it."""
+        with self._lock:
+            gang = self._gangs.get(gang_id)
+            if gang is None or gang.state not in (
+                STATE_RESERVED,
+                STATE_BOUND,
+            ):
+                return False
+            gang.state = STATE_DRAINING
+            gang.expires_at = None
+            gang.last_seen = self._clock()
+            # held nodes did not change, but cached Filter verdicts may
+            # encode this gang as schedulable-on — not true anymore
+            self._reservation_version += 1
+            self._journal_gen += 1
+            gauges = self._publish_gauges_locked()
+        self._set_gauges(gauges)
+        self._journal_flush()
+        return True
+
+    def reserve_slice(
+        self,
+        pod: Pod,
+        nodes: List[str],
+        anchor: Optional[Tuple[int, int, int, int]] = None,
+    ) -> bool:
+        """Reservation-while-draining, the preemptor's half: hold the
+        planned slice for ``pod``'s gang BEFORE the victims finish
+        draining.  The preemptor's reservation may overlap DRAINING
+        victims' holds — its own members pass Filter on the slice (the
+        allowed-set check precedes the held map), every other pod keeps
+        failing those nodes, and when the sweep releases the last victim
+        the slice transfers without ever being observably free.  The
+        normal TTL applies from now, so an abandoned preemption still
+        expires instead of pinning the mesh."""
+        spec = GangSpec.from_pod(pod)
+        if spec is None or not nodes:
+            return False
+        now = self._clock()
+        with self._lock:
+            gang = self._gangs.get(spec.gang_id)
+            if gang is None:
+                gang = _Gang(spec, now)
+                self._gangs[spec.gang_id] = gang
+            if gang.state in (STATE_BOUND, STATE_DRAINING):
+                return False  # already placed, or itself a victim
+            key = f"{pod.namespace}/{pod.name}"
+            gang.members.add(key)
+            self._member_gang[key] = spec.gang_id
+            gang.last_seen = now
+            gang.state = STATE_RESERVED
+            gang.reserved_nodes = list(nodes)
+            gang.anchor = tuple(anchor) if anchor is not None else None
+            gang.bound = {}
+            gang.expires_at = now + self.ttl_s
+            self._reservation_version += 1
+            self._journal_gen += 1
+            gauges = self._publish_gauges_locked()
+        trace.COUNTERS.inc("pas_gang_reservations_total")
+        self._set_gauges(gauges)
+        self._journal_flush()
+        return True
+
+    def preemption_census(self) -> List[Dict]:
+        """The victim-candidate view the preemption planner scores:
+        every gang currently holding nodes and not already committed to
+        a prior preemption (RESERVED or BOUND; DRAINING gangs are spoken
+        for, FORMING gangs hold nothing worth taking)."""
+        with self._lock:
+            out = []
+            for gang in self._gangs.values():
+                if gang.state not in (STATE_RESERVED, STATE_BOUND):
+                    continue
+                out.append(
+                    {
+                        "gang": gang.gang_id,
+                        "state": gang.state,
+                        "size": gang.spec.size,
+                        "nodes": list(gang.reserved_nodes),
+                        "members": sorted(gang.members | set(gang.bound)),
+                        "bound": dict(gang.bound),
+                    }
+                )
+            return out
+
+    def mesh(self) -> Optional[topology.MeshView]:
+        """The (cached) mesh coordinate map, for the preemption
+        planner's feasibility what-ifs."""
+        return self._mesh_view(self._clock())
+
     # -- crash-safe journal (gang/journal.py; docs/gang.md) --------------------
 
     def _journal_snapshot_locked(self) -> Dict:
@@ -659,7 +775,15 @@ class GangTracker:
         for gang in sorted(
             self._gangs.values(), key=lambda g: (g.created_at, g.gang_id)
         ):
-            if gang.state not in (STATE_RESERVED, STATE_BOUND):
+            # DRAINING journals too (its slice is still held); recovery's
+            # non-bound branch restores any non-BOUND state as RESERVED
+            # with a fresh TTL, which is exactly the containment we want
+            # after a crash mid-preemption
+            if gang.state not in (
+                STATE_RESERVED,
+                STATE_BOUND,
+                STATE_DRAINING,
+            ):
                 continue
             gangs.append(
                 {

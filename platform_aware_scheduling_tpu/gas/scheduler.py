@@ -133,6 +133,14 @@ class GASExtender:
         # layer, so the universe key is always null here; front-ends
         # serve GET /debug/record + POST /debug/whatif (404 while None)
         self.flight = None
+        # opt-in admission.AdmissionPlane (--admission=on): GAS gets the
+        # queue-only plane — capacity-class (WontFit) failures enqueue,
+        # otherwise-admissible pods may be held behind higher-priority
+        # waiters, and the front-ends serve GET /debug/admission (404
+        # while None).  No gang tracker here, so backfill's covered-
+        # demand check runs size-only and preemption never attaches
+        # (docs/admission.md).  Off (None) keeps the wire byte-identical.
+        self.admission = None
         self._device = None
         if use_device:
             # deferred import: keeps the host layer importable without jax
@@ -150,6 +158,8 @@ class GASExtender:
             counter_sets.append(self.control.counters)
         if self.flight is not None:
             counter_sets.append(self.flight.counters)
+        if self.admission is not None:
+            counter_sets.append(self.admission.counters)
         return trace.exposition(
             recorders=[self.recorder], counter_sets=counter_sets
         )
@@ -200,8 +210,16 @@ class GASExtender:
                 request.flight_universe = (
                     None, len(args.node_names or ())
                 )
+            admission_codes: Dict[str, int] = {}
             with span.stage("kernel"):
-                result = self._filter_nodes(args, span=span)
+                result = self._filter_nodes(
+                    args, span=span, codes_out=admission_codes
+                )
+            if self.admission is not None and not result.error:
+                with span.stage("admission"):
+                    result = self._admission_review(
+                        args, result, admission_codes
+                    )
             status = 404 if result.error else 200
             with span.stage("encode"):
                 body = result.to_json()
@@ -243,7 +261,10 @@ class GASExtender:
     # -- filter (scheduler.go:447-482) -----------------------------------------
 
     def _filter_nodes(
-        self, args: Args, span=trace.NULL_SPAN
+        self,
+        args: Args,
+        span=trace.NULL_SPAN,
+        codes_out: Optional[Dict[str, int]] = None,
     ) -> FilterResult:
         if not args.node_names:
             error = (
@@ -272,6 +293,12 @@ class GASExtender:
                         for n, ok, code in zip(args.node_names, fits, codes)
                         if not ok
                     }
+                    if codes_out is not None:
+                        for n, ok, code in zip(
+                            args.node_names, fits, codes
+                        ):
+                            if not ok:
+                                codes_out[n] = code
                     self._record_filter_decision(
                         span, args.pod, args.node_names, failed, codes
                     )
@@ -303,11 +330,43 @@ class GASExtender:
                     code = decisions.CODE_GAS_ERROR
                 if code != decisions.CODE_ELIGIBLE:
                     failed[node_name] = decisions.gas_reason(code, summary)
+                    if codes_out is not None:
+                        codes_out[node_name] = code
                 codes.append(code)
             self._record_filter_decision(
                 span, args.pod, args.node_names, failed, codes
             )
             return FilterResult(node_names=node_names, failed_nodes=failed, error="")
+
+    def _admission_review(
+        self, args: Args, result: FilterResult, codes: Dict[str, int]
+    ) -> FilterResult:
+        """Consult the admission plane over one gas_filter verdict
+        (admission/plane.py review contract): None keeps the verdict
+        (admitted, or a WontFit-everywhere failure that enqueued); a
+        replacement pair means HELD behind higher-priority queued work —
+        every candidate fails CODE_ADMISSION_BLOCKED.  Fails open."""
+        try:
+            verdict = self.admission.review(
+                args.pod,
+                list(args.node_names or ()),
+                dict(result.failed_nodes),
+                codes,
+            )
+        except Exception as exc:
+            klog.error("admission review failed open: %r", exc)
+            return result
+        if verdict is None:
+            return result
+        held, _codes = verdict
+        merged = dict(result.failed_nodes)
+        merged.update(held)
+        node_names = [
+            n for n in (result.node_names or []) if n not in held
+        ]
+        return FilterResult(
+            node_names=node_names, failed_nodes=merged, error=result.error
+        )
 
     def _record_filter_decision(
         self, span, pod: Pod, node_names, failed: Dict[str, str], codes
@@ -426,6 +485,10 @@ class GASExtender:
                 decisions.DECISIONS.observe_bind(
                     args.pod_namespace, args.pod_name, args.node
                 )
+                if self.admission is not None:
+                    self.admission.observe_bind(
+                        args.pod_namespace, args.pod_name
+                    )
                 return BindingResult()
             except Exception as exc:
                 klog.error("binding failed: %s", exc)

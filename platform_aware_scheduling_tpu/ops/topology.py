@@ -206,6 +206,112 @@ def topology_feasibility(
     return topology_feasibility_host(free, h, w)
 
 
+# ---------------------------------------------------------------------------
+# wraparound (twisted-torus) windows
+# ---------------------------------------------------------------------------
+#
+# Real TPU pods close their ICI links into a torus: a 4x4 slice whose
+# rows wrap from column N-1 back to column 0 is just as valid as a
+# rectangle in the interior.  The SAME integral-image kernel answers the
+# wrapped question when run over a torus-padded copy of the free mask:
+#
+#   * one wrapped row/column on the TOP/LEFT so every anchor's one-cell
+#     halo ring sees true torus neighbors (not synthetic zeros);
+#   * ``h`` rows / ``w`` columns wrapped onto the BOTTOM/RIGHT so every
+#     anchor in [0, M) x [0, N) has its full window and halo in-bounds.
+#
+# Cropping the anchor grids back to [0, M) x [0, N) de-duplicates the
+# wrapped copies (each torus anchor appears exactly once), and the
+# node fold becomes a modular shift union.  The device path runs the
+# jitted kernel on the padded mask and shares the numpy crop/fold with
+# the host mirror, so torus parity reduces to the (already pinned)
+# rectangular kernel parity.
+
+
+def _torus_pad(free: np.ndarray, h: int, w: int) -> np.ndarray:
+    """The torus-padded free mask: [1 + M + h, 1 + N + w]."""
+    rows = np.concatenate([free[-1:, :], free, free[:h, :]], axis=0)
+    return np.concatenate([rows[:, -1:], rows, rows[:, :w]], axis=1)
+
+
+def _torus_fold(
+    anchor_ok: np.ndarray, anchor_score: np.ndarray, h: int, w: int
+) -> TopologyFeasibility:
+    """Fold cropped torus anchor scores onto the nodes they cover:
+    anchor (i, j) covers nodes ((i+a) mod M, (j+b) mod N) — a modular
+    shift union (np.roll), the torus analogue of the rectangular
+    mirror's shift loop."""
+    m, n = anchor_score.shape
+    node_score = np.full((m, n), INFEASIBLE, np.int32)
+    for a in range(h):
+        rolled_rows = np.roll(anchor_score, a, axis=0)
+        for b in range(w):
+            node_score = np.minimum(
+                node_score, np.roll(rolled_rows, b, axis=1)
+            )
+    return TopologyFeasibility(
+        anchor_ok=anchor_ok,
+        anchor_score=anchor_score,
+        node_ok=node_score < INFEASIBLE,
+        node_score=node_score,
+    )
+
+
+def torus_feasibility_device(
+    free: np.ndarray, h: int, w: int
+) -> TopologyFeasibility:
+    """Device path: the rectangular kernel over the torus-padded mask;
+    crop and fold happen host-side, shared verbatim with the mirror."""
+    free = np.asarray(free, dtype=bool)
+    m, n = free.shape
+    if h > m or w > n:  # a wrapped window larger than the torus self-overlaps
+        return _all_infeasible(m, n)
+    padded = _torus_pad(free, h, w)
+    _, anchor_score_p, _ = _topology_kernel(
+        jnp.asarray(padded, dtype=bool), int(h), int(w)
+    )
+    anchor_score = np.asarray(anchor_score_p)[1 : m + 1, 1 : n + 1]
+    return _torus_fold(anchor_score < INFEASIBLE, anchor_score, h, w)
+
+
+def torus_feasibility_host(
+    free: np.ndarray, h: int, w: int
+) -> TopologyFeasibility:
+    """Exact host mirror: the rectangular host kernel over the same
+    torus-padded mask, then the shared crop/fold."""
+    free = np.asarray(free, dtype=bool)
+    m, n = free.shape
+    if h > m or w > n:
+        return _all_infeasible(m, n)
+    padded = _torus_pad(free, h, w)
+    feas = topology_feasibility_host(padded, h, w)
+    anchor_score = feas.anchor_score[1 : m + 1, 1 : n + 1]
+    return _torus_fold(anchor_score < INFEASIBLE, anchor_score, h, w)
+
+
+def torus_feasibility(
+    free: np.ndarray, h: int, w: int, use_device: bool = True
+) -> TopologyFeasibility:
+    """Dual-path entry for wraparound windows, same fallback stance as
+    :func:`topology_feasibility`."""
+    if use_device:
+        try:
+            return torus_feasibility_device(free, h, w)
+        except Exception:
+            pass
+    return torus_feasibility_host(free, h, w)
+
+
+def torus_slice_cells(
+    i: int, j: int, h: int, w: int, m: int, n: int
+) -> List[Tuple[int, int]]:
+    """The wrapped window's cells in deterministic row-major order,
+    coordinates taken modulo the [m, n] torus."""
+    return [
+        ((i + a) % m, (j + b) % n) for a in range(h) for b in range(w)
+    ]
+
+
 def best_anchor(feas: TopologyFeasibility) -> Optional[Tuple[int, int, int]]:
     """The deterministic best anchor ``(row, col, score)``: lowest
     stranded-fragment score, row-major smallest position on ties; None

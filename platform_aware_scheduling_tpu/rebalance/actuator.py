@@ -32,7 +32,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from platform_aware_scheduling_tpu.kube.client import KubeError
 from platform_aware_scheduling_tpu.kube.objects import Pod, object_key
@@ -363,3 +363,83 @@ class SafeActuator:
             # partially-refused gang keeps its hold; the tracker's
             # dead-gang sweep reclaims it once every member disappears)
             self.gang_tracker.release(gang)
+
+    # -- preemption (admission/preempt.py; docs/admission.md) ------------------
+
+    def preempt_gang(
+        self,
+        gang_id: str,
+        pods: List[Pod],
+        counters=None,
+    ) -> Tuple[bool, ActuationResult]:
+        """The preemption verb — deliberate whole-gang displacement for
+        the admission plane, distinct from drift eviction in three ways:
+
+          * **no min-available floor**: preemption removes the victim
+            group entirely by design; the floor exists to stop a drift
+            plan from accidentally gutting a group, and here the planner
+            chose the whole gang deliberately (whole-gang atomicity is
+            the safety property, not the floor);
+          * **no slice release on success**: the victim flips to
+            DRAINING (caller) and keeps holding its nodes until its pods
+            are actually gone — reservation-while-draining;
+          * **its own accounting**: outcomes land in the admission
+            plane's ``pas_preemption_*`` families via ``counters``, not
+            in ``pas_rebalance_moves_*`` (the off path registers
+            nothing).
+
+        The shared gates stay: any member in eviction cooldown, missing
+        rate tokens (taken atomically for the whole gang), or a
+        non-active mode refuses the WHOLE preemption before any API
+        call, and every eviction re-verifies the fencing token.  Returns
+        ``(fully_evicted, result)``."""
+        result = ActuationResult()
+        moves = [
+            Move(
+                pod_key=object_key(pod),
+                namespace=pod.namespace,
+                name=pod.name,
+                from_node=pod.spec_node_name or "",
+                to_node="",
+                gain=0.0,
+            )
+            for pod in pods
+        ]
+
+        def refuse(reason: str) -> Tuple[bool, ActuationResult]:
+            for m in moves:
+                result.skip(reason, m)
+            if counters is not None and moves:
+                counters.inc(
+                    "pas_preemption_skipped_total",
+                    len(moves),
+                    labels={"reason": reason},
+                )
+            return False, result
+
+        if not moves:
+            return False, result
+        if any(self._in_cooldown(m.pod_key) for m in moves):
+            return refuse("cooldown")
+        if not self._bucket.try_take_n(len(moves)):
+            return refuse("rate_limit")
+        if self.mode != MODE_ACTIVE:
+            return refuse("dry_run")
+        klog.v(1).info_s(
+            f"preempting gang {gang_id} atomically ({len(moves)} pods)",
+            component="rebalance",
+        )
+        evicted = 0
+        for move, pod in zip(moves, pods):
+            if self._evict(move, pod, result):
+                evicted += 1
+        if counters is not None:
+            if evicted:
+                counters.inc("pas_preemption_evictions_total", evicted)
+            for reason, skipped in result.skipped.items():
+                counters.inc(
+                    "pas_preemption_skipped_total",
+                    len(skipped),
+                    labels={"reason": reason},
+                )
+        return evicted == len(moves), result

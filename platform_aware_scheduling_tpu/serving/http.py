@@ -124,6 +124,12 @@ class AsyncServer:
                 controller = getattr(self.scheduler, "control", None)
                 if controller is not None:
                     sets.append(controller.counters)
+                flight = getattr(self.scheduler, "flight", None)
+                if flight is not None:
+                    sets.append(flight.counters)
+                admission = getattr(self.scheduler, "admission", None)
+                if admission is not None:
+                    sets.append(admission.counters)
                 return trace.exposition(
                     recorders=[self.recorder], counter_sets=sets
                 )

@@ -177,6 +177,19 @@ class MetricsExtender:
         # Filter/Prioritize; only the actuation loops are gated
         # (docs/robustness.md "HA & leader election")
         self.leadership = None
+        # opt-in admission.AdmissionPlane, set by assembly when
+        # --admission=on: capacity-class Filter failures enqueue into a
+        # bounded per-class queue, an otherwise-admissible pod may be
+        # HELD behind higher-priority queued work (every candidate fails
+        # CODE_ADMISSION_BLOCKED), small gangs backfill a large gang's
+        # pending reservation, and the front-ends serve GET
+        # /debug/admission (404 while this is None).  While set, the
+        # Filter response cache is bypassed — the admission verdict is
+        # per-pod queue state the span-keyed cache cannot key
+        # (docs/admission.md).  Off (None) costs the verb one attribute
+        # check and keeps the wire byte-identical — pinned by
+        # tests/test_admission.py.
+        self.admission = None
         # request-independent ranking/violation caches + byte-fragment
         # encoder (tas/fastpath.py) — the per-request device dispatch and
         # per-node Python objects the round-1 verdict flagged are gone
@@ -402,6 +415,8 @@ class MetricsExtender:
             counter_sets.append(self.control.counters)
         if self.flight is not None:
             counter_sets.append(self.flight.counters)
+        if self.admission is not None:
+            counter_sets.append(self.admission.counters)
         return trace.exposition(
             recorders=[self.recorder], counter_sets=counter_sets
         )
@@ -549,7 +564,12 @@ class MetricsExtender:
                 gang_token = None
                 if self.gangs is not None:
                     gang_token = self._gang_cache_token(request)
-                if self.gangs is None or gang_token is not None:
+                if (
+                    self.gangs is None or gang_token is not None
+                ) and self.admission is None:
+                    # admission mode bypasses entirely: whether a pod is
+                    # admitted, held, or queued is per-pod queue state
+                    # that changes between identical request bodies
                     with span.stage("cache_probe"):
                         probe = self._filter_cache_probe(
                             request, gang_token
@@ -590,6 +610,11 @@ class MetricsExtender:
             if result is None:
                 klog.v(2).info_s("No filtered nodes returned", component="extender")
                 return HTTPResponse.json(b"null\n", status=404)
+            if self.admission is not None:
+                with span.stage("admission"):
+                    result = self._admission_review(
+                        args, result, gang_codes, degraded_action
+                    )
             with span.stage("encode"):
                 body = result.to_json()
             if probe is not None:
@@ -637,6 +662,51 @@ class MetricsExtender:
             self.recorder.observe("filter", time.perf_counter() - start)
             if self.flight is not None:
                 self._record_flight_verb("filter", request)
+
+    def _admission_review(
+        self, args, result, gang_codes, degraded_action
+    ):
+        """Consult the admission plane over one computed Filter verdict
+        (admission/plane.py review contract): None keeps the verdict
+        unchanged (admitted, or a failure that was enqueued/judged as a
+        side effect); a replacement ``(failed, codes)`` pair means HELD
+        — every candidate fails with CODE_ADMISSION_BLOCKED.  The held
+        codes merge into ``gang_codes`` so the decision record counts
+        holds under their own reason family.  Fails open: plane trouble
+        must never take down Filter."""
+        try:
+            default_code = decisions.CODE_RULE_VIOLATION
+            if degraded_action == degraded_mode.ACTION_FAIL_CLOSED:
+                default_code = decisions.CODE_FAIL_CLOSED
+            failed = dict(result.failed_nodes)
+            codes = {
+                name: gang_codes.get(name, default_code)
+                for name in failed
+            }
+            verdict = self.admission.review(
+                args.pod, self._candidate_names(args), failed, codes
+            )
+        except Exception as exc:
+            klog.error("admission review failed open: %r", exc)
+            return result
+        if verdict is None:
+            return result
+        held, held_codes = verdict
+        gang_codes.update(held_codes)
+        merged = dict(result.failed_nodes)
+        merged.update(held)
+        nodes = result.nodes
+        if nodes is not None:
+            nodes = [n for n in nodes if n.name not in held]
+        node_names = result.node_names
+        if node_names is not None:
+            node_names = [n for n in node_names if n not in held]
+        return FilterResult(
+            nodes=nodes,
+            node_names=node_names,
+            failed_nodes=merged,
+            error=result.error,
+        )
 
     def _gang_cache_token(self, request: HTTPRequest):
         """(reservation version, held map) when this request may use the
@@ -852,7 +922,9 @@ class MetricsExtender:
         # pod's open decision records AND promotes its gang reservation
         # toward fully-bound (gang/group.py observe_bind)
         if (
-            decisions.DECISIONS.enabled or self.gangs is not None
+            decisions.DECISIONS.enabled
+            or self.gangs is not None
+            or self.admission is not None
         ) and request.body:
             try:
                 from platform_aware_scheduling_tpu.extender.types import (
@@ -868,6 +940,10 @@ class MetricsExtender:
                     if self.gangs is not None:
                         self.gangs.observe_bind(
                             args.pod_namespace, args.pod_name, args.node
+                        )
+                    if self.admission is not None:
+                        self.admission.observe_bind(
+                            args.pod_namespace, args.pod_name
                         )
             except Exception:
                 pass  # feedback is best-effort; the verb stays a 404

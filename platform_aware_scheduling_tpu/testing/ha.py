@@ -200,6 +200,59 @@ class ReplicaStack:
             self.gangs.recover()
             self.extender.gangs = self.gangs
             self.rebalancer.actuator.gang_tracker = self.gangs
+        # the admission plane (cmd/common.build_admission_plane's twin):
+        # per-replica like every other collaborator, on the shared fake
+        # clock, with a DEDICATED DecisionLog so scenarios can assert
+        # admission/preemption provenance without the process-global
+        # log's cross-test noise
+        self.admission = None
+        if harness.admission_plane:
+            from platform_aware_scheduling_tpu.admission import (
+                AdmissionPlane,
+                PreemptionPlanner,
+            )
+            from platform_aware_scheduling_tpu.utils.decisions import (
+                DecisionLog,
+            )
+
+            plane = AdmissionPlane(
+                starve_consults=harness.admission_starve_consults,
+                clock=clock.now,
+                decision_log=DecisionLog(clock=clock.now),
+            )
+            plane.gangs = self.gangs
+            if harness.preemption and self.gangs is not None:
+                from platform_aware_scheduling_tpu.rebalance.actuator import (
+                    MODE_ACTIVE,
+                    SafeActuator,
+                )
+
+                # a dedicated actuator, as in production assembly: its
+                # token bucket is the preemption budget (generous here —
+                # the twin's subject is victim selection, not pacing)
+                # and it carries NO gang_tracker, because the rebalancer
+                # path's full-gang auto-release would fight
+                # reservation-while-draining
+                actuator = SafeActuator(
+                    self.ft_kube,
+                    mode=MODE_ACTIVE,
+                    rate_per_s=1000.0,
+                    burst=100,
+                    cooldown_s=0.0,
+                    clock=clock.now,
+                )
+                actuator.leadership = self.elector
+                plane.preemption = PreemptionPlanner(
+                    plane,
+                    self.gangs,
+                    actuator,
+                    max_victims=harness.preemption_max_victims,
+                    retry_s=0.0,  # the fake clock ticks coarsely
+                    leadership=self.elector,
+                    clock=clock.now,
+                )
+            self.admission = plane
+            self.extender.admission = plane
 
     def step(self) -> None:
         """This replica's slice of one fleet tick: election round, then
@@ -235,6 +288,10 @@ class HAHarness:
         gang_ttl_s: float = 30.0,
         journal_name: str = "pas-ha-journal",
         node_cap: int = 8,
+        admission_plane: bool = False,
+        preemption: bool = False,
+        preemption_max_victims: int = 8,
+        admission_starve_consults: int = 16,
     ):
         self.clock = FakeClock()
         self.plan = FaultPlan(seed=seed)
@@ -245,6 +302,14 @@ class HAHarness:
         self.rebalance_mode = rebalance_mode
         self.gang = gang
         self.gang_ttl_s = gang_ttl_s
+        #: admission plane options (ReplicaStack builds per replica):
+        #: ``admission_plane`` gates the whole subsystem (named to stay
+        #: clear of TwinCluster.admission, the serving-layer queue);
+        #: ``preemption`` additionally arms the planner (requires gang)
+        self.admission_plane = admission_plane
+        self.preemption = preemption
+        self.preemption_max_victims = preemption_max_victims
+        self.admission_starve_consults = admission_starve_consults
         self.journal_name = journal_name
         self.fake = FakeKubeClient()
         self.fake.fault_plan = self.plan

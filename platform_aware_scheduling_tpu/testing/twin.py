@@ -59,6 +59,7 @@ from platform_aware_scheduling_tpu.utils.slo import (
     ALERT_PAGE,
     SLO,
     SLOEngine,
+    _counter_specs,
     default_slos,
 )
 from platform_aware_scheduling_tpu.utils.tracing import CounterSet
@@ -186,6 +187,10 @@ class TwinCluster(HAHarness):
         admission_timeout_ticks: int = 2,
         retry_storm: bool = False,
         control: bool = False,
+        admission_plane: bool = False,
+        preemption: bool = False,
+        preemption_max_victims: int = 8,
+        admission_starve_consults: int = 16,
     ):
         super().__init__(
             replicas=replicas,
@@ -199,6 +204,14 @@ class TwinCluster(HAHarness):
             seed=seed,
             gang=gang,
             mesh=mesh,
+            # the PRIORITY admission plane (admission/plane.py), built
+            # per replica by ReplicaStack — distinct from
+            # ``admission_depth`` above, which models the SERVING-layer
+            # request queue (self.admission, an AdmissionQueue)
+            admission_plane=admission_plane,
+            preemption=preemption,
+            preemption_max_victims=preemption_max_victims,
+            admission_starve_consults=admission_starve_consults,
             # capacity below the violation threshold (4 x POD_LOAD=400
             # <= THRESHOLD=450): a capacity-legal rebalance plan can
             # never manufacture the next violating node, so scenarios
@@ -360,13 +373,48 @@ class TwinCluster(HAHarness):
                             threshold_s=wire_slo_us / 1e6,
                         )
                     )
+            plane = self.priority_plane()
+            if plane is not None:
+                # per-class admission availability (docs/admission.md):
+                # admitted consults are the good events, starvation
+                # events (consults past the plane's threshold) the bad.
+                # One SLO per configured class, so the preemption
+                # head-to-head can compare the HIGH class's error-budget
+                # ledger while watching the victim classes' cost.  An
+                # idle class measures compliance 1.0 (no events, no
+                # errors), so armed-but-quiet scenarios stay green.
+                for klass in plane.classes:
+                    slos.append(
+                        SLO(
+                            name=f"class_availability_{klass}",
+                            sli="counter_ratio",
+                            objective=0.9,
+                            description=(
+                                f"admission outcomes for priority class "
+                                f"{klass!r}: admitted vs starved consults"
+                            ),
+                            good=_counter_specs([{
+                                "name": "pas_admission_admitted_total",
+                                "labels": {"class": klass},
+                            }]),
+                            bad=_counter_specs([{
+                                "name": "pas_admission_starved_total",
+                                "labels": {"class": klass},
+                            }]),
+                        )
+                    )
             recorders = [s.extender.recorder for s in self.replicas if s]
             if self.gas is not None:
                 recorders.append(self.gas.recorder)
             self.engine = SLOEngine(
                 slos,
                 recorders=recorders,
-                counter_sets=[self.serving_counters],
+                # the plane's CounterSet joins the engine's sources the
+                # same single-replica way the controller attaches knobs:
+                # the head-to-heads run one replica, and that replica's
+                # pas_admission_* families are the class SLOs' events
+                counter_sets=[self.serving_counters]
+                + ([plane.counters] if plane is not None else []),
                 freshness=self._freshness,
                 clock=self.clock.now,
                 windows=slo_windows,
@@ -408,11 +456,35 @@ class TwinCluster(HAHarness):
             stack = next(s for s in self.replicas if s is not None)
             self.controller.attach_rebalancer(stack.rebalancer)
             self.controller.attach_degraded(stack.degraded)
+            if (
+                stack.admission is not None
+                and stack.admission.preemption is not None
+            ):
+                # the victim classes pay for planner aggressiveness:
+                # sustained burn on the LOWEST class's availability
+                # ledger steps the max_victims ceiling down
+                self.controller.attach_preemption(
+                    stack.admission.preemption,
+                    slo=(
+                        f"class_availability_"
+                        f"{stack.admission.classes[-1]}"
+                    ),
+                )
             for stack in self.replicas:
                 if stack is not None:
                     stack.extender.control = self.controller
 
     # -- signal plumbing -------------------------------------------------------
+
+    def priority_plane(self):
+        """The first replica's admission plane (admission/plane.py), or
+        None — the plane the engine's class SLOs and the controller's
+        preemption knob watch.  NOT ``self.admission``: that name is the
+        serving-layer :class:`AdmissionQueue` model."""
+        for stack in self.replicas:
+            if stack is not None and stack.admission is not None:
+                return stack.admission
+        return None
 
     def _freshness(self) -> Tuple[bool, str]:
         """The fleet's telemetry-freshness signal: the first LIVE
@@ -545,6 +617,14 @@ class TwinCluster(HAHarness):
         if not new:
             return
         self._seen_evictions = len(self.fake.evictions)
+        if self.gang:
+            # the mesh world belongs to the scenario: a preempted gang
+            # member silently re-created on the least-loaded node would
+            # keep its old gang's member key alive (the draining slice
+            # could never release) and would bypass the scheduler
+            # entirely.  Re-admission goes back through the verbs —
+            # which is exactly what the preemption cascade measures.
+            return
         targets: Dict[str, str] = {}
         for stack in self.live():
             record = stack.rebalancer.status().get("last_plan") or {}
@@ -937,6 +1017,9 @@ class Scenario:
                     "timeouts": twin.admission.timeouts,
                     "final_depth": twin.admission.max_queue_depth,
                 }
+            plane = twin.priority_plane()
+            if plane is not None:
+                result["admission_plane"] = plane.snapshot()
             return result
         finally:
             twin.close()
@@ -1641,6 +1724,675 @@ def control_headtohead(
         entry["strictly_better"] for entry in out["scenarios"].values()
     )
     return out
+
+
+class _AdmissionScenario(Scenario):
+    """Shared machinery for the admission-plane scenarios: a 4x4 mesh
+    twin with the priority plane armed, GangWave-style verb driving with
+    per-pod candidate control, and fake-pod bookkeeping on Bind — a
+    bound member lands as a REAL pod in the fake cluster (the
+    kube-scheduler's side of Bind), so the preemption planner's pod
+    census, the eviction verb, and the tracker's dead-gang sweep all see
+    true cluster state instead of phantom members."""
+
+    rows, cols = 4, 4
+    high_rows, high_cols = 2, 4
+    preemption = False
+    starve_consults = 16
+
+    def build(self, scale: Dict) -> TwinCluster:
+        scale = dict(scale)
+        scale.pop("num_nodes", None)
+        scale.pop("pods", None)
+        twin = TwinCluster(
+            num_nodes=self.rows * self.cols,
+            gang=True,
+            mesh=(self.rows, self.cols),
+            gas=False,
+            admission_plane=True,
+            preemption=self.preemption,
+            admission_starve_consults=self.starve_consults,
+            **scale,
+        )
+        #: each entry: {"pod": obj, "group": str, "candidates": [...]|None}
+        self.pending: List[Dict] = []
+        self.bound: Dict[str, List[str]] = {}
+        self.node_of: Dict[str, str] = {}
+        self.single_nodes: Set[str] = set()
+        self.admitted_at: Optional[int] = None
+        return twin
+
+    # -- pod bodies ------------------------------------------------------------
+
+    @staticmethod
+    def _gang_pod(
+        name: str, group: str, size: int, topo: str, klass: str
+    ) -> Dict:
+        pod = GangWave._pod_obj(name, group, size, topo)
+        pod["metadata"]["labels"][shared_labels.PRIORITY_LABEL] = klass
+        return pod
+
+    @staticmethod
+    def _single_pod(name: str, klass: str) -> Dict:
+        return {
+            "metadata": {
+                "name": name,
+                "namespace": "default",
+                "labels": {
+                    "telemetry-policy": POLICY_NAME,
+                    shared_labels.PRIORITY_LABEL: klass,
+                },
+            }
+        }
+
+    # -- verb driving ----------------------------------------------------------
+
+    def _drive_round(
+        self,
+        twin: TwinCluster,
+        only: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> int:
+        """One admission round: every still-pending pod (optionally only
+        group ``only``) tries Filter -> Prioritize -> Bind through the
+        real verbs, binding at most ``limit`` pods this round (the
+        ration that keeps a gang's slice reserved-with-waiters across
+        ticks).  Returns how many pods bound."""
+        extender = twin.live()[0].extender
+        bound_now = 0
+        progressed = []
+        # the kube-scheduler's one-pod-per-slot bookkeeping: a node
+        # hosting a live pod is not offered again, sourced from the fake
+        # cluster so completions and evictions free their nodes
+        occupied = {
+            p.spec_node_name
+            for p in twin.fake.list_pods()
+            if p.phase == "Running"
+        }
+        for item in self.pending:
+            if only is not None and item["group"] != only:
+                continue
+            if limit is not None and bound_now >= limit:
+                break
+            pod_obj = item["pod"]
+            candidates = item["candidates"]
+            if candidates is None:
+                candidates = [
+                    n
+                    for n in twin.mesh_nodes
+                    if n not in self.single_nodes
+                ]
+            twin.traffic["requests"] += 1
+            response = extender.filter(
+                _request(
+                    "/scheduler/filter",
+                    json.dumps(
+                        {"Pod": pod_obj, "NodeNames": candidates}
+                    ).encode(),
+                )
+            )
+            if response.status != 200:
+                twin.traffic["errors"] += 1
+                continue
+            passing = list(
+                json.loads(response.body).get("NodeNames") or []
+            )
+            if not passing:
+                continue
+            ranked = json.loads(
+                extender.prioritize(
+                    _request(
+                        "/scheduler/prioritize",
+                        json.dumps(
+                            {"Pod": pod_obj, "NodeNames": passing}
+                        ).encode(),
+                    )
+                ).body
+                or b"[]"
+            )
+            open_ranked = [
+                e for e in ranked if e["Host"] not in occupied
+            ]
+            open_passing = [n for n in passing if n not in occupied]
+            if open_ranked:
+                node = max(open_ranked, key=lambda e: e["Score"])["Host"]
+            elif open_passing:
+                node = open_passing[0]
+            else:
+                continue  # every passing node already hosts a pod
+            occupied.add(node)
+            name = pod_obj["metadata"]["name"]
+            extender.bind(
+                _request(
+                    "/scheduler/bind",
+                    json.dumps(
+                        {
+                            "PodName": name,
+                            "PodNamespace": "default",
+                            "PodUID": "uid",
+                            "Node": node,
+                        }
+                    ).encode(),
+                )
+            )
+            twin.fake.add_pod(
+                make_pod(
+                    name,
+                    labels=dict(pod_obj["metadata"]["labels"]),
+                    node_name=node,
+                    phase="Running",
+                )
+            )
+            self.bound.setdefault(item["group"], []).append(node)
+            self.node_of[name] = node
+            if shared_labels.GANG_SIZE_LABEL not in (
+                pod_obj["metadata"]["labels"]
+            ):
+                self.single_nodes.add(node)
+            bound_now += 1
+            progressed.append(item)
+        self.pending = [i for i in self.pending if i not in progressed]
+        return bound_now
+
+    def _complete_gang(self, twin: TwinCluster, names: List[str]) -> None:
+        """A gang's job finishes: its pods leave the cluster and the
+        tracker's dead-gang sweep releases the slice (gang/group.py) —
+        forced inline so the release lands this tick, not whenever the
+        next throttled background scan runs."""
+        for name in names:
+            twin.fake.delete_pod("default", name)
+        for stack in twin.live():
+            if stack.gangs is not None:
+                stack.gangs.prune()
+
+    def _forms(
+        self, twin: TwinCluster, nodes: List[str], h: int, w: int
+    ) -> bool:
+        from platform_aware_scheduling_tpu.ops import topology
+
+        mesh = topology.MeshView(twin.fake.list_nodes())
+        mask = mesh.free_mask(nodes)
+        if int(mask.sum()) != h * w:
+            return False
+        for hh, ww in {(h, w), (w, h)}:
+            if topology.topology_feasibility_host(mask, hh, ww).anchor_ok.any():
+                return True
+        return False
+
+    def _plane_counter(
+        self, twin: TwinCluster, name: str, klass: Optional[str] = None
+    ) -> float:
+        plane = twin.priority_plane()
+        if plane is None:
+            return 0.0
+        labels = {"class": klass} if klass is not None else None
+        return plane.counters.get(name, kind="counter", labels=labels)
+
+
+class PriorityInversionStorm(_AdmissionScenario):
+    """The queue-and-hold half of the admission plane, no preemption: a
+    fragmented mesh (free nodes exist, but no contiguous 2x4 window)
+    queues a high-priority gang, and the batch singles that keep
+    arriving are HELD behind it — without the gate they would nibble the
+    very nodes the gang is waiting for (the classic priority inversion).
+    When one fragment's job completes, the gang lands as a contiguous
+    slice first; the singles flow in behind it."""
+
+    name = "priority_inversion"
+    high_arrival = 3
+    singles_arrival = 4
+    release_tick = 8
+
+    def build(self, scale: Dict) -> TwinCluster:
+        twin = super().build(scale)
+        # two batch 2x2 gangs FORCED (via their candidate lists) onto
+        # the middle columns: the 8 free nodes (columns 0 and 3) are two
+        # disconnected 4x1 strips — no 2x4 or 4x2 window anywhere
+        for group, rows_ in (("frag-a", (0, 1)), ("frag-b", (2, 3))):
+            forced = [f"mesh-{r}-{c}" for r in rows_ for c in (1, 2)]
+            for i in range(4):
+                self.pending.append(
+                    {
+                        "pod": self._gang_pod(
+                            f"{group}-{i}", group, 4, "2x2", "batch"
+                        ),
+                        "group": group,
+                        "candidates": forced,
+                    }
+                )
+        return twin
+
+    def ticks(self, scale: Dict) -> int:
+        return 20
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.high_arrival:
+            for i in range(8):
+                self.pending.append(
+                    {
+                        "pod": self._gang_pod(
+                            f"high-{i}", "gang-high", 8, "2x4", "high"
+                        ),
+                        "group": "gang-high",
+                        "candidates": None,
+                    }
+                )
+        if t == self.singles_arrival:
+            for i in range(4):
+                self.pending.append(
+                    {
+                        "pod": self._single_pod(f"batch-s-{i}", "batch"),
+                        "group": "singles",
+                        "candidates": None,
+                    }
+                )
+        if t == self.release_tick:
+            self._complete_gang(
+                twin, [f"frag-a-{i}" for i in range(4)]
+            )
+        self._drive_round(twin)
+        if (
+            self.admitted_at is None
+            and len(self.bound.get("gang-high", [])) == 8
+        ):
+            self.admitted_at = t
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        high = self.bound.get("gang-high", [])
+        singles = self.bound.get("singles", [])
+        blocked = self._plane_counter(
+            twin, "pas_admission_blocked_total", "batch"
+        )
+        log = twin.priority_plane().decision_log
+        enqueues = [
+            r
+            for r in log.snapshot(verb="admission", limit=256)["records"]
+            if r.get("detail", {}).get("event") == "enqueue"
+        ]
+        checks = self.slo_gates(
+            twin,
+            compliant=("class_availability_high", "class_availability_batch"),
+        )
+        checks.extend(
+            [
+                self._check(
+                    "high_admitted_as_slice",
+                    len(high) == 8
+                    and self._forms(
+                        twin, high, self.high_rows, self.high_cols
+                    ),
+                    f"{len(high)}/8 bound after the fragment released",
+                ),
+                self._check(
+                    "singles_held_then_admitted",
+                    blocked > 0 and len(singles) == 4,
+                    f"{blocked:g} holds, {len(singles)}/4 singles bound",
+                ),
+                self._check(
+                    "holds_have_provenance",
+                    len(enqueues) > 0,
+                    f"{len(enqueues)} enqueue records in the decision log",
+                ),
+                self._check(
+                    "no_sharp_edges",
+                    len(twin.evictions()) == 0
+                    and self._plane_counter(
+                        twin, "pas_preemption_reservations_total"
+                    )
+                    == 0,
+                    "queue-and-hold only: zero evictions, zero "
+                    "preemptions",
+                ),
+            ]
+        )
+        return checks
+
+
+class BackfillStarvation(_AdmissionScenario):
+    """The backfill guarantee: while a high-priority gang drains into
+    its RESERVED slice one member per tick (the window in which a naive
+    priority queue would starve everyone behind the head), small batch
+    singles keep arriving — each must be admitted through the backfill
+    branch (the head's demand stays covered by its reservation), and
+    none may starve."""
+
+    name = "backfill_starvation"
+    arrival = 2
+    release_tick = 3
+    singles_start = 4
+
+    def build(self, scale: Dict) -> TwinCluster:
+        twin = super().build(scale)
+        # batch-a (2x4, rows 0-1) + batch-b (2x2, rows 2-3 x cols 0-1):
+        # free is only the 2x2 block at rows 2-3 x cols 2-3, so the high
+        # 2x4 gang is infeasible until batch-a completes
+        for i in range(8):
+            self.pending.append(
+                {
+                    "pod": self._gang_pod(
+                        f"batch-a-{i}", "batch-a", 8, "2x4", "batch"
+                    ),
+                    "group": "batch-a",
+                    "candidates": [
+                        f"mesh-{r}-{c}" for r in (0, 1) for c in range(4)
+                    ],
+                }
+            )
+        for i in range(4):
+            self.pending.append(
+                {
+                    "pod": self._gang_pod(
+                        f"batch-b-{i}", "batch-b", 4, "2x2", "batch"
+                    ),
+                    "group": "batch-b",
+                    "candidates": [
+                        f"mesh-{r}-{c}" for r in (2, 3) for c in (0, 1)
+                    ],
+                }
+            )
+        return twin
+
+    def ticks(self, scale: Dict) -> int:
+        # 8 rationed one-per-tick member binds after the release (which
+        # itself may wait a tick or two on the throttled dead-gang
+        # sweep), plus slack: 20 ticks
+        return 20
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.arrival:
+            for i in range(8):
+                self.pending.append(
+                    {
+                        "pod": self._gang_pod(
+                            f"high-{i}", "gang-high", 8, "2x4", "high"
+                        ),
+                        "group": "gang-high",
+                        "candidates": None,
+                    }
+                )
+        if t == self.release_tick:
+            self._complete_gang(
+                twin, [f"batch-a-{i}" for i in range(8)]
+            )
+        if self.singles_start <= t < self.singles_start + 4:
+            self.pending.append(
+                {
+                    "pod": self._single_pod(
+                        f"batch-s-{t - self.singles_start}", "batch"
+                    ),
+                    "group": "singles",
+                    "candidates": None,
+                }
+            )
+        if t < self.arrival:
+            self._drive_round(twin)
+            return
+        # ration the high gang to ONE member bind per tick: the slice
+        # stays reserved-with-waiters for several ticks — exactly the
+        # window the backfill branch exists for
+        self._drive_round(twin, only="gang-high", limit=1)
+        self._drive_round(twin, only="batch-a")
+        self._drive_round(twin, only="batch-b")
+        self._drive_round(twin, only="singles")
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        high = self.bound.get("gang-high", [])
+        singles = self.bound.get("singles", [])
+        backfills = self._plane_counter(
+            twin, "pas_admission_backfill_total", "batch"
+        )
+        starved = self._plane_counter(
+            twin, "pas_admission_starved_total", "batch"
+        )
+        checks = self.slo_gates(
+            twin,
+            compliant=("class_availability_high", "class_availability_batch"),
+        )
+        checks.extend(
+            [
+                self._check(
+                    "high_admitted_as_slice",
+                    len(high) == 8
+                    and self._forms(
+                        twin, high, self.high_rows, self.high_cols
+                    ),
+                    f"{len(high)}/8 bound, one member per tick",
+                ),
+                self._check(
+                    "singles_backfilled",
+                    backfills > 0 and len(singles) == 4,
+                    f"{backfills:g} backfill admissions, "
+                    f"{len(singles)}/4 singles bound",
+                ),
+                self._check(
+                    "nobody_starved",
+                    starved == 0,
+                    f"{starved:g} batch starvation events",
+                ),
+            ]
+        )
+        return checks
+
+
+class PreemptionCascade(_AdmissionScenario):
+    """The sharp edge, run with the planner ON or OFF over an identical
+    program: two batch gangs fill the mesh, then a high-priority gang
+    arrives.  ON, the planner evicts the cheapest whole batch gang
+    all-or-nothing, reserves the freed slice while the victims drain,
+    and the high gang binds within a bounded number of ticks — with a
+    provenance record naming every victim.  OFF, the high gang starves
+    (and its availability ledger shows it) while not a single pod is
+    evicted.  :func:`admission_headtohead` compares the two runs."""
+
+    name = "preemption_cascade"
+    arrival = 4
+    admit_budget_ticks = 3
+    starve_consults = 4
+
+    def __init__(self, preemption: bool = True):
+        self.preemption = bool(preemption)
+        if not preemption:
+            self.name = "preemption_cascade_off"
+
+    def build(self, scale: Dict) -> TwinCluster:
+        twin = super().build(scale)
+        for i in range(8):  # strict interleave, as in GangWave
+            for group in ("batch-a", "batch-b"):
+                self.pending.append(
+                    {
+                        "pod": self._gang_pod(
+                            f"{group}-{i}", group, 8, "2x4", "batch"
+                        ),
+                        "group": group,
+                        "candidates": None,
+                    }
+                )
+        return twin
+
+    def ticks(self, scale: Dict) -> int:
+        return 16
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.arrival:
+            for i in range(8):
+                self.pending.append(
+                    {
+                        "pod": self._gang_pod(
+                            f"high-{i}", "gang-high", 8, "2x4", "high"
+                        ),
+                        "group": "gang-high",
+                        "candidates": None,
+                    }
+                )
+        self._drive_round(twin)
+        if (
+            self.admitted_at is None
+            and len(self.bound.get("gang-high", [])) == 8
+        ):
+            self.admitted_at = t
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        high = self.bound.get("gang-high", [])
+        evictions = twin.evictions()
+        plane = twin.priority_plane()
+        preemptions = self._plane_counter(
+            twin, "pas_preemption_reservations_total"
+        )
+        records = plane.decision_log.snapshot(
+            verb="preemption", limit=64
+        )["records"]
+        if not self.preemption:
+            # the control arm: no planner, so the high gang must starve
+            # visibly (the ledger is the head-to-head's comparison) and
+            # nothing may be evicted
+            starved = self._plane_counter(
+                twin, "pas_admission_starved_total", "high"
+            )
+            return [
+                self._check(
+                    "high_never_admitted",
+                    not high and self.admitted_at is None,
+                    f"{len(high)} members bound without preemption",
+                ),
+                self._check(
+                    "high_starvation_visible",
+                    starved > 0,
+                    f"{starved:g} starvation events for class high",
+                ),
+                self._check(
+                    "zero_evictions",
+                    len(evictions) == 0 and preemptions == 0,
+                    f"{len(evictions)} evictions, {preemptions:g} "
+                    f"preemption reservations",
+                ),
+            ]
+        victim_classes = {
+            v["class"]
+            for r in records
+            for v in r.get("detail", {}).get("victims", [])
+        }
+        survivor = [
+            p
+            for p in twin.fake.list_pods()
+            if p.name.startswith("batch-") and p.phase == "Running"
+        ]
+        checks = self.slo_gates(
+            twin, compliant=("class_availability_high",)
+        )
+        checks.extend(
+            [
+                self._check(
+                    "high_admitted_in_bounded_ticks",
+                    self.admitted_at is not None
+                    and self.admitted_at
+                    <= self.arrival + self.admit_budget_ticks
+                    and len(high) == 8
+                    and self._forms(
+                        twin, high, self.high_rows, self.high_cols
+                    ),
+                    f"admitted at tick {self.admitted_at} "
+                    f"(arrival {self.arrival}, budget "
+                    f"{self.admit_budget_ticks})",
+                ),
+                self._check(
+                    "one_whole_gang_evicted",
+                    len(evictions) == 8
+                    and len({e["pod"].rsplit("-", 1)[0] for e in evictions})
+                    == 1,
+                    f"{len(evictions)} evictions: "
+                    f"{sorted(e['pod'] for e in evictions)}",
+                ),
+                self._check(
+                    "every_preemption_has_provenance",
+                    preemptions >= 1 and len(records) == int(preemptions),
+                    f"{preemptions:g} reservations, {len(records)} "
+                    f"provenance records",
+                ),
+                self._check(
+                    "victims_strictly_lower_class",
+                    victim_classes == {"batch"},
+                    f"victim classes: {sorted(victim_classes)}",
+                ),
+                self._check(
+                    "survivor_gang_intact",
+                    len(survivor) == 8,
+                    f"{len(survivor)} batch pods still running",
+                ),
+            ]
+        )
+        return checks
+
+
+def admission_headtohead(period_s: float = 5.0) -> Dict:
+    """The admission plane's acceptance A/B (docs/admission.md): the
+    preemption cascade runs twice on identical twins — planner ON vs OFF
+    — and the verdict compares the HIGH class's final error-budget
+    ledger (ON must finish strictly better, having admitted the gang in
+    bounded ticks; OFF must never admit it and never evict).  Plus the
+    null hypothesis: a quiet diurnal day with the plane armed
+    (queue-only, no contention) must end with zero queueing, zero
+    preemptions, and every check green — a gate that fidgets on a
+    healthy cluster is itself a defect."""
+    scale = {"period_s": period_s}
+    on = PreemptionCascade(preemption=True).run(dict(scale))
+    off = PreemptionCascade(preemption=False).run(dict(scale))
+    slo_name = "class_availability_high"
+    on_budget = (on["judgment"].get(slo_name) or {}).get(
+        "error_budget_remaining"
+    )
+    off_budget = (off["judgment"].get(slo_name) or {}).get(
+        "error_budget_remaining"
+    )
+    quiet = DiurnalLoad().run(
+        {
+            "num_nodes": 16,
+            "pods": 16,
+            "period_s": period_s,
+            "admission_plane": True,
+        }
+    )
+    quiet_plane = quiet.get("admission_plane") or {}
+    quiet_ok = (
+        quiet["passed"]
+        and quiet_plane.get("depth") == 0
+        and (quiet_plane.get("counters") or {}).get("queued", 0) == 0
+        and (quiet_plane.get("counters") or {}).get("preemptions", 0) == 0
+    )
+    return {
+        "slo": slo_name,
+        "preemption_on": {
+            "budget": on_budget,
+            "admitted": any(
+                c["check"] == "high_admitted_in_bounded_ticks" and c["ok"]
+                for c in on["checks"]
+            ),
+            "passed": on["passed"],
+            "checks": on["checks"],
+        },
+        "preemption_off": {
+            "budget": off_budget,
+            "passed": off["passed"],
+            "checks": off["checks"],
+        },
+        "strictly_better": bool(
+            on_budget is not None
+            and off_budget is not None
+            and on_budget > off_budget
+        ),
+        "diurnal_quiet": {
+            "passed": quiet["passed"],
+            "plane": quiet_plane,
+            "ok": quiet_ok,
+        },
+        "all_ok": bool(
+            on["passed"]
+            and off["passed"]
+            and on_budget is not None
+            and off_budget is not None
+            and on_budget > off_budget
+            and quiet_ok
+        ),
+    }
 
 
 DEFAULT_SCENARIOS: Tuple[Scenario, ...] = (

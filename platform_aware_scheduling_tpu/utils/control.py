@@ -305,6 +305,37 @@ class BudgetController:
             )
         )
 
+    def attach_preemption(
+        self, planner, slo: str = "verb_availability"
+    ) -> Optional[Knob]:
+        """The preemption-aggressiveness knob: sustained availability
+        burn steps the per-plan victim budget (admission/preempt.py
+        reads ``max_victims`` live each plan) down by halving toward 1
+        — a cluster already burning availability budget must not ALSO
+        amplify churn with bigger victim sets.  ``slo`` defaults to the
+        shared verb-availability objective; the twin attaches it to the
+        per-class availability SLOs instead.  None when the configured
+        budget is already 1 (nothing to tighten)."""
+        baseline = max(1, int(planner.max_victims))
+        ladder: List[int] = [baseline]
+        while ladder[-1] > 1:
+            ladder.append(ladder[-1] // 2)
+        if len(ladder) < 2:
+            return None
+
+        def write(value, planner=planner):
+            planner.max_victims = max(1, int(value))
+
+        return self.add_knob(
+            Knob(
+                "preemption_max_victims",
+                slo,
+                ladder,
+                write,
+                read=lambda: planner.max_victims,
+            )
+        )
+
     # -- the control loop ------------------------------------------------------
 
     def on_tick(self, evaluations: Dict[str, Dict]) -> None:

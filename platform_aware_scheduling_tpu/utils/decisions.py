@@ -65,6 +65,7 @@ CODE_GAS_CAPACITY = 5
 CODE_GAS_ERROR = 6  # host-loop unexpected failure; no device analog
 CODE_GANG_RESERVED = 7  # node held by another gang's reservation
 CODE_GANG_INFEASIBLE = 8  # no feasible slice / node outside the gang's slice
+CODE_ADMISSION_BLOCKED = 9  # admission queue holding the pod back
 
 #: code -> bounded Prometheus ``reason`` label (never per-rule/per-node:
 #: label cardinality stays fixed; per-rule detail lives in the records
@@ -78,7 +79,44 @@ CODE_LABELS: Dict[int, str] = {
     CODE_GAS_ERROR: "gas_error",
     CODE_GANG_RESERVED: "gang_reserved",
     CODE_GANG_INFEASIBLE: "gang_infeasible",
+    CODE_ADMISSION_BLOCKED: "admission_blocked",
 }
+
+#: the capacity-vs-policy split the admission queue keys on.  A
+#: QUEUEABLE failure is transient cluster state — someone else holds the
+#: capacity right now (gang reservations, GAS card occupancy, no feasible
+#: slice THIS tick) — so retrying from the queue can succeed without any
+#: policy change.  Everything else is TERMINAL for the queue: a
+#: ``dontschedule`` policy rejection, fail-closed degradation, or a node
+#: that structurally cannot host the pod will fail identically on every
+#: retry, so enqueueing it would only burn fairness budget (the
+#: never-retry-a-policy-rejection pin in tests/test_admission.py).
+QUEUEABLE_CODES = frozenset(
+    {CODE_GAS_CAPACITY, CODE_GANG_RESERVED, CODE_GANG_INFEASIBLE}
+)
+
+
+def queueable(code: int) -> bool:
+    """Whether one Filter failure code is capacity-class (retryable from
+    the admission queue) rather than policy/error-class (terminal)."""
+    return code in QUEUEABLE_CODES
+
+
+def queueable_counts(reason_counts: Mapping[int, int]) -> bool:
+    """Whether a whole Filter failure is queueable: every failed node's
+    reason must be capacity-class.  One terminal reason anywhere makes
+    the decision terminal — a pod rejected by policy on half the mesh
+    and capacity on the other half would never bind even if the capacity
+    half freed up, unless the policy verdict changes (which re-enters
+    Filter on its own)."""
+    counted = False
+    for code, count in reason_counts.items():
+        if not count:
+            continue
+        counted = True
+        if code not in QUEUEABLE_CODES:
+            return False
+    return counted
 
 REASON_FAIL_CLOSED = "degraded fail-closed"
 REASON_GAS_UNKNOWN = "gas: node unknown to cache"
@@ -467,6 +505,46 @@ class DecisionLog:
             pod_namespace="-",
             pod_name=str(detail.get("knob", "control")),
             path=str(detail.get("direction", "")),
+            detail=detail,
+        )
+        record.outcome = {"completed": True}
+        self.add(record)
+
+    def record_admission(self, detail: Dict) -> None:
+        """One admission-plane event (admission/plane.py): enqueue,
+        backfill, overflow shed, or starvation promotion — keyed by the
+        subject pod but born closed (the pod's own Filter records carry
+        the open/bind lifecycle; the admission event is its own
+        outcome)."""
+        if not self.enabled:
+            return
+        pod = str(detail.get("pod", "-/admission"))
+        namespace, _, name = pod.partition("/")
+        record = DecisionRecord(
+            verb="admission",
+            pod_namespace=namespace or "-",
+            pod_name=name or "admission",
+            path=str(detail.get("event", "")),
+            detail=detail,
+        )
+        record.outcome = {"completed": True}
+        self.add(record)
+
+    def record_preemption(self, detail: Dict) -> None:
+        """One gang preemption (admission/preempt.py): which gang was
+        admitted over which victims, the per-victim eviction counts, and
+        the slice reserved for the preemptor — the provenance record the
+        acceptance gate requires for EVERY preemption.  Born closed like
+        a rebalance cycle summary."""
+        if not self.enabled:
+            return
+        pod = str(detail.get("target", "-/preemption"))
+        namespace, _, name = pod.partition("/")
+        record = DecisionRecord(
+            verb="preemption",
+            pod_namespace=namespace or "-",
+            pod_name=name or "preemption",
+            path=str(detail.get("outcome", "")),
             detail=detail,
         )
         record.outcome = {"completed": True}

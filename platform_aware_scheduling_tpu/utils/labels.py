@@ -14,7 +14,10 @@ back-compat alias).  This module must stay importable without jax.
     ``4x4`` (a contiguous 4-row by 4-column slice); absent means any
     ``k`` mesh nodes (no adjacency constraint);
   * ``TPU_COORD_LABEL`` — a node's mesh coordinate ``"row,col"``
-    (synthesized by testing/fake_kube for hermetic meshes).
+    (synthesized by testing/fake_kube for hermetic meshes);
+  * ``PRIORITY_LABEL`` — the pod's admission priority class name
+    (admission/plane.py; unlabeled or unknown-class pods take the
+    plane's default class).
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ GROUP_LABEL = "pas-workload-group"
 GANG_SIZE_LABEL = "pas-gang-size"
 GANG_TOPOLOGY_LABEL = "pas-gang-topology"
 TPU_COORD_LABEL = "pas-tpu-coord"
+PRIORITY_LABEL = "pas-priority"
 
 
 def gang_reserved_reason(gang_id: str) -> str:
@@ -63,6 +67,21 @@ def gang_id_for(namespace: str, pod_labels: Dict[str, str]) -> Optional[str]:
         if topo is None or topo[0] * topo[1] != size:
             return None
     return f"{namespace}/{group}"
+
+
+def priority_class_for(pod_labels: Dict[str, str], classes) -> Optional[str]:
+    """The pod's declared admission priority class, or None when the pod
+    is unlabeled or names a class outside ``classes`` (the configured
+    ladder).  This is the single classifier — the admission plane, the
+    preemption planner's victim census, and the decision records all go
+    through it, so a mislabeled pod degrades to the default class
+    EVERYWHERE instead of crashing Filter or forking semantics."""
+    raw = pod_labels.get(PRIORITY_LABEL)
+    if not raw:
+        return None
+    if raw not in classes:
+        return None
+    return raw
 
 
 #: sanity ceiling per mesh dimension: the dense [rows, cols] grids the

@@ -154,8 +154,8 @@ declare("pas_degraded", "gauge", "1 while the named subsystem runs degraded: tel
 # decision provenance (utils/decisions.py: per-decision explain records,
 # placement-quality feedback, /debug/decisions; docs/observability.md
 # "Decision provenance")
-declare("pas_decision_records_total", "counter", "Scheduling decisions recorded into the decision log (label: verb in filter/prioritize/gas_filter/rebalance/control).")
-declare("pas_decision_filtered_nodes_total", "counter", "Nodes filtered out of scheduling decisions, by reason class (label: reason in rule_violation/fail_closed/gas_unknown_node/gas_no_gpus/gas_capacity/gas_error/gang_reserved/gang_infeasible).")
+declare("pas_decision_records_total", "counter", "Scheduling decisions recorded into the decision log (label: verb in filter/prioritize/gas_filter/rebalance/control/admission/preemption).")
+declare("pas_decision_filtered_nodes_total", "counter", "Nodes filtered out of scheduling decisions, by reason class (label: reason in rule_violation/fail_closed/gas_unknown_node/gas_no_gpus/gas_capacity/gas_error/gang_reserved/gang_infeasible/admission_blocked).")
 declare("pas_decision_open", "gauge", "Decision records currently awaiting outcome feedback (pod bind / rebalance).")
 declare("pas_decision_closed_total", "counter", "Decision records closed by a pod-bind observation.")
 declare("pas_decision_violated_at_bind_total", "counter", "Pods bound onto a node the Filter decision had marked violating — the placement-quality red flag.")
@@ -211,6 +211,23 @@ declare("pas_record_events_total", "counter", "Anonymized events accepted into t
 declare("pas_record_dropped_total", "counter", "Oldest flight-recorder events evicted by ring overflow (raise --recordSize if this moves).")
 declare("pas_whatif_runs_total", "counter", "What-if twin replay runs served (POST /debug/whatif + the cmd.whatif CLI).")
 declare("pas_whatif_failures_total", "counter", "What-if runs that failed to parse their capture or crashed mid-replay.")
+# priority-aware admission plane (admission/plane.py + admission/preempt.py;
+# docs/admission.md).  The pas_admission_*/pas_preemption_* families live
+# in the plane's own CounterSet and appear on /metrics only where one is
+# wired (--admission=on) — the off path registers nothing and stays
+# byte-identical on the wire.
+declare("pas_admission_queued_total", "counter", "Pods enqueued after a capacity-class Filter failure (label: class).")
+declare("pas_admission_admitted_total", "counter", "Filter admissions the gate allowed through (label: class) — per decision, not per pod.")
+declare("pas_admission_backfill_total", "counter", "Admissions that flowed around a higher-priority waiter whose demand stayed covered (label: class).")
+declare("pas_admission_blocked_total", "counter", "Filter passes held back behind a higher-priority waiter (label: class) — the head-of-line gate.")
+declare("pas_admission_rejected_total", "counter", "Queue departures without admission (labels: class, reason in overflow/terminal).")
+declare("pas_admission_starved_total", "counter", "Queue consults past the starvation threshold (label: class) — the bad half of the per-class availability SLOs.")
+declare("pas_admission_queue_depth", "gauge", "Current admission-queue depth (label: class).")
+declare("pas_preemption_plans_total", "counter", "Preemption planning passes (label: outcome in planned/infeasible/over_budget/not_leader/actuation_refused/reserve_failed/no_pod_view).")
+declare("pas_preemption_victim_gangs_total", "counter", "Whole gangs displaced by executed preemptions.")
+declare("pas_preemption_evictions_total", "counter", "Pod evictions executed through the actuator's preemption verb.")
+declare("pas_preemption_skipped_total", "counter", "Preemption evictions refused by the actuator's gates (label: reason in cooldown/rate_limit/dry_run/pdb/fenced/error).")
+declare("pas_preemption_reservations_total", "counter", "Freed slices reserved for the preempting gang while its victims drain.")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where

@@ -1,0 +1,809 @@
+"""Priority-aware admission plane suite (ISSUE 16, docs/admission.md).
+
+Covers the whole subsystem:
+
+  * priority classes: one ``pas-priority`` label validator, default
+    fallback for unlabeled/unknown pods, malformed ladders fail fast;
+  * the bounded queue: (class, arrival) head-of-line order, overflow
+    shedding (worst-ranked entry, or the arrival itself when it ranks
+    worst), the fairness-streak override, backfill (spare-nodes and
+    covered-by-reservation), starvation accounting past the consult
+    threshold, terminal drops, bind feedback;
+  * victim selection + atomic execution: never equal-or-higher class,
+    whole gangs only, leader-gated, bounded appetite, retry throttle,
+    and fenced-refusal containment (an aborted plan creates NO
+    reservation);
+  * flag wiring: --preemption=on demands --admission=on AND --gang=on
+    (exit 2 with usage), GAS offers no --preemption at all, malformed
+    class ladders exit 2, --admission=off builds nothing;
+  * the off-path pin: without a plane the verbs serve byte-identically,
+    /debug/admission is 404, and zero pas_admission_* families register;
+  * torus wraparound feasibility device<->host parity (ops/topology);
+  * the ACCEPTANCE scenarios over real sockets on BOTH front-ends:
+    priority inversion held at the gate, backfill without starvation,
+    and the preemption cascade ON vs OFF head-to-head.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from benchmarks.gang_load import _post, build_mesh_service
+from platform_aware_scheduling_tpu.admission import (
+    AdmissionPlane,
+    PreemptionPlanner,
+    blocked_reason,
+)
+from platform_aware_scheduling_tpu.gang import GangTracker
+from platform_aware_scheduling_tpu.ops import topology
+from platform_aware_scheduling_tpu.rebalance.actuator import (
+    MODE_ACTIVE,
+    MODE_DRY_RUN,
+    SafeActuator,
+)
+from platform_aware_scheduling_tpu.testing import twin as tw
+from platform_aware_scheduling_tpu.testing.builders import (
+    make_gang_pod,
+    make_pod,
+)
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.utils import decisions
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
+from wirehelpers import get_request, start_async, start_threaded
+
+HIGH = {shared_labels.PRIORITY_LABEL: "high"}
+BATCH = {shared_labels.PRIORITY_LABEL: "batch"}
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+class _Leader:
+    def __init__(self, ok: bool):
+        self.ok = ok
+
+    def is_leader(self) -> bool:
+        return self.ok
+
+
+def _plane(**kw):
+    clock = _Clock()
+    kw.setdefault("clock", clock.now)
+    kw.setdefault(
+        "decision_log", decisions.DecisionLog(clock=clock.now)
+    )
+    return AdmissionPlane(**kw), clock
+
+
+def _consult(plane, pod, nodes):
+    """Filter passed on every candidate: the gate decides."""
+    return plane.review(pod, list(nodes), {}, {})
+
+
+def _miss(plane, pod, nodes, code=decisions.CODE_GANG_INFEASIBLE):
+    """Filter failed on every candidate with one uniform code."""
+    failed = {n: "x" for n in nodes}
+    codes = {n: code for n in nodes}
+    return plane.review(pod, list(nodes), failed, codes)
+
+
+def _counter(plane, name, **labels):
+    return plane.counters.get(
+        name, kind="counter", labels=labels or None
+    )
+
+
+def _events(plane, verb="admission"):
+    return plane.decision_log.snapshot(verb=verb, limit=64)["records"]
+
+
+# ---------------------------------------------------------------------------
+# priority classes
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityClasses:
+    def test_label_classifies(self):
+        plane, _ = _plane()
+        assert plane.classify(make_pod("p", labels=HIGH)) == ("high", 0)
+        assert plane.classify(make_pod("p", labels=BATCH)) == ("batch", 2)
+
+    def test_unlabeled_takes_default(self):
+        plane, _ = _plane()
+        assert plane.classify(make_pod("p")) == ("normal", 1)
+
+    def test_unknown_class_takes_default(self):
+        plane, _ = _plane()
+        pod = make_pod(
+            "p", labels={shared_labels.PRIORITY_LABEL: "platinum"}
+        )
+        assert plane.classify(pod) == ("normal", 1)
+
+    def test_malformed_ladders_rejected(self):
+        with pytest.raises(ValueError):
+            AdmissionPlane(classes=("a", "a"))
+        with pytest.raises(ValueError):
+            AdmissionPlane(classes=())
+        with pytest.raises(ValueError):
+            AdmissionPlane(classes=("a", "b"), default_class="c")
+
+    def test_gang_class_remembered_for_the_census(self):
+        plane, _ = _plane()
+        pod = make_gang_pod("g-0", "gang-b", 4, labels=dict(BATCH))
+        _consult(plane, pod, ["n1"])
+        assert plane.class_of_gang("default/gang-b") == "batch"
+        assert plane.rank_of_gang("default/gang-b") == 2
+        # a gang the plane never saw defaults, like an unlabeled pod
+        assert plane.class_of_gang("never-seen") == "normal"
+
+
+# ---------------------------------------------------------------------------
+# the bounded queue
+# ---------------------------------------------------------------------------
+
+
+class TestBoundedQueue:
+    def test_capacity_miss_enqueues_in_class_order(self):
+        plane, _ = _plane()
+        assert _miss(plane, make_pod("b1", labels=BATCH), ["n1"]) is None
+        assert _miss(plane, make_pod("h1", labels=HIGH), ["n1"]) is None
+        snap = plane.snapshot()
+        assert snap["depth"] == 2
+        # (class, arrival): the later-arriving high pod heads the queue
+        assert [e["pod"] for e in snap["queue"]] == [
+            "default/h1",
+            "default/b1",
+        ]
+        assert _counter(
+            plane, "pas_admission_queued_total", **{"class": "high"}
+        ) == 1.0
+
+    def test_lower_class_held_behind_queued_higher_class(self):
+        plane, _ = _plane()
+        _miss(plane, make_gang_pod("h0", "g-h", 8, labels=dict(HIGH)),
+              ["n1", "n2"])
+        verdict = _consult(plane, make_pod("b1", labels=BATCH), ["n1"])
+        assert verdict is not None
+        failed, codes = verdict
+        assert failed == {"n1": blocked_reason("high", 2)}
+        assert codes == {"n1": decisions.CODE_ADMISSION_BLOCKED}
+        # the hold pinned its arrival order: it now waits in the queue
+        snap = plane.snapshot()
+        assert snap["depth"] == 2
+        assert _counter(
+            plane, "pas_admission_blocked_total", **{"class": "batch"}
+        ) == 1.0
+
+    def test_higher_class_never_blocked_by_lower(self):
+        plane, _ = _plane()
+        _miss(plane, make_pod("b1", labels=BATCH), ["n1"])
+        assert _consult(plane, make_pod("h1", labels=HIGH), ["n1"]) is None
+        assert _counter(
+            plane, "pas_admission_admitted_total", **{"class": "high"}
+        ) == 1.0
+
+    def test_overflow_sheds_worst_ranked_entry(self):
+        plane, _ = _plane(max_depth=2)
+        _miss(plane, make_pod("b1", labels=BATCH), ["n1"])
+        _miss(plane, make_pod("b2", labels=BATCH), ["n1"])
+        # a batch arrival ranks no better than the incumbents: IT sheds
+        _miss(plane, make_pod("b3", labels=BATCH), ["n1"])
+        snap = plane.snapshot()
+        assert snap["depth"] == 2
+        assert "default/b3" not in [e["pod"] for e in snap["queue"]]
+        assert any(
+            r["detail"]["event"] == "overflow_shed"
+            and r["detail"]["pod"] == "default/b3"
+            for r in _events(plane)
+        )
+        # a high arrival outranks the worst incumbent: b2 (latest
+        # arrival of the worst class) sheds and h1 takes the slot
+        _miss(plane, make_pod("h1", labels=HIGH), ["n1"])
+        snap = plane.snapshot()
+        assert [e["pod"] for e in snap["queue"]] == [
+            "default/h1",
+            "default/b1",
+        ]
+        assert _counter(
+            plane,
+            "pas_admission_rejected_total",
+            **{"class": "batch", "reason": "overflow"},
+        ) == 2.0
+
+    def test_fairness_streak_lets_the_waiting_class_through(self):
+        plane, _ = _plane(fairness_streak=2)
+        _consult(plane, make_pod("h1", labels=HIGH), ["n1"])
+        _consult(plane, make_pod("h2", labels=HIGH), ["n1"])
+        assert plane.snapshot()["streak"] == {"class": "high", "count": 2}
+        _miss(plane, make_gang_pod("h0", "g-h", 8, labels=dict(HIGH)),
+              ["n1", "n2"])
+        # the streak cap overrides the hold: batch gets one through
+        assert _consult(plane, make_pod("b1", labels=BATCH), ["n1"]) is None
+        assert any(
+            r["detail"]["event"] == "fairness"
+            and r["detail"]["pod"] == "default/b1"
+            for r in _events(plane)
+        )
+        # ...exactly one: the streak reset to (batch, 1), so the next
+        # batch pod waits its turn again
+        assert _consult(
+            plane, make_pod("b2", labels=BATCH), ["n1"]
+        ) is not None
+
+    def test_backfill_needs_spare_nodes_beyond_head_demand(self):
+        plane, _ = _plane()
+        _miss(plane, make_gang_pod("h0", "g-h", 2, "1x2",
+                                   labels=dict(HIGH)), ["n1", "n2"])
+        # 2 eligible - 2 unmet head demand < 1: admitting would eat the
+        # gang's window — hold
+        assert _consult(
+            plane, make_pod("b1", labels=BATCH), ["n1", "n2"]
+        ) is not None
+        # 3 eligible - 2 leaves one spare: backfill
+        assert _consult(
+            plane, make_pod("b2", labels=BATCH), ["n1", "n2", "n3"]
+        ) is None
+        assert _counter(
+            plane, "pas_admission_backfill_total", **{"class": "batch"}
+        ) == 1.0
+
+    def test_backfill_when_head_holds_a_reservation(self):
+        class _GangStub:
+            def gang_state(self, gang_id):
+                return "reserved"
+
+        plane, _ = _plane()
+        plane.gangs = _GangStub()
+        _miss(plane, make_gang_pod("h0", "g-h", 8, "2x4",
+                                   labels=dict(HIGH)), ["n1", "n2"])
+        # the head's demand is covered by its slice (the overlay keeps
+        # every reserved node out of this pod's eligible set), so even
+        # one spare node backfills
+        assert _consult(plane, make_pod("b1", labels=BATCH), ["n1"]) is None
+        assert _counter(
+            plane, "pas_admission_backfill_total", **{"class": "batch"}
+        ) == 1.0
+
+    def test_starvation_counts_past_the_consult_threshold(self):
+        plane, _ = _plane(starve_consults=2)
+        pod = make_pod("b1", labels=BATCH)
+        _miss(plane, pod, ["n1"])  # enqueue
+        _miss(plane, pod, ["n1"])  # consult 1: aging, not yet starved
+        assert _counter(
+            plane, "pas_admission_starved_total", **{"class": "batch"}
+        ) == 0.0
+        _miss(plane, pod, ["n1"])  # consult 2: at the threshold
+        _miss(plane, pod, ["n1"])  # consult 3: every one counts now
+        assert _counter(
+            plane, "pas_admission_starved_total", **{"class": "batch"}
+        ) == 2.0
+
+    def test_terminal_failure_drops_the_queued_entry(self):
+        plane, _ = _plane()
+        pod = make_pod("b1", labels=BATCH)
+        _miss(plane, pod, ["n1"])
+        assert plane.snapshot()["depth"] == 1
+        _miss(plane, pod, ["n1"], code=decisions.CODE_RULE_VIOLATION)
+        assert plane.snapshot()["depth"] == 0
+        assert _counter(
+            plane,
+            "pas_admission_rejected_total",
+            **{"class": "batch", "reason": "terminal"},
+        ) == 1.0
+        assert any(
+            r["detail"]["event"] == "terminal" for r in _events(plane)
+        )
+
+    def test_terminal_failure_never_enqueues(self):
+        plane, _ = _plane()
+        _miss(plane, make_pod("b1", labels=BATCH), ["n1"],
+              code=decisions.CODE_FAIL_CLOSED)
+        assert plane.snapshot()["depth"] == 0
+
+    def test_bind_feedback_clears_the_entry(self):
+        plane, _ = _plane()
+        _miss(plane, make_pod("b1", labels=BATCH), ["n1"])
+        plane.observe_bind("default", "b1")
+        assert plane.snapshot()["depth"] == 0
+        assert plane.counters.get(
+            "pas_admission_queue_depth",
+            kind="gauge",
+            labels={"class": "batch"},
+        ) == 0.0
+
+    def test_snapshot_carries_cumulative_counters(self):
+        plane, _ = _plane()
+        _miss(plane, make_pod("b1", labels=BATCH), ["n1"])
+        _consult(plane, make_pod("h1", labels=HIGH), ["n1"])
+        counters = plane.snapshot()["counters"]
+        assert counters["queued"] == 1.0
+        assert counters["admitted"] == 1.0
+        assert counters["preemptions"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# victim selection + atomic execution
+# ---------------------------------------------------------------------------
+
+
+def _preemption_world(
+    max_victims=8, leader=None, actuator_mode=MODE_ACTIVE, retry_s=0.0
+):
+    """A 4x4 mesh with a real tracker + fake kube behind the planner."""
+    kube = FakeKubeClient()
+    kube.add_mesh(4, 4)
+    clock = _Clock()
+    tracker = GangTracker(
+        nodes_provider=kube.list_nodes,
+        pods_provider=kube.list_pods,
+        ttl_s=600.0,
+        clock=clock.now,
+    )
+    plane, _ = _plane(clock=clock.now)
+    plane.gangs = tracker
+    actuator = SafeActuator(
+        kube,
+        mode=actuator_mode,
+        rate_per_s=1000.0,
+        burst=100,
+        cooldown_s=0.0,
+        clock=clock.now,
+    )
+    planner = PreemptionPlanner(
+        plane,
+        tracker,
+        actuator,
+        max_victims=max_victims,
+        retry_s=retry_s,
+        leadership=leader,
+        clock=clock.now,
+    )
+    plane.preemption = planner
+    return kube, tracker, plane, planner, clock
+
+
+def _place_gang(kube, tracker, plane, group, size, topo, klass, rows):
+    """Reserve + bind one gang onto ``rows`` of the mesh, landing a
+    Running pod per member (the kube-scheduler's side of Bind)."""
+    labels = {shared_labels.PRIORITY_LABEL: klass}
+    candidates = [f"mesh-{r}-{c}" for r in rows for c in range(4)]
+    for i in range(size):
+        pod = make_gang_pod(
+            f"{group}-{i}", group, size, topo, labels=dict(labels)
+        )
+        _consult(plane, pod, candidates)  # the plane learns the class
+        failed, _codes = tracker.filter_overlay(pod, list(candidates))
+        passing = [n for n in candidates if n not in failed]
+        assert passing, f"{group} member {i} found no slice"
+        taken = {p.spec_node_name for p in kube.list_pods()}
+        node = next(n for n in passing if n not in taken)
+        tracker.observe_bind(pod.namespace, pod.name, node)
+        kube.add_pod(
+            make_pod(
+                pod.name,
+                labels=dict(pod.get_labels()),
+                node_name=node,
+                phase="Running",
+            )
+        )
+    assert tracker.gang_state(f"default/{group}") == "bound"
+
+
+def _target_pod(name="t-0", group="g-target"):
+    return make_gang_pod(name, group, 8, "2x4", labels=dict(HIGH))
+
+
+class TestVictimSelection:
+    def test_never_preempts_equal_or_higher_class(self):
+        kube, tracker, plane, planner, _ = _preemption_world()
+        _place_gang(kube, tracker, plane, "high-a", 8, "2x4", "high",
+                    (0, 1))
+        _place_gang(kube, tracker, plane, "high-b", 8, "2x4", "high",
+                    (2, 3))
+        assert planner.maybe_preempt(_target_pod(), "high", 0) is False
+        assert kube.evictions == []
+        assert _counter(
+            plane, "pas_preemption_plans_total", outcome="infeasible"
+        ) == 1.0
+        assert _counter(plane, "pas_preemption_reservations_total") == 0.0
+
+    def test_whole_gang_evicted_and_slice_reserved_while_draining(self):
+        kube, tracker, plane, planner, _ = _preemption_world()
+        _place_gang(kube, tracker, plane, "high-a", 8, "2x4", "high",
+                    (0, 1))
+        _place_gang(kube, tracker, plane, "batch-a", 8, "2x4", "batch",
+                    (2, 3))
+        pod = _target_pod()
+        assert planner.maybe_preempt(pod, "high", 0) is True
+        # whole gang, nothing else: all 8 batch members, zero high
+        evicted = sorted(e["pod"] for e in kube.evictions)
+        assert evicted == sorted(f"batch-a-{i}" for i in range(8))
+        # reservation-while-draining: the victim keeps DRAINING state
+        # (its nodes stay accounted) and the target already holds the
+        # slice before a single victim pod is actually gone
+        assert tracker.gang_state("default/batch-a") == "draining"
+        assert tracker.gang_state("default/g-target") == "reserved"
+        assert _counter(plane, "pas_preemption_reservations_total") == 1.0
+        # provenance: the record names target, victims, and the slice
+        records = _events(plane, verb="preemption")
+        assert len(records) == 1
+        detail = records[0]["detail"]
+        assert detail["target_gang"] == "default/g-target"
+        assert [v["class"] for v in detail["victims"]] == ["batch"]
+        assert len(detail["reserved_nodes"]) == 8
+
+    def test_survivor_gang_untouched(self):
+        kube, tracker, plane, planner, _ = _preemption_world()
+        _place_gang(kube, tracker, plane, "high-a", 8, "2x4", "high",
+                    (0, 1))
+        _place_gang(kube, tracker, plane, "batch-a", 8, "2x4", "batch",
+                    (2, 3))
+        planner.maybe_preempt(_target_pod(), "high", 0)
+        assert tracker.gang_state("default/high-a") == "bound"
+        survivors = [
+            p.name
+            for p in kube.list_pods()
+            if p.name.startswith("high-a-") and p.phase == "Running"
+        ]
+        assert len(survivors) == 8
+
+    def test_refusal_aborts_with_no_reservation(self):
+        """Fenced-refusal containment: a refused actuation (here the
+        mode gate, the same pre-flight that fencing/rate/cooldown
+        refusals share) aborts the plan and creates NO reservation —
+        nothing is admitted on the back of a half-executed plan."""
+        kube, tracker, plane, planner, _ = _preemption_world(
+            actuator_mode=MODE_DRY_RUN
+        )
+        _place_gang(kube, tracker, plane, "batch-a", 8, "2x4", "batch",
+                    (0, 1))
+        assert planner.maybe_preempt(_target_pod(), "high", 0) is False
+        assert kube.evictions == []
+        assert tracker.gang_state("default/batch-a") == "bound"
+        assert tracker.gang_state("default/g-target") not in (
+            "reserved", "bound", "draining",
+        )
+        assert _counter(plane, "pas_preemption_reservations_total") == 0.0
+        assert _counter(
+            plane,
+            "pas_preemption_plans_total",
+            outcome="actuation_refused",
+        ) == 1.0
+        assert _events(plane, verb="preemption") == []
+
+    def test_bounded_appetite_refuses_oversized_plans(self):
+        kube, tracker, plane, planner, _ = _preemption_world(max_victims=4)
+        _place_gang(kube, tracker, plane, "batch-a", 8, "2x4", "batch",
+                    (0, 1))
+        _place_gang(kube, tracker, plane, "batch-b", 8, "2x4", "batch",
+                    (2, 3))
+        assert planner.maybe_preempt(_target_pod(), "high", 0) is False
+        assert kube.evictions == []
+        assert _counter(
+            plane, "pas_preemption_plans_total", outcome="over_budget"
+        ) == 1.0
+
+    def test_standby_never_plans(self):
+        kube, tracker, plane, planner, _ = _preemption_world(
+            leader=_Leader(False)
+        )
+        _place_gang(kube, tracker, plane, "batch-a", 8, "2x4", "batch",
+                    (0, 1))
+        assert planner.maybe_preempt(_target_pod(), "high", 0) is False
+        assert kube.evictions == []
+        assert _counter(
+            plane, "pas_preemption_plans_total", outcome="not_leader"
+        ) == 1.0
+
+    def test_retry_throttle_bounds_replanning(self):
+        kube, tracker, plane, planner, clock = _preemption_world(
+            retry_s=30.0
+        )
+        _place_gang(kube, tracker, plane, "high-a", 8, "2x4", "high",
+                    (0, 1))
+        _place_gang(kube, tracker, plane, "high-b", 8, "2x4", "high",
+                    (2, 3))
+        planner.maybe_preempt(_target_pod(), "high", 0)
+        planner.maybe_preempt(_target_pod(), "high", 0)  # throttled
+        assert _counter(
+            plane, "pas_preemption_plans_total", outcome="infeasible"
+        ) == 1.0
+        clock.advance(31.0)
+        planner.maybe_preempt(_target_pod(), "high", 0)
+        assert _counter(
+            plane, "pas_preemption_plans_total", outcome="infeasible"
+        ) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# flag wiring
+# ---------------------------------------------------------------------------
+
+
+class TestFlagWiring:
+    def _tas_args(self, argv):
+        from platform_aware_scheduling_tpu.cmd import common, tas
+
+        parser = tas.build_arg_parser()
+        args = parser.parse_args(argv)
+        common.validate_admission_flags(parser, args)
+        return args
+
+    def test_preemption_requires_admission(self, capsys):
+        with pytest.raises(SystemExit) as exc:
+            self._tas_args(["--preemption", "on", "--gang", "on"])
+        assert exc.value.code == 2
+        assert "--admission=on" in capsys.readouterr().err
+
+    def test_preemption_requires_gang(self, capsys):
+        with pytest.raises(SystemExit) as exc:
+            self._tas_args(["--admission", "on", "--preemption", "on"])
+        assert exc.value.code == 2
+        assert "--gang=on" in capsys.readouterr().err
+
+    def test_full_stack_validates(self):
+        args = self._tas_args(
+            ["--admission", "on", "--preemption", "on", "--gang", "on"]
+        )
+        assert args.preemptionMaxVictims == 8
+
+    def test_malformed_ladder_exits(self):
+        with pytest.raises(SystemExit) as exc:
+            self._tas_args(
+                ["--admission", "on", "--admissionClasses", "high,high"]
+            )
+        assert exc.value.code == 2
+        with pytest.raises(SystemExit) as exc:
+            self._tas_args(
+                ["--admission", "on", "--admissionDefaultClass", "gold"]
+            )
+        assert exc.value.code == 2
+
+    def test_gas_offers_no_preemption_flag(self):
+        from platform_aware_scheduling_tpu.cmd import gas
+
+        with pytest.raises(SystemExit) as exc:
+            gas.build_arg_parser().parse_args(["--preemption", "on"])
+        assert exc.value.code == 2
+        # ...but the queue-only admission surface is there
+        args = gas.build_arg_parser().parse_args(["--admission", "on"])
+        assert args.admission == "on"
+
+    def test_off_builds_nothing(self):
+        from platform_aware_scheduling_tpu.cmd import common, tas
+
+        args = tas.build_arg_parser().parse_args([])
+        assert args.admission == "off"
+        ext, _kube, _names = build_mesh_service(2, 2, gang=False)
+        assert common.build_admission_plane(args, ext) is None
+        assert ext.admission is None
+        assert "pas_admission_" not in ext.metrics_text()
+
+    def test_on_builds_plane_and_planner(self):
+        from platform_aware_scheduling_tpu.cmd import common, tas
+
+        args = tas.build_arg_parser().parse_args(
+            ["--admission", "on", "--preemption", "on", "--gang", "on",
+             "--admissionDepth", "7"]
+        )
+        ext, kube, _names = build_mesh_service(2, 2, gang=True)
+        plane = common.build_admission_plane(
+            args, ext, kube_client=kube, gang_tracker=ext.gangs
+        )
+        assert ext.admission is plane
+        assert plane.classes == ("high", "normal", "batch")
+        assert plane.max_depth == 7
+        assert plane.gangs is ext.gangs
+        assert plane.preemption is not None
+        assert plane.preemption.actuator.mode == MODE_ACTIVE
+        # the planner's actuator must NOT auto-release whole gangs (that
+        # would fight reservation-while-draining)
+        assert plane.preemption.actuator.gang_tracker is None
+
+    def test_queue_only_without_tracker(self):
+        from platform_aware_scheduling_tpu.cmd import common, gas
+
+        args = gas.build_arg_parser().parse_args(["--admission", "on"])
+        ext, kube, _names = build_mesh_service(2, 2, gang=False)
+        plane = common.build_admission_plane(args, ext, kube_client=kube)
+        assert plane is not None
+        assert plane.preemption is None
+
+
+# ---------------------------------------------------------------------------
+# the off path
+# ---------------------------------------------------------------------------
+
+
+class TestOffPathPins:
+    def test_quiet_plane_serves_byte_identical(self):
+        """The plane only ever substitutes one failure for another —
+        with no contention (nothing queued) every verb response is
+        byte-identical to a build without the plane."""
+        ext_off, _k1, names = build_mesh_service(4, 4, gang=True)
+        ext_on, _k2, _n2 = build_mesh_service(4, 4, gang=True)
+        ext_on.admission, _ = _plane()
+        ext_on.admission.gangs = ext_on.gangs
+        single = {
+            "metadata": {
+                "name": "solo",
+                "namespace": "default",
+                "labels": {
+                    "telemetry-policy": "gang-pol",
+                    shared_labels.PRIORITY_LABEL: "high",
+                },
+            }
+        }
+        gang_member = {
+            "metadata": {
+                "name": "g-0",
+                "namespace": "default",
+                "labels": {
+                    "telemetry-policy": "gang-pol",
+                    shared_labels.GROUP_LABEL: "g-a",
+                    shared_labels.GANG_SIZE_LABEL: "8",
+                    shared_labels.GANG_TOPOLOGY_LABEL: "2x4",
+                    shared_labels.PRIORITY_LABEL: "high",
+                },
+            }
+        }
+        for pod_obj in (single, gang_member):
+            for verb in ("filter", "prioritize"):
+                body = {"Pod": pod_obj, "NodeNames": list(names)}
+                off = _post(ext_off, verb, body)
+                on = _post(ext_on, verb, body)
+                assert off.status == on.status
+                assert off.body == on.body
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_debug_endpoint_404_off_200_on(self, serving):
+        ext, _kube, _names = build_mesh_service(2, 2, gang=False)
+        server = (
+            start_async(ext) if serving == "async" else start_threaded(ext)
+        )
+        try:
+            status, _h, body = get_request(server.port, "/debug/admission")
+            assert status == 404
+            status, _h, metrics = get_request(server.port, "/metrics")
+            assert b"pas_admission_" not in metrics
+            # wire the plane: same server, the route comes alive
+            ext.admission, _ = _plane()
+            _miss(ext.admission, make_pod("b1", labels=BATCH), ["n1"])
+            status, _h, body = get_request(server.port, "/debug/admission")
+            assert status == 200
+            snap = json.loads(body)
+            assert snap["enabled"] is True
+            assert snap["depth"] == 1
+            assert snap["counters"]["queued"] == 1.0
+            status, _h, metrics = get_request(server.port, "/metrics")
+            assert b"pas_admission_queued_total" in metrics
+            assert b"pas_admission_queue_depth" in metrics
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# torus wraparound feasibility (ops/topology satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestTorusFeasibility:
+    def test_device_host_parity_byte_exact(self):
+        rng = np.random.default_rng(16)
+        for _ in range(20):
+            m, n = rng.integers(2, 9, 2)
+            free = rng.random((m, n)) < 0.6
+            for h, w in [(1, 1), (2, 2), (2, 3), (int(m), int(n))]:
+                device = topology.torus_feasibility_device(free, h, w)
+                host = topology.torus_feasibility_host(free, h, w)
+                for d_arr, h_arr in zip(device, host):
+                    assert d_arr.dtype == h_arr.dtype
+                    assert np.array_equal(d_arr, h_arr)
+
+    def test_wraparound_window_feasible_only_on_the_torus(self):
+        """Free columns 0 and 3 of a 4x4: two disconnected planar
+        strips, but one contiguous 4x2 ring window across the seam."""
+        free = np.zeros((4, 4), bool)
+        free[:, 0] = True
+        free[:, 3] = True
+        planar = topology.topology_feasibility_host(free, 4, 2)
+        assert not planar.anchor_ok.any()
+        torus = topology.torus_feasibility_host(free, 4, 2)
+        assert torus.anchor_ok[0, 3]
+        cells = topology.torus_slice_cells(0, 3, 4, 2, 4, 4)
+        assert all(free[i, j] for i, j in cells)
+        assert len(set(cells)) == 8
+
+    def test_window_larger_than_torus_self_overlaps(self):
+        for fn in (
+            topology.torus_feasibility_host,
+            topology.torus_feasibility_device,
+        ):
+            feas = fn(np.ones((2, 2), bool), 3, 1)
+            assert not feas.anchor_ok.any()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the twin scenarios over real sockets on BOTH front-ends
+# ---------------------------------------------------------------------------
+
+
+def _run_scenario(scenario, serving):
+    """Drive one admission scenario tick by tick with a live front-end
+    mounted; returns the /debug/admission snapshot and /metrics text
+    read over the wire after the last tick."""
+    scale = {"period_s": 5.0}
+    twin = scenario.build(scale)
+    server = twin.serve(serving)
+    try:
+        for t in range(scenario.ticks(scale)):
+            scenario.apply(twin, t)
+            twin.tick()
+        failures = [c for c in scenario.checks(twin) if not c["ok"]]
+        assert not failures, failures
+        status, _h, body = get_request(server.port, "/debug/admission")
+        assert status == 200
+        status, _h, metrics = get_request(server.port, "/metrics")
+        assert status == 200
+        return json.loads(body), metrics.decode()
+    finally:
+        server.shutdown()
+        twin.close()
+
+
+class TestAdmissionScenarios:
+    """ISSUE 16 acceptance: the three scenarios green over a real
+    socket on both front-ends, with the wire's /debug/admission and
+    /metrics agreeing with the in-process verdicts."""
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_priority_inversion_held_at_the_gate(self, serving):
+        snap, metrics = _run_scenario(tw.PriorityInversionStorm(), serving)
+        assert snap["counters"]["blocked"] > 0
+        assert snap["counters"]["preemptions"] == 0
+        assert snap["depth"] == 0  # everyone landed in the end
+        assert "pas_admission_blocked_total" in metrics
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_backfill_without_starvation(self, serving):
+        snap, metrics = _run_scenario(tw.BackfillStarvation(), serving)
+        assert snap["counters"]["backfills"] > 0
+        assert snap["counters"]["starved"] == 0
+        assert "pas_admission_backfill_total" in metrics
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_preemption_cascade_admits_high_gang(self, serving):
+        snap, metrics = _run_scenario(
+            tw.PreemptionCascade(preemption=True), serving
+        )
+        assert snap["counters"]["preemptions"] == 1
+        assert snap["preemption"]["last_plan"]["outcome"] == "planned"
+        assert "pas_preemption_reservations_total" in metrics
+
+    @pytest.mark.parametrize("serving", ["threaded", "async"])
+    def test_preemption_off_starves_without_evicting(self, serving):
+        snap, _metrics = _run_scenario(
+            tw.PreemptionCascade(preemption=False), serving
+        )
+        assert snap["counters"]["preemptions"] == 0
+        assert snap["counters"]["starved"] > 0
+        assert snap["preemption"] is None
+
+    def test_head_to_head_verdict(self):
+        result = tw.admission_headtohead()
+        assert result["all_ok"], result
+        assert result["strictly_better"]
+        on = result["preemption_on"]
+        off = result["preemption_off"]
+        assert on["admitted"] and on["passed"] and off["passed"]
+        assert on["budget"] > off["budget"]
+        assert result["diurnal_quiet"]["ok"]

@@ -686,7 +686,7 @@ class TestDebugIndexCompleteness:
         "/debug/decisions", "/debug/rebalance", "/debug/gangs",
         "/debug/forecast", "/debug/leader", "/debug/slo",
         "/debug/wire", "/debug/profile", "/debug/record",
-        "/debug/whatif", "/debug/control",
+        "/debug/whatif", "/debug/control", "/debug/admission",
     }
 
     def test_index_names_every_debug_route(self):
