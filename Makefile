@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record test-control test-admission bench-control bench-admission bench-replay test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record test-control test-admission test-explain bench-control bench-admission bench-replay test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
 
 all: test
 
@@ -142,6 +142,13 @@ bench-control:
 # acceptance scenarios over real sockets on both front-ends
 test-admission:
 	python -m pytest tests/test_admission.py -q -m 'not slow'
+
+# causal event spine + /debug/explain suite (docs/observability.md
+# "Explain plane"): journal bounds/ordering under writer torture,
+# one-hop correlation walks, the /debug/explain wire contract on both
+# front-ends, TraceBuffer top-K under concurrent completions
+test-explain:
+	python -m pytest tests/test_explain.py -q
 
 # the admission plane's head-to-head alone: preemption cascade ON vs
 # OFF through the real verbs + the quiet-diurnal null + gate overhead

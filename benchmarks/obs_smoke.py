@@ -15,6 +15,7 @@ from __future__ import annotations
 import http.client
 import json
 import sys
+import time
 
 from benchmarks.http_load import http_get as _get
 
@@ -322,11 +323,58 @@ def check_front_end(serving: str) -> str:
             f"record events={lines[0]['events']}, "
             f"whatif slos={len(projection['verdicts'])}"
         )
+        # causal event spine: /debug/explain joins the story the verbs
+        # above just wrote — 404 while disabled (--events=off), 400
+        # without a filter, then the correlated chain + narrative for
+        # the bench pod the prioritize calls acted on
+        from platform_aware_scheduling_tpu.utils.events import JOURNAL
+
+        assert "/debug/explain" in paths, f"{serving}: index missing explain"
+        JOURNAL.configure(enabled=False)
+        try:
+            status, _payload = _get(port, "/debug/explain?pod=x")
+            assert status == 404, (
+                f"{serving}: /debug/explain must 404 while off -> {status}"
+            )
+        finally:
+            JOURNAL.configure(enabled=True)
+        status, _payload = _get(port, "/debug/explain")
+        assert status == 400, (
+            f"{serving}: filterless /debug/explain must 400 -> {status}"
+        )
+        status, _ = _post(port, "/scheduler/prioritize", body)
+        assert status == 200
+        # the wire event lands when the span does — just after the
+        # response bytes; poll briefly rather than racing it
+        deadline = time.time() + 5.0
+        while True:
+            status, payload = _get(
+                port, "/debug/explain?pod=default/bench-pod-0"
+            )
+            assert status == 200, f"{serving}: /debug/explain -> {status}"
+            explain = json.loads(payload)
+            if any(e["kind"] == "wire" for e in explain["events"]):
+                break
+            assert time.time() < deadline, (
+                f"{serving}: no wire event for the pod: {explain}"
+            )
+            time.sleep(0.005)
+        assert explain["narrative"], explain
+        explain_note = f"explain chain={len(explain['events'])}"
+        # OpenMetrics exemplars: the verbs above observed with their
+        # trace ids, so the latency histogram buckets must carry
+        # ``# {trace_id="..."}`` annotations — and still parse (the
+        # families checks above already round-tripped the exposition)
+        status, payload = _get(port, "/metrics")
+        assert status == 200
+        assert ' # {trace_id="' in payload.decode(), (
+            f"{serving}: no exemplar annotations on /metrics"
+        )
         conditions = [c["name"] for c in readyz["conditions"]]
         return (
             f"obs-smoke {serving}: OK (conditions={conditions}, "
             f"{len(families)} metric families, {control_note}, "
-            f"{wire_note}, {record_note})"
+            f"{wire_note}, {record_note}, {explain_note})"
         )
     finally:
         server.shutdown()
@@ -396,6 +444,74 @@ def check_scrape_under_load(
         twin.close()
 
 
+def check_publication_overhead(
+    num_nodes: int = 256, batches: int = 10, per_batch: int = 200
+) -> str:
+    """Hermetic spine cost on the warm Filter path: mean per-request
+    microseconds with the journal enabled vs disabled — interleaved
+    batches in one process, median of batch means per side, gc fenced
+    (the record_inprocess_overhead methodology behind the flight
+    recorder's +4.0/+7.8 us figures).  Every request carries a real
+    span, as on a live front-end, so the enabled side pays exactly the
+    publication path: one short lock, one deque append, one counter
+    bump.  Budget: <=5 us per warm verb (docs/observability.md)."""
+    import gc
+
+    from benchmarks.http_load import build_extender, make_bodies
+    from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+    from platform_aware_scheduling_tpu.utils import trace
+    from platform_aware_scheduling_tpu.utils.events import JOURNAL
+
+    ext, names = build_extender(num_nodes, device=True)
+    body = make_bodies(names, "nodenames", count=1)[0]
+
+    def call():
+        request = HTTPRequest(
+            method="POST",
+            path="/scheduler/filter",
+            headers={"Content-Type": "application/json"},
+            body=body,
+        )
+        request.span = trace.Span("POST /scheduler/filter", "smoke-rid")
+        response = ext.filter(request)
+        trace.TRACES.add(request.span.finish(response.status))
+        return response
+
+    for _ in range(5):  # warm the kernels and the filter caches
+        assert call().status == 200
+    means = {"on": [], "off": []}
+    JOURNAL.reset()
+    try:
+        for batch in range(batches):
+            label = "on" if batch % 2 == 0 else "off"
+            JOURNAL.configure(enabled=(label == "on"))
+            gc.collect()
+            gc.disable()
+            try:
+                t0 = time.perf_counter()
+                for _ in range(per_batch):
+                    call()
+                means[label].append(
+                    (time.perf_counter() - t0) / per_batch * 1e6
+                )
+            finally:
+                gc.enable()
+    finally:
+        JOURNAL.configure(enabled=True)
+        JOURNAL.reset()
+    on = sorted(means["on"])[len(means["on"]) // 2]
+    off = sorted(means["off"])[len(means["off"]) // 2]
+    delta = on - off
+    assert delta <= 5.0, (
+        f"event publication +{delta:.1f} us on warm Filter exceeds the "
+        f"5 us budget (on {on:.1f} us, off {off:.1f} us)"
+    )
+    return (
+        f"obs-smoke explain-overhead: OK (warm filter {off:.1f} us -> "
+        f"{on:.1f} us, publication +{delta:.1f} us <= 5 us budget)"
+    )
+
+
 def main() -> int:
     for serving in ("threaded", "async"):
         try:
@@ -403,11 +519,12 @@ def main() -> int:
         except AssertionError as exc:
             print(f"obs-smoke FAILED: {exc}", file=sys.stderr)
             return 1
-    try:
-        print(check_scrape_under_load(), flush=True)
-    except AssertionError as exc:
-        print(f"obs-smoke FAILED: {exc}", file=sys.stderr)
-        return 1
+    for check in (check_scrape_under_load, check_publication_overhead):
+        try:
+            print(check(), flush=True)
+        except AssertionError as exc:
+            print(f"obs-smoke FAILED: {exc}", file=sys.stderr)
+            return 1
     return 0
 
 

@@ -44,7 +44,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from platform_aware_scheduling_tpu.gang.group import GangSpec
 from platform_aware_scheduling_tpu.kube.objects import Pod
-from platform_aware_scheduling_tpu.utils import decisions, klog
+from platform_aware_scheduling_tpu.utils import decisions, events, klog
 from platform_aware_scheduling_tpu.utils import labels as shared_labels
 from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 
@@ -219,11 +219,14 @@ class AdmissionPlane:
         candidates: List[str],
         failed: Dict[str, str],
         codes: Dict[str, int],
+        request_id: str = "",
     ) -> Optional[Tuple[Dict[str, str], Dict[str, int]]]:
         """One Filter decision through the gate (module doc).  Returns
         None when the verdict stands, or a replacement ``(failed,
         codes)`` pair failing every candidate when the pod is held.
-        Never turns a failure into an admit."""
+        Never turns a failure into an admit.  ``request_id`` is the
+        consulting Filter span's id — carried into provenance records
+        and causal-spine events so the decision joins to its span."""
         spec = GangSpec.from_pod(pod)
         klass, rank = self.classify(pod)
         self._note_gang_class(
@@ -233,9 +236,12 @@ class AdmissionPlane:
         size = spec.size if spec is not None else 1
         eligible = [name for name in candidates if name not in failed]
         if eligible:
-            return self._gate(pod, pod_key, klass, rank, size, eligible)
+            return self._gate(
+                pod, pod_key, klass, rank, size, eligible, request_id
+            )
         return self._capacity_miss(
-            pod, pod_key, spec, klass, rank, size, candidates, codes
+            pod, pod_key, spec, klass, rank, size, candidates, codes,
+            request_id,
         )
 
     def _gate(
@@ -246,6 +252,7 @@ class AdmissionPlane:
         rank: int,
         size: int,
         eligible: List[str],
+        request_id: str = "",
     ) -> Optional[Tuple[Dict[str, str], Dict[str, int]]]:
         """Filter passed: may the pod take the capacity now?"""
         now = self._clock()
@@ -258,7 +265,9 @@ class AdmissionPlane:
                 if e.pod_key != pod_key and e.order() < my_order
             ]
             if not blockers:
-                self._admit_locked(pod_key, klass, event=None)
+                self._admit_locked(
+                    pod_key, klass, event=None, request_id=request_id
+                )
                 return None
             # fairness: the streak class has monopolized admissions while
             # other classes wait — let this one through and reset
@@ -267,7 +276,9 @@ class AdmissionPlane:
                 and self._streak_class != klass
                 and self._streak >= self.fairness_streak
             ):
-                self._admit_locked(pod_key, klass, event="fairness")
+                self._admit_locked(
+                    pod_key, klass, event="fairness", request_id=request_id
+                )
                 return None
             # backfill: admitting this pod must leave the head waiter's
             # demand covered — either the head already holds its slice
@@ -280,17 +291,35 @@ class AdmissionPlane:
                 if state in ("reserved", "bound", "draining"):
                     head_unmet = 0
             if len(eligible) - head_unmet >= size:
-                self._admit_locked(pod_key, klass, event="backfill")
+                self._admit_locked(
+                    pod_key, klass, event="backfill", request_id=request_id
+                )
                 return None
             self.counters.inc(
                 "pas_admission_blocked_total", labels={"class": klass}
             )
-            if entry is None:
+            newly_queued = entry is None
+            if newly_queued:
                 # it must wait its turn: enqueue so its arrival order is
                 # pinned from THIS consult, not a later retry
                 self._enqueue_locked(pod, pod_key, klass, rank, size, now)
             depth = len(self._entries)
             head_class = head.klass
+        if newly_queued:
+            events.JOURNAL.publish(
+                "admission",
+                "enqueue",
+                request_id=request_id,
+                pod=pod_key,
+                data={"class": klass, "depth": depth},
+            )
+        events.JOURNAL.publish(
+            "admission",
+            "blocked",
+            request_id=request_id,
+            pod=pod_key,
+            data={"class": klass, "head_class": head_class, "depth": depth},
+        )
         failed = {
             name: blocked_reason(head_class, depth) for name in eligible
         }
@@ -309,6 +338,7 @@ class AdmissionPlane:
         size: int,
         candidates: List[str],
         codes: Dict[str, int],
+        request_id: str = "",
     ) -> None:
         """Filter failed everywhere: enqueue if (and only if) every
         reason is capacity-class."""
@@ -317,6 +347,8 @@ class AdmissionPlane:
             reason_counts[code] = reason_counts.get(code, 0) + 1
         queueable = candidates and decisions.queueable_counts(reason_counts)
         arm_preemption = False
+        starved = False
+        gang = spec.gang_id if spec is not None else ""
         with self._lock:
             entry = self._entries.get(pod_key)
             if not queueable:
@@ -334,6 +366,7 @@ class AdmissionPlane:
                         "pod": pod_key,
                         "event": "terminal",
                         "class": entry.klass,
+                        "request_id": request_id,
                     }
                 else:
                     detail = None
@@ -346,6 +379,7 @@ class AdmissionPlane:
                         "pas_admission_starved_total",
                         labels={"class": klass},
                     )
+                    starved = True
                 arm_preemption = (
                     spec is not None and self.preemption is not None
                 )
@@ -363,6 +397,7 @@ class AdmissionPlane:
                         "pod": pod_key,
                         "event": "overflow_shed",
                         "class": klass,
+                        "request_id": request_id,
                     }
                 else:
                     self._enqueue_locked(
@@ -376,15 +411,40 @@ class AdmissionPlane:
                         "event": "enqueue",
                         "class": klass,
                         "depth": len(self._entries),
+                        "request_id": request_id,
                     }
                     if isinstance(shed, _Entry):
                         detail["shed"] = shed.pod_key
-        if detail is not None and self.decision_log is not None:
-            self.decision_log.record_admission(detail)
+        if starved:
+            events.JOURNAL.publish(
+                "admission",
+                "starved",
+                request_id=request_id,
+                pod=pod_key,
+                gang=gang,
+                data={"class": klass},
+            )
+        if detail is not None:
+            if self.decision_log is not None:
+                self.decision_log.record_admission(detail)
+            events.JOURNAL.publish(
+                "admission",
+                str(detail["event"]),
+                request_id=request_id,
+                pod=pod_key,
+                gang=gang,
+                data={
+                    k: v
+                    for k, v in detail.items()
+                    if k not in ("pod", "event", "request_id")
+                },
+            )
         if arm_preemption:
             # planning runs OUTSIDE the plane lock: it walks the gang
             # tracker and may call the cluster through the actuator
-            self.preemption.maybe_preempt(pod, klass, rank)
+            self.preemption.maybe_preempt(
+                pod, klass, rank, request_id=request_id
+            )
         return None
 
     # -- queue internals (under the lock) --------------------------------------
@@ -440,7 +500,11 @@ class AdmissionPlane:
         return worst
 
     def _admit_locked(
-        self, pod_key: str, klass: str, event: Optional[str]
+        self,
+        pod_key: str,
+        klass: str,
+        event: Optional[str],
+        request_id: str = "",
     ) -> None:
         entry = self._entries.pop(pod_key, None)
         if entry is not None:
@@ -459,8 +523,24 @@ class AdmissionPlane:
             self._streak = 1
         if event is not None and self.decision_log is not None:
             self.decision_log.record_admission(
-                {"pod": pod_key, "event": event, "class": klass}
+                {
+                    "pod": pod_key,
+                    "event": event,
+                    "class": klass,
+                    "request_id": request_id,
+                }
             )
+        # the journal publish is one short lock + a deque append — the
+        # same weight as the record_admission above, safe under the
+        # plane lock (the journal never calls back into the plane)
+        events.JOURNAL.publish(
+            "admission",
+            event or "admit",
+            request_id=request_id,
+            pod=pod_key,
+            gang=entry.gang_id if entry is not None and entry.gang_id else "",
+            data={"class": klass, "waited": entry is not None},
+        )
 
     def _publish_depth_locked(self) -> None:
         depths = {name: 0 for name in self.classes}

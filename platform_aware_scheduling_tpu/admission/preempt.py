@@ -43,7 +43,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from platform_aware_scheduling_tpu.gang.group import GangSpec
 from platform_aware_scheduling_tpu.kube.objects import Pod
 from platform_aware_scheduling_tpu.ops import topology
-from platform_aware_scheduling_tpu.utils import klog
+from platform_aware_scheduling_tpu.utils import events, klog
 
 DEFAULT_MAX_VICTIMS = 8
 #: minimum seconds between plans for the SAME target gang — the retry
@@ -88,9 +88,13 @@ class PreemptionPlanner:
 
     # -- trigger ---------------------------------------------------------------
 
-    def maybe_preempt(self, pod: Pod, klass: str, rank: int) -> bool:
+    def maybe_preempt(
+        self, pod: Pod, klass: str, rank: int, request_id: str = ""
+    ) -> bool:
         """Plan-and-execute for one starving gang pod; True when a
-        preemption fully executed and the slice is reserved."""
+        preemption fully executed and the slice is reserved.
+        ``request_id`` is the triggering Filter span's id, carried into
+        the provenance record and causal-spine events."""
         spec = GangSpec.from_pod(pod)
         if spec is None:
             return False
@@ -114,7 +118,9 @@ class PreemptionPlanner:
             self._outcome("infeasible")
             return False
         victims, nodes, anchor = plan
-        return self._execute(pod, spec, klass, victims, nodes, anchor)
+        return self._execute(
+            pod, spec, klass, victims, nodes, anchor, request_id
+        )
 
     # -- victim selection ------------------------------------------------------
 
@@ -237,6 +243,7 @@ class PreemptionPlanner:
         victims: List[Dict],
         nodes: List[str],
         anchor: Optional[tuple],
+        request_id: str = "",
     ) -> bool:
         pods_by_key = self._live_pods()
         if pods_by_key is None:
@@ -280,11 +287,13 @@ class PreemptionPlanner:
         self.counters.inc(
             "pas_preemption_victim_gangs_total", len(executed)
         )
+        target = f"{pod.namespace}/{pod.name}"
         detail = {
-            "target": f"{pod.namespace}/{pod.name}",
+            "target": target,
             "target_gang": spec.gang_id,
             "class": klass,
             "outcome": "planned",
+            "request_id": request_id,
             "victims": [
                 {
                     "gang": v["gang"],
@@ -299,6 +308,34 @@ class PreemptionPlanner:
         self._outcome("planned", detail)
         if self.plane.decision_log is not None:
             self.plane.decision_log.record_preemption(detail)
+        events.JOURNAL.publish(
+            "preemption",
+            "planned",
+            request_id=request_id,
+            pod=target,
+            gang=spec.gang_id or "",
+            data={
+                "class": klass,
+                "victims": [v["gang"] for v in detail["victims"]],
+            },
+        )
+        for victim in detail["victims"]:
+            events.JOURNAL.publish(
+                "preemption",
+                "victim evicted",
+                request_id=request_id,
+                pod=target,
+                gang=victim["gang"],
+                data={"class": victim["class"], "pods": victim["pods"]},
+            )
+        events.JOURNAL.publish(
+            "preemption",
+            "slice reserved",
+            request_id=request_id,
+            pod=target,
+            gang=spec.gang_id or "",
+            data={"nodes": len(nodes)},
+        )
         klog.v(1).info_s(
             f"preempted {len(executed)} gang(s) for {spec.gang_id} "
             f"(class={klass}); slice reserved while victims drain",

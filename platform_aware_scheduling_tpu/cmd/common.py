@@ -82,6 +82,37 @@ def add_decision_flags(parser: argparse.ArgumentParser) -> None:
                         "above pending-pods x verbs)")
 
 
+def add_event_flags(parser: argparse.ArgumentParser) -> None:
+    """Causal-event-spine flag surface shared by both mains
+    (docs/observability.md "Explain plane")."""
+    parser.add_argument("--events", default="on",
+                        choices=["off", "on"],
+                        help="causal event journal behind GET "
+                        "/debug/explain: every subsystem publishes typed "
+                        "events (wire spans, verdicts, admission holds, "
+                        "preemptions, rebalance moves, controller "
+                        "actuations, SLO flips) carrying correlation "
+                        "keys, so one query returns the ordered causal "
+                        "chain for a pod/gang/request/node.  Publication "
+                        "costs <=5 us per warm verb (pinned by "
+                        "obs_smoke); off publishes nothing and 404s the "
+                        "endpoint")
+    parser.add_argument("--eventsSize", type=int, default=4096,
+                        help="event-journal ring capacity; overflow "
+                        "drops the OLDEST event and counts it in "
+                        "pas_events_dropped_total")
+
+
+def configure_events(args) -> None:
+    """Apply the shared event flags to the process-wide EventJournal."""
+    from platform_aware_scheduling_tpu.utils import events
+
+    events.JOURNAL.configure(
+        enabled=getattr(args, "events", "on") == "on",
+        capacity=getattr(args, "eventsSize", 4096),
+    )
+
+
 def add_gang_flags(parser: argparse.ArgumentParser) -> None:
     """Gang & topology-aware scheduling flag surface (docs/gang.md).
     One helper so a future GAS adoption cannot drift from TAS."""
@@ -522,6 +553,11 @@ def build_flight_recorder(args, extender, cache=None):
         cache.on_refresh_pass.append(
             lambda: recorder.observe_cache(cache)
         )
+    # the causal spine exports through the same capture (anonymized to
+    # kind/event/tick + an irreversible correlation hash — record_spine)
+    from platform_aware_scheduling_tpu.utils import events
+
+    events.JOURNAL.flight = recorder
     return recorder
 
 

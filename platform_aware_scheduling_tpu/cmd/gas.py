@@ -52,6 +52,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_profile_flag(parser)
     common.add_robustness_flags(parser, degraded=False)
     common.add_decision_flags(parser)
+    common.add_event_flags(parser)
     # queue-only admission: GAS has no gang tracker, so the --preemption
     # surface is explicitly NOT offered (no dead flags)
     common.add_admission_flags(parser, preemption=False)
@@ -70,6 +71,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     common.validate_admission_flags(parser, args)
     klog.set_verbosity(args.v)
     common.configure_decisions(args)
+    common.configure_events(args)
 
     # fault-tolerant proxy in front of every API consumer — GAS has no
     # telemetry cache so no degraded-mode controller, but its informers

@@ -107,6 +107,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_profile_flag(parser)
     common.add_robustness_flags(parser)
     common.add_decision_flags(parser)
+    common.add_event_flags(parser)
     common.add_gang_flags(parser)
     common.add_admission_flags(parser)
     common.add_forecast_flags(parser)
@@ -309,8 +310,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     common.validate_admission_flags(parser, args)
     klog.set_verbosity(args.v)
     sync_period_s = parse_duration(args.syncPeriod)
-    # decision provenance on/off + ring size, before any verb can record
+    # decision provenance + causal event journal on/off + ring sizes,
+    # before any verb can record or publish
     common.configure_decisions(args)
+    common.configure_events(args)
 
     # every remote call goes through the fault-tolerant proxy: retried
     # reads, breaker-gated writes, per-endpoint-group circuits

@@ -25,6 +25,7 @@ from typing import Dict, Optional, TYPE_CHECKING
 from platform_aware_scheduling_tpu.utils import (
     decisions,
     devicewatch,
+    events,
     health,
     klog,
     trace,
@@ -90,7 +91,8 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/control", "description": "budget feedback controller: knob settings, ladder levels, recent actuations with provenance (404 when --sloControl=off)"},
     {"path": "/debug/wire", "description": "wire-path caches: interned node-name universes, intern hit/miss/eviction counts, response-skeleton keys (404 without a device fastpath)"},
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
-    {"path": "/debug/record", "description": "flight-recorder capture as versioned JSONL: anonymized verb arrivals, telemetry deciles, eviction/leader events (404 when --flightRecorder=off)"},
+    {"path": "/debug/explain", "description": "causal event spine: the ordered event chain + narrative for one entity; filters: ?pod=<ns/name>&gang=<id>&request_id=<id>&node=<name> (404 when --events=off)"},
+    {"path": "/debug/record", "description": "flight-recorder capture as versioned JSONL: anonymized verb arrivals, telemetry deciles, eviction/leader events, spine passthrough (404 when --flightRecorder=off)"},
     {"path": "/debug/whatif", "method": "POST", "description": "twin replay of a capture under transform knobs (load_multiplier, remove_nodes, thresholds): projected SLO verdicts + budget ledgers (404 when --flightRecorder=off)"},
 ]
 
@@ -671,6 +673,33 @@ class Server:
                     verb=params.get("verb"),
                     limit=limit,
                 ),
+            )
+        if bare_path == "/debug/explain":
+            # causal event spine (utils/events.py): the ordered event
+            # chain + human narrative for one pod/gang/request/node,
+            # joined across admission, preemption, rebalance, control,
+            # SLO, and the wire; 404 while disabled (--events=off)
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            if not events.JOURNAL.enabled:
+                return HTTPResponse.json(
+                    b'{"error": "event journal disabled"}\n', status=404
+                )
+            params = parse_query(request.path)
+            query = {
+                key: params.get(key, "")
+                for key in ("request_id", "pod", "gang", "node")
+            }
+            if not any(query.values()):
+                return HTTPResponse.json(
+                    b'{"error": "one of ?pod= ?gang= ?request_id= ?node= '
+                    b'is required"}\n',
+                    status=400,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=events.JOURNAL.to_json(**query),
             )
         if bare_path in ("/debug", "/debug/"):
             # tiny index so the debug surface is discoverable from curl

@@ -55,7 +55,7 @@ from platform_aware_scheduling_tpu.gas.utils import (
 from platform_aware_scheduling_tpu.kube.client import ConflictError
 from platform_aware_scheduling_tpu.kube.retry import RetryPolicy
 from platform_aware_scheduling_tpu.kube.objects import Node, Pod
-from platform_aware_scheduling_tpu.utils import decisions, klog, trace
+from platform_aware_scheduling_tpu.utils import decisions, events, klog, trace
 from platform_aware_scheduling_tpu.utils.quantity import Quantity
 from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
 
@@ -215,17 +215,31 @@ class GASExtender:
                 result = self._filter_nodes(
                     args, span=span, codes_out=admission_codes
                 )
+            span.set("pod", f"{args.pod.namespace}/{args.pod.name}")
             if self.admission is not None and not result.error:
                 with span.stage("admission"):
                     result = self._admission_review(
-                        args, result, admission_codes
+                        args, result, admission_codes, span.trace_id
                     )
             status = 404 if result.error else 200
             with span.stage("encode"):
                 body = result.to_json()
+            events.JOURNAL.publish(
+                "verdict",
+                "gas_filter",
+                request_id=span.trace_id,
+                pod=f"{args.pod.namespace}/{args.pod.name}",
+                data={
+                    "failed": len(result.failed_nodes),
+                    "path": str(span.attrs.get("path", "")),
+                },
+            )
             return HTTPResponse.json(body, status=status)
         finally:
-            self.recorder.observe("gas_filter", time.perf_counter() - start)
+            self.recorder.observe(
+                "gas_filter", time.perf_counter() - start,
+                trace_id=span.trace_id,
+            )
             if self.flight is not None:
                 self._record_flight_verb("gas_filter", request)
 
@@ -252,9 +266,20 @@ class GASExtender:
             status = 404 if result.error else 200
             with span.stage("encode"):
                 body = result.to_json()
+            events.JOURNAL.publish(
+                "verdict",
+                "gas_bind",
+                request_id=span.trace_id,
+                pod=f"{args.pod_namespace}/{args.pod_name}",
+                node=args.node,
+                data={"status": status},
+            )
             return HTTPResponse.json(body, status=status)
         finally:
-            self.recorder.observe("gas_bind", time.perf_counter() - start)
+            self.recorder.observe(
+                "gas_bind", time.perf_counter() - start,
+                trace_id=span.trace_id,
+            )
             if self.flight is not None:
                 self._record_flight_verb("gas_bind", request)
 
@@ -339,7 +364,11 @@ class GASExtender:
             return FilterResult(node_names=node_names, failed_nodes=failed, error="")
 
     def _admission_review(
-        self, args: Args, result: FilterResult, codes: Dict[str, int]
+        self,
+        args: Args,
+        result: FilterResult,
+        codes: Dict[str, int],
+        request_id: str = "",
     ) -> FilterResult:
         """Consult the admission plane over one gas_filter verdict
         (admission/plane.py review contract): None keeps the verdict
@@ -352,6 +381,7 @@ class GASExtender:
                 list(args.node_names or ()),
                 dict(result.failed_nodes),
                 codes,
+                request_id=request_id,
             )
         except Exception as exc:
             klog.error("admission review failed open: %r", exc)

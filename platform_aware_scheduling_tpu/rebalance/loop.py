@@ -47,7 +47,7 @@ from platform_aware_scheduling_tpu.tas.planner import (
     DEFAULT_NODE_CAPACITY,
     TAS_POLICY_LABEL,
 )
-from platform_aware_scheduling_tpu.utils import decisions, klog, trace
+from platform_aware_scheduling_tpu.utils import decisions, events, klog, trace
 from platform_aware_scheduling_tpu.utils.quantity import Quantity
 
 DESCHEDULE_STRATEGY = "deschedule"
@@ -297,6 +297,13 @@ class Rebalancer:
                 decisions.DECISIONS.observe_rebalance(
                     move.namespace, move.name, "evicted",
                     f"{move.from_node} -> {move.to_node}",
+                )
+                events.JOURNAL.publish(
+                    "rebalance",
+                    "move executed",
+                    pod=move.pod_key,
+                    node=move.from_node,
+                    data={"to": move.to_node, "cycle": cycle_no},
                 )
             for reason, skipped in actuation.skipped.items():
                 for move in skipped:

@@ -39,7 +39,7 @@ from platform_aware_scheduling_tpu.extender.server import (
     HTTPRequest,
     HTTPResponse,
 )
-from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils import events, klog, trace
 from platform_aware_scheduling_tpu.utils.tracing import (
     CounterSet,
     LatencyRecorder,
@@ -109,6 +109,12 @@ class MicroBatchDispatcher:
         if len(self._queue) >= self.max_queue_depth:
             self.counters.inc("pas_serving_rejected_total")
             trace.of(request).set("rejected", True)
+            events.JOURNAL.publish(
+                "serving",
+                "request shed",
+                request_id=trace.of(request).trace_id,
+                data={"path": request.path, "depth": len(self._queue)},
+            )
             future.set_result(
                 HTTPResponse(
                     status=503,

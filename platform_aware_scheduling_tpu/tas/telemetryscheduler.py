@@ -64,7 +64,7 @@ from platform_aware_scheduling_tpu.native import get_wirec
 from platform_aware_scheduling_tpu.tas.fastpath import PrioritizeFastPath
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy, TASPolicyRule
 from platform_aware_scheduling_tpu.tas.strategies import core, dontschedule
-from platform_aware_scheduling_tpu.utils import decisions, klog, trace
+from platform_aware_scheduling_tpu.utils import decisions, events, klog, trace
 from platform_aware_scheduling_tpu.utils import labels as shared_labels
 from platform_aware_scheduling_tpu.utils.tracing import LatencyRecorder
 
@@ -488,11 +488,24 @@ class MetricsExtender:
             args, names, status = decoded
             if self.flight is not None:
                 self._stash_flight_exact(request, args, candidates=len(names))
-            return HTTPResponse.json(
-                self._prioritize_body(args, names, span=span), status=status
+            span.set("pod", f"{args.pod.namespace}/{args.pod.name}")
+            body = self._prioritize_body(args, names, span=span)
+            events.JOURNAL.publish(
+                "verdict",
+                "prioritize",
+                request_id=span.trace_id,
+                pod=f"{args.pod.namespace}/{args.pod.name}",
+                data={
+                    "candidates": len(names),
+                    "path": str(span.attrs.get("path", "exact")),
+                },
             )
+            return HTTPResponse.json(body, status=status)
         finally:
-            self.recorder.observe("prioritize", time.perf_counter() - start)
+            self.recorder.observe(
+                "prioritize", time.perf_counter() - start,
+                trace_id=span.trace_id,
+            )
             if self.flight is not None:
                 self._record_flight_verb("prioritize", request)
 
@@ -610,10 +623,12 @@ class MetricsExtender:
             if result is None:
                 klog.v(2).info_s("No filtered nodes returned", component="extender")
                 return HTTPResponse.json(b"null\n", status=404)
+            span.set("pod", f"{args.pod.namespace}/{args.pod.name}")
             if self.admission is not None:
                 with span.stage("admission"):
                     result = self._admission_review(
-                        args, result, gang_codes, degraded_action
+                        args, result, gang_codes, degraded_action,
+                        span.trace_id,
                     )
             with span.stage("encode"):
                 body = result.to_json()
@@ -657,14 +672,27 @@ class MetricsExtender:
                     reason_code=reason_code,
                     reason_counts=reason_counts,
                 )
+            events.JOURNAL.publish(
+                "verdict",
+                "filter",
+                request_id=span.trace_id,
+                pod=f"{args.pod.namespace}/{args.pod.name}",
+                data={
+                    "failed": len(result.failed_nodes),
+                    "path": str(span.attrs.get("filter_cache", "exact")),
+                },
+            )
             return HTTPResponse.json(body)
         finally:
-            self.recorder.observe("filter", time.perf_counter() - start)
+            self.recorder.observe(
+                "filter", time.perf_counter() - start,
+                trace_id=span.trace_id,
+            )
             if self.flight is not None:
                 self._record_flight_verb("filter", request)
 
     def _admission_review(
-        self, args, result, gang_codes, degraded_action
+        self, args, result, gang_codes, degraded_action, request_id=""
     ):
         """Consult the admission plane over one computed Filter verdict
         (admission/plane.py review contract): None keeps the verdict
@@ -684,7 +712,8 @@ class MetricsExtender:
                 for name in failed
             }
             verdict = self.admission.review(
-                args.pod, self._candidate_names(args), failed, codes
+                args.pod, self._candidate_names(args), failed, codes,
+                request_id=request_id,
             )
         except Exception as exc:
             klog.error("admission review failed open: %r", exc)
@@ -933,6 +962,16 @@ class MetricsExtender:
 
                 args = BindingArgs.from_json(request.body)
                 if args.pod_name and args.node:
+                    # verb + correlation attrs on the span: its completion
+                    # becomes the chain-closing "bind responded" wire
+                    # event in the causal spine (utils/events.py), 404
+                    # status and all — the 404 IS the wire response here
+                    span = trace.of(request)
+                    span.set("verb", "bind")
+                    span.set(
+                        "pod", f"{args.pod_namespace}/{args.pod_name}"
+                    )
+                    span.set("node", args.node)
                     if decisions.DECISIONS.enabled:
                         decisions.DECISIONS.observe_bind(
                             args.pod_namespace, args.pod_name, args.node
@@ -945,6 +984,13 @@ class MetricsExtender:
                         self.admission.observe_bind(
                             args.pod_namespace, args.pod_name
                         )
+                    events.JOURNAL.publish(
+                        "verdict",
+                        "bind observed",
+                        request_id=trace.of(request).trace_id,
+                        pod=f"{args.pod_namespace}/{args.pod_name}",
+                        node=args.node,
+                    )
             except Exception:
                 pass  # feedback is best-effort; the verb stays a 404
         return HTTPResponse(status=404)
@@ -1026,6 +1072,12 @@ class MetricsExtender:
         pod = Pod(
             {"metadata": {"name": parsed.pod_name or "", "namespace": namespace}}
         )
+        # correlation key for the causal spine: the native path must
+        # stamp the span and publish its verdict exactly like the exact
+        # path below, or /debug/explain loses the score step for every
+        # fastpath-served pod
+        pod_key = f"{namespace}/{parsed.pod_name or ''}"
+        span.set("pod", pod_key)
         planned = (
             self.planner.planned_node(pod) if self.planner is not None else None
         )
@@ -1059,6 +1111,13 @@ class MetricsExtender:
                     compiled=compiled, view=rank_view,
                     forecast=rank_view is not view,
                 )
+                events.JOURNAL.publish(
+                    "verdict",
+                    "prioritize",
+                    request_id=span.trace_id,
+                    pod=pod_key,
+                    data={"candidates": int(candidates), "path": "native"},
+                )
                 return HTTPResponse.json(body, status)
             except Exception as exc:
                 trace.COUNTERS.inc("pas_prioritize_host_fallback_total")
@@ -1085,6 +1144,13 @@ class MetricsExtender:
         self._record_prioritize(
             span, namespace, parsed.pod_name or "", policy_name,
             "native_host", rule, int(candidates), planned, result=result,
+        )
+        events.JOURNAL.publish(
+            "verdict",
+            "prioritize",
+            request_id=span.trace_id,
+            pod=pod_key,
+            data={"candidates": int(candidates), "path": "native_host"},
         )
         return HTTPResponse.json(body, status)
 

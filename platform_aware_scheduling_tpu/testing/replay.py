@@ -149,6 +149,7 @@ class Capture:
         self.verb_counts: Dict[str, int] = {}
         self.evictions = 0
         self.leader_flips = 0
+        self.spine_events = 0
         for e in self.events:
             kind = e.get("kind")
             if kind == "verb":
@@ -168,6 +169,11 @@ class Capture:
                 self.evictions += int(e.get("count", 0))
             elif kind == "leader":
                 self.leader_flips += 1
+            elif kind == "spine":
+                # causal-spine passthrough (format /2): counted for the
+                # stats echo, never inferred from — the timeline comes
+                # from telemetry/verb events alone
+                self.spine_events += 1
 
     def stats(self) -> Dict:
         """The capture summary a what-if response echoes back."""
@@ -182,6 +188,7 @@ class Capture:
             "peak_verbs_per_tick": max(self.arrivals, default=0),
             "evictions": self.evictions,
             "leader_flips": self.leader_flips,
+            "spine_events": self.spine_events,
         }
 
 

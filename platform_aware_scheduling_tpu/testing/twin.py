@@ -40,11 +40,14 @@ from __future__ import annotations
 
 import json
 import math
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from platform_aware_scheduling_tpu.extender.server import HTTPRequest
+from platform_aware_scheduling_tpu.extender.server import (
+    HTTPRequest,
+    HTTPResponse,
+)
 from platform_aware_scheduling_tpu.testing.builders import make_pod
 from platform_aware_scheduling_tpu.testing.faults import int_node_metric
 from platform_aware_scheduling_tpu.testing.ha import (
@@ -54,6 +57,7 @@ from platform_aware_scheduling_tpu.testing.ha import (
     POLICY_NAME,
     THRESHOLD,
 )
+from platform_aware_scheduling_tpu.utils import events, trace
 from platform_aware_scheduling_tpu.utils import labels as shared_labels
 from platform_aware_scheduling_tpu.utils.slo import (
     ALERT_PAGE,
@@ -473,6 +477,16 @@ class TwinCluster(HAHarness):
             for stack in self.replicas:
                 if stack is not None:
                     stack.extender.control = self.controller
+        # -- the causal event spine rides the twin tick: journal events
+        # carry the engine tick (not just wall time) so /debug/explain
+        # narratives read in scheduler time.  The PREVIOUS source is
+        # saved and restored in close(): a what-if replay builds a
+        # TwinCluster inside a live server's request and must not leave
+        # a dead lambda (or a cleared slot) on the process-wide journal.
+        self.tick_no = 0
+        self._prev_tick_source = events.JOURNAL.tick_source
+        self._prev_journal_flight = events.JOURNAL.flight
+        events.JOURNAL.tick_source = lambda: self.tick_no
 
     # -- signal plumbing -------------------------------------------------------
 
@@ -603,6 +617,7 @@ class TwinCluster(HAHarness):
         enforcement + rebalance), then the world's reaction (evicted
         pods reschedule), then synthetic verb traffic through the real
         handlers, then one SLO evaluation."""
+        self.tick_no += 1
         super().tick()
         self._rebind_evicted()
         self._drive_traffic()
@@ -878,6 +893,9 @@ class TwinCluster(HAHarness):
         stack.cache.on_refresh_pass.append(
             lambda: recorder.observe_cache(stack.cache)
         )
+        # the causal spine exports through the same capture, exactly as
+        # cmd/common.build_flight_recorder wires it in production
+        events.JOURNAL.flight = recorder
 
     def serve(self, serving: str = "threaded"):
         """Mount the first live replica's extender behind a REAL HTTP
@@ -902,6 +920,8 @@ class TwinCluster(HAHarness):
     def close(self) -> None:
         if self.gas is not None:
             self.gas.cache.stop()
+        events.JOURNAL.tick_source = self._prev_tick_source
+        events.JOURNAL.flight = self._prev_journal_flight
 
     # -- judgment --------------------------------------------------------------
 
@@ -987,6 +1007,58 @@ class Scenario:
                 )
             )
         return checks
+
+    def expect_chain(
+        self,
+        twin: TwinCluster,
+        expected: List[Tuple[str, str]],
+        **query: str,
+    ) -> Dict:
+        """Prove a causal story through the REAL debug surface: issue
+        ``GET /debug/explain`` against a front-end mounted on the twin's
+        leader (routed directly — no socket) and assert ``expected``,
+        ordered ``(kind, event-prefix)`` pairs, appears as a subsequence
+        of the returned chain.  Query kwargs are the endpoint's own
+        filters (``pod=``/``gang=``/``request_id=``/``node=``)."""
+        from platform_aware_scheduling_tpu.extender.server import Server
+
+        extender = twin.live()[0].extender
+        server = Server(extender, metrics_provider=extender.metrics_text)
+        qs = "&".join(f"{k}={v}" for k, v in query.items() if v)
+        response = server.route(
+            HTTPRequest(
+                method="GET",
+                path=f"/debug/explain?{qs}",
+                headers={},
+                body=b"",
+            )
+        )
+        if response.status != 200:
+            return self._check(
+                "explain:chain",
+                False,
+                f"/debug/explain?{qs} -> {response.status}",
+            )
+        chain = json.loads(response.body).get("events") or []
+        walker = iter(chain)
+        missing: List[str] = []
+        for kind, event in expected:
+            for record in walker:
+                if record["kind"] == kind and record["event"].startswith(
+                    event
+                ):
+                    break
+            else:
+                # once one link is missing, order past it is unprovable
+                missing.append(f"{kind}:{event}")
+                walker = iter(())
+        return self._check(
+            "explain:chain",
+            not missing,
+            f"missing (in order) {missing} in {len(chain)} events"
+            if missing
+            else f"full causal chain present ({len(chain)} events)",
+        )
 
     def run(self, scale: Optional[Dict] = None) -> Dict:
         scale = dict(scale or {})
@@ -1744,6 +1816,11 @@ class _AdmissionScenario(Scenario):
         scale = dict(scale)
         scale.pop("num_nodes", None)
         scale.pop("pods", None)
+        # each run tells ONE causal story: reset here (not in
+        # TwinCluster.__init__ — /debug/whatif builds a twin inside a
+        # live server's request and must not wipe the live journal), so
+        # expect_chain() reads only this scenario's events
+        events.JOURNAL.reset()
         twin = TwinCluster(
             num_nodes=self.rows * self.cols,
             gang=True,
@@ -1787,6 +1864,19 @@ class _AdmissionScenario(Scenario):
 
     # -- verb driving ----------------------------------------------------------
 
+    @staticmethod
+    def _call(verb: Callable, path: str, payload: Dict) -> HTTPResponse:
+        """One verb call carrying a REAL span, exactly as the live
+        front-ends attach one: the handler stamps verb/pod attrs on it,
+        and finishing it into trace.TRACES fires the span observer, so
+        every twin verb lands a correlated ``wire`` event in the causal
+        spine (utils/events.py) with a request_id chains can join on."""
+        request = _request(path, json.dumps(payload).encode())
+        request.span = trace.Span(f"POST {path}", trace.new_request_id())
+        response = verb(request)
+        trace.TRACES.add(request.span.finish(response.status))
+        return response
+
     def _drive_round(
         self,
         twin: TwinCluster,
@@ -1823,13 +1913,10 @@ class _AdmissionScenario(Scenario):
                     if n not in self.single_nodes
                 ]
             twin.traffic["requests"] += 1
-            response = extender.filter(
-                _request(
-                    "/scheduler/filter",
-                    json.dumps(
-                        {"Pod": pod_obj, "NodeNames": candidates}
-                    ).encode(),
-                )
+            response = self._call(
+                extender.filter,
+                "/scheduler/filter",
+                {"Pod": pod_obj, "NodeNames": candidates},
             )
             if response.status != 200:
                 twin.traffic["errors"] += 1
@@ -1840,13 +1927,10 @@ class _AdmissionScenario(Scenario):
             if not passing:
                 continue
             ranked = json.loads(
-                extender.prioritize(
-                    _request(
-                        "/scheduler/prioritize",
-                        json.dumps(
-                            {"Pod": pod_obj, "NodeNames": passing}
-                        ).encode(),
-                    )
+                self._call(
+                    extender.prioritize,
+                    "/scheduler/prioritize",
+                    {"Pod": pod_obj, "NodeNames": passing},
                 ).body
                 or b"[]"
             )
@@ -1862,18 +1946,15 @@ class _AdmissionScenario(Scenario):
                 continue  # every passing node already hosts a pod
             occupied.add(node)
             name = pod_obj["metadata"]["name"]
-            extender.bind(
-                _request(
-                    "/scheduler/bind",
-                    json.dumps(
-                        {
-                            "PodName": name,
-                            "PodNamespace": "default",
-                            "PodUID": "uid",
-                            "Node": node,
-                        }
-                    ).encode(),
-                )
+            self._call(
+                extender.bind,
+                "/scheduler/bind",
+                {
+                    "PodName": name,
+                    "PodNamespace": "default",
+                    "PodUID": "uid",
+                    "Node": node,
+                },
             )
             twin.fake.add_pod(
                 make_pod(
@@ -2318,6 +2399,25 @@ class PreemptionCascade(_AdmissionScenario):
                     len(survivor) == 8,
                     f"{len(survivor)} batch pods still running",
                 ),
+                # the causal spine must tell this scenario's WHOLE story
+                # from one query: ask /debug/explain about the high
+                # gang's leader and demand the ordered chain — enqueue,
+                # preemption plan naming victims, slice reservation,
+                # admission, score path, wire response (utils/events.py)
+                self.expect_chain(
+                    twin,
+                    [
+                        ("admission", "enqueue"),
+                        ("preemption", "planned"),
+                        ("preemption", "victim evicted"),
+                        ("preemption", "slice reserved"),
+                        ("admission", "admit"),
+                        ("verdict", "filter"),
+                        ("verdict", "prioritize"),
+                        ("wire", "bind responded"),
+                    ],
+                    pod="default/high-0",
+                ),
             ]
         )
         return checks
@@ -2343,6 +2443,10 @@ def admission_headtohead(period_s: float = 5.0) -> Dict:
     off_budget = (off["judgment"].get(slo_name) or {}).get(
         "error_budget_remaining"
     )
+    # fresh timeline for the null hypothesis: the cascade arms above
+    # legitimately filled (and may have overflowed) the event ring, and
+    # DiurnalLoad builds a bare TwinCluster with no reset of its own
+    events.JOURNAL.reset()
     quiet = DiurnalLoad().run(
         {
             "num_nodes": 16,
@@ -2352,11 +2456,16 @@ def admission_headtohead(period_s: float = 5.0) -> Dict:
         }
     )
     quiet_plane = quiet.get("admission_plane") or {}
+    # the spine must never shed its own story on a healthy day: a quiet
+    # diurnal run that overflows the event ring means the journal is
+    # sized wrong for steady state (ISSUE: zero drops in quiet-diurnal)
+    quiet_events_dropped = events.JOURNAL.dropped
     quiet_ok = (
         quiet["passed"]
         and quiet_plane.get("depth") == 0
         and (quiet_plane.get("counters") or {}).get("queued", 0) == 0
         and (quiet_plane.get("counters") or {}).get("preemptions", 0) == 0
+        and quiet_events_dropped == 0
     )
     return {
         "slo": slo_name,
@@ -2382,6 +2491,7 @@ def admission_headtohead(period_s: float = 5.0) -> Dict:
         "diurnal_quiet": {
             "passed": quiet["passed"],
             "plane": quiet_plane,
+            "events_dropped": quiet_events_dropped,
             "ok": quiet_ok,
         },
         "all_ok": bool(

@@ -45,7 +45,7 @@ import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from platform_aware_scheduling_tpu.utils import decisions, klog
+from platform_aware_scheduling_tpu.utils import decisions, events, klog
 from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 
 #: tighten while the trigger SLO's remaining error budget sits below
@@ -455,6 +455,16 @@ class BudgetController:
             self.decision_log.record_control(dict(record))
         except Exception as exc:
             klog.error("control decision record failed: %r", exc)
+        events.JOURNAL.publish(
+            "control",
+            f"knob {direction}",
+            data={
+                "knob": knob.name,
+                "trigger": trigger,
+                "from": before,
+                "to": after,
+            },
+        )
         return True
 
     # -- introspection ---------------------------------------------------------

@@ -522,6 +522,7 @@ class DecisionLog:
         namespace, _, name = pod.partition("/")
         record = DecisionRecord(
             verb="admission",
+            request_id=str(detail.get("request_id", "")),
             pod_namespace=namespace or "-",
             pod_name=name or "admission",
             path=str(detail.get("event", "")),
@@ -542,6 +543,7 @@ class DecisionLog:
         namespace, _, name = pod.partition("/")
         record = DecisionRecord(
             verb="preemption",
+            request_id=str(detail.get("request_id", "")),
             pod_namespace=namespace or "-",
             pod_name=name or "preemption",
             path=str(detail.get("outcome", "")),

@@ -46,8 +46,12 @@ from platform_aware_scheduling_tpu.utils import klog, trace
 from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 
 #: capture format version: bumped on any event-schema change so a
-#: replay loader can refuse captures it would misread
-FORMAT = "pas-flight-record/1"
+#: replay loader can refuse captures it would misread.  /2 added the
+#: causal-spine passthrough events (kind "spine": utils/events.py
+#: forwards journal events with an irreversible process-local
+#: correlation hash); loaders that fold a capture into a twin scenario
+#: ignore kinds they don't infer from, so /2 stays replayable.
+FORMAT = "pas-flight-record/2"
 
 DEFAULT_CAPACITY = 4096
 
@@ -177,6 +181,25 @@ class FlightRecorder:
                 "t": round(self.clock(), 6),
                 "kind": "leader",
                 "leader": bool(is_leader),
+            }
+        )
+
+    def record_spine(
+        self, kind: str, event: str, tick: int, corr: str
+    ) -> None:
+        """One causal-spine event (utils/events.py forwards every
+        journal publish here while wired).  Anonymization holds: the
+        correlation keys (pod/gang/node/request id) are collapsed into
+        ``corr``, an irreversible process-local hash — chains stay
+        joinable within one capture, nothing joins back to a name."""
+        self._append(
+            {
+                "t": round(self.clock(), 6),
+                "kind": "spine",
+                "spine_kind": str(kind),
+                "event": str(event),
+                "tick": int(tick),
+                "corr": str(corr),
             }
         )
 

@@ -61,11 +61,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
-from platform_aware_scheduling_tpu.utils import klog, trace
+from platform_aware_scheduling_tpu.utils import events, klog, trace
 from platform_aware_scheduling_tpu.utils.tracing import (
+    BUCKETS,
     CounterSet,
     LatencyRecorder,
-    _BUCKETS,
     bucket_count_below,
     quantile_from_buckets,
 )
@@ -366,7 +366,7 @@ class SLOEngine:
         recorder lock the hot path's observe() contends on) and shared
         by all histogram-reading SLOs."""
         total = 0.0
-        merged = [0.0] * (len(_BUCKETS) + 1)
+        merged = [0.0] * (len(BUCKETS) + 1)
         for snap in recorder_snaps:
             for verb in verbs:
                 entry = snap.get(verb)
@@ -527,6 +527,18 @@ class SLOEngine:
                     f"SLO {slo.name} entered {tier} (burn "
                     f"{', '.join(f'{w}={burn[w]:.1f}' for w in burn)})",
                     component="slo",
+                )
+                events.JOURNAL.publish(
+                    "slo",
+                    f"entered {tier}",
+                    data={
+                        "slo": slo.name,
+                        "burn": {w: round(b, 3) for w, b in burn.items()},
+                    },
+                )
+            elif was_active and not now_active:
+                events.JOURNAL.publish(
+                    "slo", f"cleared {tier}", data={"slo": slo.name}
                 )
         state.warn_active = warn_now
         state.page_active = page_now

@@ -228,6 +228,13 @@ declare("pas_preemption_victim_gangs_total", "counter", "Whole gangs displaced b
 declare("pas_preemption_evictions_total", "counter", "Pod evictions executed through the actuator's preemption verb.")
 declare("pas_preemption_skipped_total", "counter", "Preemption evictions refused by the actuator's gates (label: reason in cooldown/rate_limit/dry_run/pdb/fenced/error).")
 declare("pas_preemption_reservations_total", "counter", "Freed slices reserved for the preempting gang while its victims drain.")
+# causal event spine + explain plane (utils/events.py; docs/observability.md
+# "Explain plane").  Unlike pas_record_*, these land in the process-wide
+# COUNTERS: the journal is on by default and both front-ends feed it.
+declare("pas_events_published_total", "counter", "Typed events accepted into the causal event journal (label: kind in wire/verdict/admission/preemption/rebalance/control/slo/serving).")
+declare("pas_events_dropped_total", "counter", "Oldest journal events evicted by ring overflow (raise --eventsSize if this moves).")
+declare("pas_explain_requests_total", "counter", "GET /debug/explain queries served (both front-ends).")
+declare("pas_explain_chain_events", "gauge", "Events in the causal chain returned by the most recent /debug/explain query.")
 
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where
@@ -408,6 +415,13 @@ def of(request) -> Span:
 # trace ring buffer
 # ---------------------------------------------------------------------------
 
+#: callables ``(span)`` invoked after every completed span lands in the
+#: buffer — the causal event spine (utils/events.py) registers here so
+#: wire completions become journal events without trace.py importing it.
+#: Observers run on the request thread and must never raise into the
+#: caller; failures are swallowed (precedent: FIRST_COMPILE_HOOKS).
+SPAN_OBSERVERS: List[Callable] = []
+
 
 class TraceBuffer:
     """Bounded ring of recent completed spans + bounded top-K slowest.
@@ -440,6 +454,11 @@ class TraceBuffer:
                 slow.insert(i, span)
                 del slow[self.slow_capacity :]
         COUNTERS.inc("pas_traces_recorded_total")
+        for observer in SPAN_OBSERVERS:
+            try:
+                observer(span)
+            except Exception:
+                pass
 
     def find(self, trace_id: str) -> Optional[Span]:
         with self._lock:
@@ -728,7 +747,14 @@ def parse_prometheus_text(text: str) -> Dict[str, Dict]:
                 else:
                     fam["help"] = parts[3] if len(parts) > 3 else ""
             continue
-        # sample line: name[{labels}] value [timestamp]
+        # sample line: name[{labels}] value [timestamp] [# exemplar]
+        # OpenMetrics exemplar annotations (`... # {trace_id="x"} 0.01`)
+        # are emitted on our histogram buckets (utils/tracing.py); strip
+        # them before brace-finding so rfind("}") can't grab the
+        # exemplar's labelset instead of the sample's.
+        exemplar = line.find(" # {")
+        if exemplar >= 0:
+            line = line[:exemplar].rstrip()
         brace = line.find("{")
         labels: Dict[str, str] = {}
         if brace >= 0:

@@ -26,10 +26,14 @@ from typing import Deque, Dict, Iterable, List, Optional, Tuple
 # buckets (utils/slo.py) — collapsed into the 400 µs -> 800 µs step; the
 # extra bounds resolve it.  Sorted and deduplicated by construction so
 # the exposition's cumulative-bucket invariant cannot be violated by a
-# misordered literal.
-_BUCKETS: List[float] = sorted(
+# misordered literal.  PUBLIC: this ladder is the one definition shared
+# by the histogram exposition, the SLO quantile math (utils/slo.py), and
+# the exemplar store below — consumers import ``BUCKETS``, never a copy.
+BUCKETS: List[float] = sorted(
     {0.00025, 0.0005, 0.00075} | {0.0001 * (2**i) for i in range(21)}
 )
+#: backward-compatible alias (pre-explain-plane importers)
+_BUCKETS = BUCKETS
 
 
 def quantile_from_buckets(
@@ -38,7 +42,7 @@ def quantile_from_buckets(
     """Estimate the q-quantile in seconds from per-bucket counts.
 
     ``buckets`` holds one count per bound in ``bounds`` (default: the
-    shared ``_BUCKETS`` ladder) plus a trailing +Inf overflow count —
+    shared ``BUCKETS`` ladder) plus a trailing +Inf overflow count —
     exactly the shape :meth:`LatencyRecorder.snapshot` returns, and the
     shape the SLO engine's windowed bucket deltas take (utils/slo.py).
 
@@ -58,7 +62,7 @@ def quantile_from_buckets(
         (there is no upper edge to interpolate toward — the estimate is
         a floor, as for any +Inf-bucket quantile)."""
     if bounds is None:
-        bounds = _BUCKETS
+        bounds = BUCKETS
     total = sum(buckets)
     if total <= 0:
         return 0.0
@@ -93,7 +97,7 @@ def bucket_count_below(
     width below it (the same within-bucket model as
     :func:`quantile_from_buckets`); +Inf samples never count."""
     if bounds is None:
-        bounds = _BUCKETS
+        bounds = BUCKETS
     good = 0.0
     for i, count in enumerate(buckets):
         if count <= 0:
@@ -296,23 +300,45 @@ class LatencyRecorder:
         self._counts: Dict[str, int] = {}
         self._sums: Dict[str, float] = {}
         self._buckets: Dict[str, List[int]] = {}
+        #: last exemplar per (label, bucket index): trace id + value.
+        #: Bounded by labels x buckets by construction; "last one wins"
+        #: is the OpenMetrics-conventional choice — the newest slow
+        #: request is the one worth opening in /debug/explain
+        self._exemplars: Dict[str, Dict[int, Tuple[str, float]]] = {}
 
-    def observe(self, label: str, seconds: float) -> None:
+    def observe(
+        self, label: str, seconds: float, trace_id: str = ""
+    ) -> None:
         with self._lock:
             if label not in self._samples:
                 self._samples[label] = deque(maxlen=self._window)
                 self._counts[label] = 0
                 self._sums[label] = 0.0
-                self._buckets[label] = [0] * (len(_BUCKETS) + 1)
+                self._buckets[label] = [0] * (len(BUCKETS) + 1)
             self._samples[label].append(seconds)
             self._counts[label] += 1
             self._sums[label] += seconds
-            for i, bound in enumerate(_BUCKETS):
+            for i, bound in enumerate(BUCKETS):
                 if seconds <= bound:
                     self._buckets[label][i] += 1
                     break
             else:
+                i = len(BUCKETS)
                 self._buckets[label][-1] += 1
+            if trace_id:
+                self._exemplars.setdefault(label, {})[i] = (
+                    trace_id, seconds,
+                )
+
+    def exemplars(self) -> Dict[str, Dict[int, Tuple[str, float]]]:
+        """label -> {bucket index -> (trace_id, seconds)}: the newest
+        exemplar recorded in each bucket (copy; merge surface for
+        :func:`histograms_text`)."""
+        with self._lock:
+            return {
+                label: dict(per_bucket)
+                for label, per_bucket in self._exemplars.items()
+            }
 
     def labels(self) -> List[str]:
         with self._lock:
@@ -361,8 +387,17 @@ def histograms_text(
     duplicate family headers, which is invalid exposition.  A label
     recorded by several recorders sums (the serving layer and a verb
     handler never share labels in practice, but the merge must still be
-    well-formed exposition if they do)."""
+    well-formed exposition if they do).
+
+    Bucket lines carry OpenMetrics EXEMPLARS when the recorder has them
+    (``... 12 # {trace_id="..."} 0.000431``): the newest trace id that
+    landed in that bucket, joining a slow histogram bucket to its
+    ``/debug/traces`` span and ``/debug/explain`` chain.  Prometheus'
+    text parser ignores everything after ``#`` on a sample line, so the
+    page stays scrape-compatible; our own parser
+    (``trace.parse_prometheus_text``) strips the annotation explicitly."""
     merged: Dict[str, Tuple[List[int], int, float]] = {}
+    exemplars: Dict[str, Dict[int, Tuple[str, float]]] = {}
     for recorder in recorders:
         for label, (buckets, count, total) in recorder.snapshot().items():
             if label in merged:
@@ -374,8 +409,21 @@ def histograms_text(
                 )
             else:
                 merged[label] = (buckets, count, total)
+        for label, per_bucket in recorder.exemplars().items():
+            exemplars.setdefault(label, {}).update(per_bucket)
     if not merged:
         return ""
+
+    def exemplar_suffix(label: str, index: int) -> str:
+        entry = exemplars.get(label, {}).get(index)
+        if entry is None:
+            return ""
+        trace_id, seconds = entry
+        return (
+            f' # {{trace_id="{_escape_label_value(trace_id)}"}} '
+            f"{seconds:.9f}"
+        )
+
     help_text = (help_texts or {}).get(metric)
     lines: List[str] = []
     if help_text:
@@ -384,15 +432,16 @@ def histograms_text(
     for label in sorted(merged):
         buckets, count, total = merged[label]
         cumulative = 0
-        for bound, n in zip(_BUCKETS, buckets):
+        for i, (bound, n) in enumerate(zip(BUCKETS, buckets)):
             cumulative += n
             lines.append(
                 f'{metric}_bucket{{{label_name}="{label}",le="{bound:g}"}} '
-                f"{cumulative}"
+                f"{cumulative}{exemplar_suffix(label, i)}"
             )
         cumulative += buckets[-1]
         lines.append(
-            f'{metric}_bucket{{{label_name}="{label}",le="+Inf"}} {cumulative}'
+            f'{metric}_bucket{{{label_name}="{label}",le="+Inf"}} '
+            f"{cumulative}{exemplar_suffix(label, len(BUCKETS))}"
         )
         lines.append(f'{metric}_sum{{{label_name}="{label}"}} {total:.9f}')
         lines.append(f'{metric}_count{{{label_name}="{label}"}} {count}')

@@ -1,0 +1,245 @@
+"""The causal event spine (utils/events.py) and its query surface:
+journal bounds/ordering under writer torture, one-hop correlation walks,
+the /debug/explain wire contract on BOTH front-ends (404 while disabled,
+405 non-GET, 400 without a filter, queue bypass on the async server),
+and the TraceBuffer slowest-top-K under concurrent completions.
+
+Everything is hermetic: unit tests run on private EventJournal/
+TraceBuffer instances; wire tests run in-process servers on 127.0.0.1
+ephemeral ports seeded like benchmarks/http_load.
+"""
+
+import json
+import threading
+import time
+
+from benchmarks.http_load import build_extender, make_bodies
+from platform_aware_scheduling_tpu.utils import trace
+from platform_aware_scheduling_tpu.utils.events import EventJournal, JOURNAL
+from wirehelpers import (
+    get_request as _get,
+    post_bytes as _post,
+    raw_request as _raw,
+    start_async as _start_async,
+    start_threaded as _start_threaded,
+)
+
+
+class TestEventJournal:
+    def test_bounded_with_drop_accounting(self):
+        journal = EventJournal(capacity=16)
+        for i in range(50):
+            journal.publish("wire", "filter responded", pod=f"ns/p-{i}")
+        assert len(journal) == 16
+        assert journal.dropped == 50 - 16
+        # the ring keeps the NEWEST events (drop-oldest overflow)
+        kept = [r["pod"] for r in journal.snapshot()]
+        assert kept == [f"ns/p-{i}" for i in range(34, 50)]
+
+    def test_disabled_publishes_nothing(self):
+        journal = EventJournal(capacity=8)
+        journal.configure(enabled=False)
+        journal.publish("wire", "filter responded", pod="ns/p")
+        assert len(journal) == 0 and journal.dropped == 0
+        journal.configure(enabled=True)
+        journal.publish("wire", "filter responded", pod="ns/p")
+        assert len(journal) == 1
+
+    def test_reconfigure_capacity_keeps_tail(self):
+        journal = EventJournal(capacity=32)
+        for i in range(32):
+            journal.publish("admission", "enqueue", pod=f"ns/p-{i}")
+        journal.configure(capacity=4)
+        assert len(journal) == 4
+        assert [r["pod"] for r in journal.snapshot()] == [
+            f"ns/p-{i}" for i in range(28, 32)
+        ]
+
+    def test_explain_walks_one_hop(self):
+        """pod -> gang -> the preemption event that never names the pod:
+        the one-hop expansion is what joins a wire span to the
+        preemption that seated it."""
+        journal = EventJournal()
+        journal.publish(
+            "admission", "enqueue", pod="default/high-0", gang="gang-high"
+        )
+        journal.publish(
+            "preemption", "planned", gang="gang-high",
+            data={"victims": ["batch-a"]},
+        )
+        journal.publish("wire", "filter responded", pod="default/other")
+        out = journal.explain(pod="default/high-0")
+        kinds = [r["kind"] for r in out["events"]]
+        assert kinds == ["admission", "preemption"]
+        assert out["correlated"]["gangs"] == ["gang-high"]
+        assert len(out["narrative"]) == 2
+        assert "victims=['batch-a']" in out["narrative"][1]
+
+    def test_concurrent_writers_bounded_and_ordered(self):
+        """Writer torture: the ring stays hard-bounded, every publish is
+        accounted (kept + dropped), seq is globally unique, and each
+        writer's events appear in its own publish order."""
+        journal = EventJournal(capacity=256)
+        writers, per_writer = 8, 500
+        barrier = threading.Barrier(writers)
+
+        def hammer(w):
+            barrier.wait()
+            for i in range(per_writer):
+                journal.publish(
+                    "wire", "filter responded",
+                    pod=f"ns/w{w}", data={"i": i},
+                )
+
+        threads = [
+            threading.Thread(target=hammer, args=(w,))
+            for w in range(writers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        snap = journal.snapshot()
+        assert len(snap) == 256
+        assert journal.dropped == writers * per_writer - 256
+        seqs = [r["seq"] for r in snap]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        for w in range(writers):
+            mine = [r["data"]["i"] for r in snap if r["pod"] == f"ns/w{w}"]
+            assert mine == sorted(mine)
+
+
+class TestTraceBufferConcurrentCompletion:
+    def test_torture_bounded_and_slowest_sorted(self):
+        """Many completing requests racing into one TraceBuffer: the
+        recent ring and the top-K stay hard-bounded, the top-K comes out
+        duration-sorted, and it holds exactly the globally slowest
+        spans (each writer plants one known outlier)."""
+        buf = trace.TraceBuffer(capacity=128, slow_capacity=8)
+        writers, per_writer = 8, 200
+        barrier = threading.Barrier(writers)
+
+        def hammer(w):
+            barrier.wait()
+            for i in range(per_writer):
+                span = trace.Span("POST /t", f"w{w}-{i}")
+                # deterministic durations; one per-writer outlier
+                span.duration_s = 10.0 + w if i == 7 else (i % 50) * 1e-4
+                span.status = 200
+                buf.add(span)
+
+        threads = [
+            threading.Thread(target=hammer, args=(w,))
+            for w in range(writers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(buf) == 128
+        snap = buf.snapshot()
+        slow = snap["slowest"]
+        assert len(slow) == 8
+        durations = [s["duration_ms"] for s in slow]
+        assert durations == sorted(durations, reverse=True)
+        # the 8 planted outliers (10..17 s) beat every organic span
+        assert sorted(s["id"] for s in slow) == [
+            f"w{w}-7" for w in range(writers)
+        ]
+
+
+class _ExplainContract:
+    """The /debug/explain wire contract, shared by both front-ends."""
+
+    start = None  # front-end starter, set by subclasses
+
+    def _server(self):
+        ext, names = build_extender(16, device=True)
+        return type(self).start(ext), names
+
+    def test_contract(self):
+        server, names = self._server()
+        JOURNAL.reset()
+        try:
+            # no filter -> 400 with a usage hint
+            status, _, body = _get(server.port, "/debug/explain")
+            assert status == 400 and b"required" in body
+            # non-GET -> 405
+            status, _, _ = _raw(
+                server.port, _post("/debug/explain?pod=x", b"{}")
+            )
+            assert status == 405
+            # disabled journal -> 404 (the --events=off contract)
+            JOURNAL.configure(enabled=False)
+            try:
+                status, _, body = _get(
+                    server.port, "/debug/explain?pod=x"
+                )
+                assert status == 404 and b"disabled" in body
+            finally:
+                JOURNAL.configure(enabled=True)
+            # drive one real verb with a caller-chosen request id, then
+            # ask the spine about it: the wire event must come back
+            # under ?request_id= AND under ?pod=
+            body_bytes = make_bodies(names, "nodenames", count=1)[0]
+            pod = json.loads(body_bytes)["Pod"]["metadata"]
+            pod_key = f"{pod['namespace']}/{pod['name']}"
+            status, _, _ = _raw(
+                server.port,
+                _post(
+                    "/scheduler/prioritize", body_bytes,
+                    extra="X-Request-ID: explain-rid-1\r\n",
+                ),
+            )
+            assert status == 200
+            # the wire event publishes when the span lands in TRACES —
+            # just AFTER the response bytes go out; poll briefly so the
+            # reader never races the writer (test_observability.py
+            # _wait_for_span does the same)
+            deadline = time.time() + 5.0
+            while True:
+                status, _, body = _get(
+                    server.port,
+                    "/debug/explain?request_id=explain-rid-1",
+                )
+                assert status == 200
+                out = json.loads(body)
+                if any(
+                    e["kind"] == "wire"
+                    and e["event"] == "prioritize responded"
+                    and e["request_id"] == "explain-rid-1"
+                    for e in out["events"]
+                ):
+                    break
+                assert time.time() < deadline, out
+                time.sleep(0.005)
+            status, _, body = _get(
+                server.port, f"/debug/explain?pod={pod_key}"
+            )
+            assert status == 200
+            out = json.loads(body)
+            assert any(
+                e["request_id"] == "explain-rid-1" for e in out["events"]
+            )
+            assert out["narrative"]
+        finally:
+            server.shutdown()
+            JOURNAL.reset()
+
+
+class TestExplainThreaded(_ExplainContract):
+    start = staticmethod(_start_threaded)
+
+
+class TestExplainAsync(_ExplainContract):
+    start = staticmethod(_start_async)
+
+    def test_bypasses_admission_queue(self):
+        """/debug/explain is in DEBUG_ENDPOINTS, so the async front-end
+        serves it off the event loop even while the verb queue is
+        saturated — the same inheritance /debug/traces gets."""
+        from platform_aware_scheduling_tpu.serving.http import (
+            QUEUE_BYPASS_PATHS,
+        )
+
+        assert "/debug/explain" in QUEUE_BYPASS_PATHS
