@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record test-control test-admission test-explain bench-control bench-admission bench-replay test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record test-control test-admission test-explain test-solveobs bench-control bench-admission bench-replay bench-ledger test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
 
 all: test
 
@@ -149,6 +149,22 @@ test-admission:
 # front-ends, TraceBuffer top-K under concurrent completions
 test-explain:
 	python -m pytest tests/test_explain.py -q
+
+# solve observatory suite (docs/observability.md "Solve observatory"):
+# per-stage attribution sums to the measured total, churn edge cases
+# (first pass, delete, byte-identical refresh), /debug/solve codes on
+# both front-ends, off-path byte-identity, the recompile-watch twin
+# gate, and the perf-ledger anchor round trip
+test-solveobs:
+	python -m pytest tests/test_solveobs.py -q -m 'not slow'
+
+# perf-regression ledger: fresh per-stage solve floors + warm-verb
+# floor vs the COMMITTED anchor (benchmarks/perf_anchor.json), plus the
+# observatory instrumented-vs-off pin.  Report-only (shared runners
+# jitter); add --strict to gate, --write to re-anchor after an
+# intentional perf change (benchmarks/perf_ledger.py)
+bench-ledger:
+	python -m benchmarks.perf_ledger
 
 # the admission plane's head-to-head alone: preemption cascade ON vs
 # OFF through the real verbs + the quiet-diurnal null + gate overhead

@@ -561,6 +561,50 @@ def build_flight_recorder(args, extender, cache=None):
     return recorder
 
 
+def add_solveobs_flags(parser: argparse.ArgumentParser) -> None:
+    """Solve-observatory flag surface shared by both mains
+    (docs/observability.md "Solve observatory")."""
+    parser.add_argument("--solveObs", default="off",
+                        choices=["off", "on"],
+                        help="per-stage device-solve attribution "
+                        "(snapshot/transfer/compile/execute/readback/"
+                        "encode rings + pas_solve_stage_us histograms), "
+                        "refresh churn telemetry (changed rows per "
+                        "metric per pass, pas_state_churn_*), and the "
+                        "per-kernel recompile watch, served on GET "
+                        "/debug/solve.  Off instruments nothing — the "
+                        "solve pays one module-global read and the wire "
+                        "stays byte-identical")
+    parser.add_argument("--solveObsSize", type=int, default=256,
+                        help="solve-observatory sample ring capacity; "
+                        "overflow drops the OLDEST sample (stage "
+                        "histograms keep the full history)")
+
+
+def build_solve_observatory(args, extender, cache=None):
+    """The SolveObservatory for --solveObs=on (None when off), installed
+    in the process-wide ``ops.solveobs.ACTIVE`` slot (the instrumented
+    sites span layers that never see the extender) and attached as
+    ``extender.solveobs`` for the /debug/solve route.  With a telemetry
+    ``cache`` (TAS), one ``on_refresh_pass`` subscription drains the
+    mirror's per-metric churn counts into histograms, the causal spine,
+    and — when a flight recorder is also wired — the capture, so churn
+    accounting costs nothing on the request path."""
+    if getattr(args, "solveObs", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.ops import solveobs
+
+    observatory = solveobs.enable(
+        capacity=getattr(args, "solveObsSize", 256)
+    )
+    observatory.mirror = getattr(extender, "mirror", None)
+    observatory.flight = getattr(extender, "flight", None)
+    extender.solveobs = observatory
+    if cache is not None:
+        cache.on_refresh_pass.append(observatory.flush_refresh_pass)
+    return observatory
+
+
 def slo_period(args, default_s: float) -> float:
     """The --sloPeriod in seconds (default: the caller's sync period)."""
     raw = getattr(args, "sloPeriod", "")

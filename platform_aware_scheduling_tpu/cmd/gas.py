@@ -61,6 +61,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_slo_flags(parser)
     common.add_control_flags(parser)
     common.add_record_flags(parser)
+    common.add_solveobs_flags(parser)
     return parser
 
 
@@ -107,6 +108,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # flight recorder (--flightRecorder=on): verb arrivals only — GAS
     # has no telemetry cache, so no decile/control events here
     common.build_flight_recorder(args, extender)
+    # solve observatory (--solveObs=on): GAS has no telemetry mirror, so
+    # no churn passes — the device binpack solves still attribute stages
+    common.build_solve_observatory(args, extender)
 
     from platform_aware_scheduling_tpu.cmd.tas import build_server
     from platform_aware_scheduling_tpu.utils.duration import parse_duration

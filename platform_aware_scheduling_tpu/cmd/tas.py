@@ -115,6 +115,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_slo_flags(parser)
     common.add_control_flags(parser)
     common.add_record_flags(parser)
+    common.add_solveobs_flags(parser)
     return parser
 
 
@@ -397,6 +398,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     # POST /debug/whatif.  Off (the default) builds nothing — the verbs
     # skip one attribute check and the wire stays byte-identical
     common.build_flight_recorder(args, extender, cache=cache)
+
+    # solve observatory (--solveObs=on; docs/observability.md "Solve
+    # observatory"): per-stage solve attribution + refresh churn behind
+    # GET /debug/solve.  Built AFTER the flight recorder so churn passes
+    # ride an enabled capture.  Off (the default) builds nothing — the
+    # solve pays one module-global read and the wire stays byte-identical
+    common.build_solve_observatory(args, extender, cache=cache)
 
     common.maybe_start_profiler(args.profilePort)
     common.start_device_watch(stop=stop)

@@ -93,6 +93,7 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/profile", "description": "bounded jax.profiler capture: ?ms=<window> (404 when unavailable)"},
     {"path": "/debug/explain", "description": "causal event spine: the ordered event chain + narrative for one entity; filters: ?pod=<ns/name>&gang=<id>&request_id=<id>&node=<name> (404 when --events=off)"},
     {"path": "/debug/record", "description": "flight-recorder capture as versioned JSONL: anonymized verb arrivals, telemetry deciles, eviction/leader events, spine passthrough (404 when --flightRecorder=off)"},
+    {"path": "/debug/solve", "description": "solve observatory: per-stage solve attribution (snapshot/transfer/compile/execute/readback/encode), refresh churn per metric, recompile watch (404 when --solveObs=off)"},
     {"path": "/debug/whatif", "method": "POST", "description": "twin replay of a capture under transform knobs (load_multiplier, remove_nodes, thresholds): projected SLO verdicts + budget ledgers (404 when --flightRecorder=off)"},
 ]
 
@@ -539,6 +540,23 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=controller.to_json(),
+            )
+        if bare_path == "/debug/solve":
+            # solve observatory (ops/solveobs.py): per-stage attribution
+            # rings, refresh churn, recompile watch; 404 when no
+            # observatory is wired (--solveObs=off), same convention
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            observatory = getattr(self.scheduler, "solveobs", None)
+            if observatory is None:
+                return HTTPResponse.json(
+                    b'{"error": "solve observatory not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=observatory.to_json(),
             )
         if bare_path == "/debug/wire":
             # wire-path cache state (tas/fastpath.py wire_debug): interned

@@ -25,7 +25,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops import i64, solveobs
 from platform_aware_scheduling_tpu.ops.assign import (
     AssignResult,
     auction_assign_kernel,
@@ -118,6 +118,41 @@ def scheduling_step(state: ClusterState, pods: PendingPods) -> ScheduleOutput:
     return ScheduleOutput(
         assignment=assignment, violating=violating, score=score, eligible=eligible
     )
+
+
+def observed_scheduling_step(
+    state: ClusterState, pods: PendingPods, timer=None
+) -> ScheduleOutput:
+    """``scheduling_step`` with solve-observatory stage attribution.
+
+    When no observatory is enabled (and no caller-owned timer is
+    passed) this is exactly one extra ``is None`` check around the
+    plain call — the planner routes through here unconditionally so the
+    off path stays byte-identical.  With a timer the call is bracketed
+    with ``compile``/``execute`` marks: compile when the jit cache grew
+    during the dispatch, execute timed across ``block_until_ready`` so
+    XLA's async dispatch cannot launder device time into the caller's
+    readback.  The caller keeps ownership of the timer — its readback
+    and encode happen on its side of the fence."""
+    own = timer is None
+    if own:
+        obs = solveobs.ACTIVE
+        if obs is None:
+            return scheduling_step(state, pods)
+        timer = obs.begin("batch_solve")
+    before = scheduling_step._cache_size()
+    out = scheduling_step(state, pods)
+    timer.mark(
+        "compile" if scheduling_step._cache_size() > before else "execute"
+    )
+    jax.block_until_ready(out.assignment.node_for_pod)
+    timer.mark("execute")
+    if own:
+        timer.done(
+            pods=int(pods.metric_row.shape[0]),
+            nodes=int(state.capacity.shape[0]),
+        )
+    return out
 
 
 def example_inputs(

@@ -48,7 +48,7 @@ from platform_aware_scheduling_tpu.models.batch_scheduler import (
     PendingPods,
     score_and_filter,
 )
-from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops import i64, solveobs
 from platform_aware_scheduling_tpu.ops.assign import lex_argmin
 from platform_aware_scheduling_tpu.ops.binpack import (
     BinpackNodeState,
@@ -246,3 +246,40 @@ def fused_schedule(
         fits=fits,
         violating=violating,
     )
+
+
+def observed_fused_schedule(
+    state: ClusterState,
+    pods: PendingPods,
+    req_class: jax.Array,
+    gas: BinpackNodeState,
+    requests: FusedRequests,
+    max_gpus: int,
+    timer=None,
+) -> FusedOutput:
+    """``fused_schedule`` with solve-observatory stage attribution — the
+    same caller-owned-timer contract as
+    ``models.batch_scheduler.observed_scheduling_step``: compile when
+    the jit cache grew during the dispatch, execute across
+    ``block_until_ready``; readback/encode belong to the caller."""
+    own = timer is None
+    if own:
+        obs = solveobs.ACTIVE
+        if obs is None:
+            return fused_schedule(
+                state, pods, req_class, gas, requests, max_gpus
+            )
+        timer = obs.begin("fused_solve")
+    before = fused_schedule._cache_size()
+    out = fused_schedule(state, pods, req_class, gas, requests, max_gpus)
+    timer.mark(
+        "compile" if fused_schedule._cache_size() > before else "execute"
+    )
+    jax.block_until_ready(out.node_for_pod)
+    timer.mark("execute")
+    if own:
+        timer.done(
+            pods=int(pods.metric_row.shape[0]),
+            nodes=int(state.capacity.shape[0]),
+        )
+    return out

@@ -26,7 +26,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops import i64, solveobs
 from platform_aware_scheduling_tpu.ops.rules import OP_IDS, RuleSet
 from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
 
@@ -289,6 +289,13 @@ class TensorStateMirror:
         # pass runs in the state-refresh thread, never on a request
         # (reference refresh loop: cmd/main.go:76-78)
         self.on_state_change: List = []
+        # per-metric churn since the last drain: metric name ->
+        # [changed columns, saw-delete flag].  Written only while a solve
+        # observatory is enabled (ops/solveobs.ACTIVE), under the mirror
+        # lock the writer already holds — no extra locking on the write
+        # path; drained per refresh pass by the observatory's
+        # cache.on_refresh_pass hook
+        self._churn_pending: Dict[str, List[int]] = {}
 
     # -- wiring ---------------------------------------------------------------
 
@@ -393,6 +400,20 @@ class TensorStateMirror:
                 or not np.array_equal(self._present[row], new_present)
                 or not np.array_equal(self._values[row], new_values)
             )
+            if solveobs.ACTIVE is not None:
+                # churn telemetry: how many node columns this write
+                # actually moved.  A freshly interned row is all-zero /
+                # all-absent, so a metric's FIRST pass naturally counts
+                # every present column (full churn — to a cold solver the
+                # whole row is news); a byte-identical refresh counts 0.
+                moved = int(
+                    np.count_nonzero(
+                        (self._values[row] != new_values)
+                        | (self._present[row] != new_present)
+                    )
+                )
+                entry = self._churn_pending.setdefault(metric_name, [0, 0])
+                entry[0] += moved
             self._host_only_metrics[metric_name] = host_only
             if changed:
                 self._values[row] = new_values
@@ -408,6 +429,13 @@ class TensorStateMirror:
             self._host_only_metrics.pop(metric_name, None)
             if row is not None:
                 deleted = True
+                if solveobs.ACTIVE is not None:
+                    # a delete churns every column it tears down
+                    entry = self._churn_pending.setdefault(
+                        metric_name, [0, 0]
+                    )
+                    entry[0] += int(np.count_nonzero(self._present[row]))
+                    entry[1] = 1
                 self._present[row, :] = False
                 self._free_metric_rows.append(row)
                 self._version += 1
@@ -435,6 +463,22 @@ class TensorStateMirror:
         with self._lock:
             self._policies.pop((namespace, name), None)
             self._policy_sources.pop((namespace, name), None)
+
+    def drain_churn(self) -> Tuple[Dict[str, Tuple[int, bool]], int]:
+        """Take (and reset) the per-metric churn accumulated since the
+        last drain, plus the current world size.  Called once per refresh
+        pass by the solve observatory's ``cache.on_refresh_pass`` hook."""
+        with self._lock:
+            pending = self._churn_pending
+            self._churn_pending = {}
+            world = len(self._node_names)
+        return (
+            {
+                metric: (changed, bool(deleted))
+                for metric, (changed, deleted) in pending.items()
+            },
+            world,
+        )
 
     # -- policy compilation ---------------------------------------------------
 
@@ -562,11 +606,27 @@ class TensorStateMirror:
     def _view_locked(self) -> DeviceView:
         if self._view is not None and self._view.version == self._version:
             return self._view
+        obs = solveobs.ACTIVE
+        timer = obs.begin("view_build") if obs is not None else None
         hi, lo = i64.split_int64_np(self._values)
+        present_host = self._present.copy()
+        values_milli = self._values.copy()
+        if timer is not None:
+            timer.mark("snapshot")
+        values = i64.I64(hi=jnp.asarray(hi), lo=jnp.asarray(lo))
+        present = jnp.asarray(present_host)
+        if timer is not None:
+            # jnp.asarray may return before the upload lands; block so
+            # the transfer stage carries its real cost, not dispatch time
+            try:
+                present.block_until_ready()
+            except Exception:
+                pass
+            timer.mark("transfer")
         rows = self._values.shape[0]
         self._view = DeviceView(
-            values=i64.I64(hi=jnp.asarray(hi), lo=jnp.asarray(lo)),
-            present=jnp.asarray(self._present.copy()),
+            values=values,
+            present=present,
             node_names=list(self._node_names),
             node_index=dict(self._node_index),
             version=self._version,
@@ -574,7 +634,10 @@ class TensorStateMirror:
                 self._row_versions.get(r, 0) for r in range(rows)
             ),
             intern_version=self._intern_version,
-            values_milli=self._values.copy(),
+            values_milli=values_milli,
             metric_index=dict(self._metric_index),
         )
+        if timer is not None:
+            timer.mark("encode")
+            timer.done(rows=rows, nodes=len(self._node_names))
         return self._view

@@ -41,6 +41,7 @@ from platform_aware_scheduling_tpu.ops.scoring import (
     filter_explain_kernel,
     prioritize_kernel,
 )
+from platform_aware_scheduling_tpu.ops import solveobs
 from platform_aware_scheduling_tpu.ops.state import CompiledPolicy, DeviceView
 from platform_aware_scheduling_tpu.utils import decisions, trace
 from platform_aware_scheduling_tpu.utils import labels as shared_labels
@@ -238,6 +239,11 @@ class PrioritizeFastPath:
         key = (view.row_version(row), row, op)
         ranked = self._rank.get(key)
         if ranked is None:
+            obs = solveobs.ACTIVE
+            timer = obs.begin("prioritize_rank") if obs is not None else None
+            compiled_before = (
+                prioritize_kernel.cache_size() if timer is not None else 0
+            )
             # ONE device pass ranks all nodes; every request until this
             # row's next content change reuses it
             res = prioritize_kernel(
@@ -247,10 +253,24 @@ class PrioritizeFastPath:
                 jnp.int32(op),
                 jnp.ones(view.node_capacity, dtype=bool),
             )
+            if timer is not None:
+                # attribute the dispatch to compile when the jit cache
+                # grew during the call, then block so execute carries the
+                # device time instead of hiding inside the readback
+                grew = prioritize_kernel.cache_size() > compiled_before
+                timer.mark("compile" if grew else "execute")
+                res.perm.block_until_ready()
+                timer.mark("execute")
             count = int(res.valid_count)
-            ranked = np.asarray(res.perm)[:count].astype(np.int64)
+            ranked = np.asarray(res.perm)[:count]
+            if timer is not None:
+                timer.mark("readback")
+            ranked = ranked.astype(np.int64)
             with self._lock:
                 self._rank[key] = ranked
+            if timer is not None:
+                timer.mark("encode")
+                timer.done(nodes=view.node_capacity)
         return ranked
 
     def warm_rankings_batched(self, view: DeviceView, pairs) -> int:
@@ -270,19 +290,35 @@ class PrioritizeFastPath:
         ]
         if not missing:
             return 0
-        res = batch_prioritize_kernel(
-            view.values,
-            view.present,
-            jnp.asarray([row for row, _ in missing], dtype=jnp.int32),
-            jnp.asarray([op for _, op in missing], dtype=jnp.int32),
-            jnp.ones((len(missing), view.node_capacity), dtype=bool),
+        obs = solveobs.ACTIVE
+        timer = obs.begin("warm_batch") if obs is not None else None
+        compiled_before = (
+            batch_prioritize_kernel.cache_size() if timer is not None else 0
         )
+        rows_dev = jnp.asarray([row for row, _ in missing], dtype=jnp.int32)
+        ops_dev = jnp.asarray([op for _, op in missing], dtype=jnp.int32)
+        mask_dev = jnp.ones((len(missing), view.node_capacity), dtype=bool)
+        if timer is not None:
+            timer.mark("transfer")
+        res = batch_prioritize_kernel(
+            view.values, view.present, rows_dev, ops_dev, mask_dev
+        )
+        if timer is not None:
+            grew = batch_prioritize_kernel.cache_size() > compiled_before
+            timer.mark("compile" if grew else "execute")
+            res.perm.block_until_ready()
+            timer.mark("execute")
         perms = np.asarray(res.perm)
         counts = np.asarray(res.valid_count)
+        if timer is not None:
+            timer.mark("readback")
         with self._lock:
             for i, (row, op) in enumerate(missing):
                 key = (view.row_version(row), row, op)
                 self._rank[key] = perms[i][: int(counts[i])].astype(np.int64)
+        if timer is not None:
+            timer.mark("encode")
+            timer.done(pairs=len(missing), nodes=view.node_capacity)
         return len(missing)
 
     def warm_pairs(self, view: DeviceView, pairs) -> None:
@@ -810,18 +846,33 @@ class PrioritizeFastPath:
         device_rules = compiled.device_rules("dontschedule")
         if device_rules is None:
             return None
+        obs = solveobs.ACTIVE
+        timer = obs.begin("filter_explain") if obs is not None else None
+        compiled_before = (
+            filter_explain_kernel.cache_size() if timer is not None else 0
+        )
         res = filter_explain_kernel(
             view.values,
             view.present,
             device_rules,
             jnp.ones(view.node_capacity, dtype=bool),
         )
+        if timer is not None:
+            grew = filter_explain_kernel.cache_size() > compiled_before
+            timer.mark("compile" if grew else "execute")
+            res.first_rule.block_until_ready()
+            timer.mark("execute")
         first_rule = np.asarray(res.first_rule)
+        if timer is not None:
+            timer.mark("readback")
         rows = np.nonzero(first_rule >= 0)[0]
         cached = (
             frozenset(int(i) for i in rows),
             {int(i): int(first_rule[i]) for i in rows},
         )
+        if timer is not None:
+            timer.mark("encode")
+            timer.done(nodes=view.node_capacity)
         with self._lock:
             # a concurrent computer may have won: keep ITS set so the
             # identity-keyed response caches see one object per state

@@ -28,10 +28,10 @@ from platform_aware_scheduling_tpu.kube.objects import Pod, object_key
 from platform_aware_scheduling_tpu.models.batch_scheduler import (
     ClusterState,
     PendingPods,
-    scheduling_step,
+    observed_scheduling_step,
     score_and_filter,
 )
-from platform_aware_scheduling_tpu.ops import i64
+from platform_aware_scheduling_tpu.ops import i64, solveobs
 from platform_aware_scheduling_tpu.ops.rules import OP_IDS, RuleSet
 from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
 from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
@@ -199,6 +199,8 @@ class BatchPlanner:
             with self._lock:
                 self._plan = {}
             return 0
+        obs = solveobs.ACTIVE
+        timer = obs.begin("replan") if obs is not None else None
         n_cap = view.node_capacity
         p = len(compiled_rows)
         metric_row = np.array([r for _, r, _ in compiled_rows], dtype=np.int32)
@@ -209,17 +211,22 @@ class BatchPlanner:
         # known node is a candidate (kube-scheduler's own predicates will
         # re-check its side)
         dontschedule = self._merged_dontschedule(pods, policies)
+        remaining = self._remaining_capacity(view)
+        if timer is not None:
+            timer.mark("snapshot")
         state = ClusterState(
             metric_values=view.values,
             metric_present=view.present,
             dontschedule=dontschedule,
-            capacity=jnp.asarray(self._remaining_capacity(view)),
+            capacity=jnp.asarray(remaining),
         )
         batch = PendingPods(
             metric_row=jnp.asarray(metric_row),
             op_id=jnp.asarray(op_id),
             candidates=jnp.asarray(candidates),
         )
+        if timer is not None:
+            timer.mark("transfer")
         if self.solver == "sinkhorn":
             from platform_aware_scheduling_tpu.ops.sinkhorn import (
                 sinkhorn_assign_kernel,
@@ -227,10 +234,14 @@ class BatchPlanner:
 
             _violating, score, eligible = score_and_filter(state, batch)
             sink = sinkhorn_assign_kernel(score, eligible, state.capacity)
+            if timer is not None:
+                timer.mark("execute")
             assigned = np.asarray(sink.assignment.node_for_pod)
         else:
-            out = scheduling_step(state, batch)
+            out = observed_scheduling_step(state, batch, timer=timer)
             assigned = np.asarray(out.assignment.node_for_pod)
+        if timer is not None:
+            timer.mark("readback")
         plan: Dict[str, Tuple[str, int]] = {}
         for i, (key, _row, _op) in enumerate(compiled_rows):
             node_idx = int(assigned[i])
@@ -239,6 +250,9 @@ class BatchPlanner:
         with self._lock:
             self._plan = plan
             self._plan_version = view.version
+        if timer is not None:
+            timer.mark("encode")
+            timer.done(pods=p, nodes=len(view.node_names))
         klog.v(4).info_s(
             f"batch plan: {len(plan)}/{p} pods assigned", component="planner"
         )

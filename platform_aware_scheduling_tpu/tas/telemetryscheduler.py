@@ -58,6 +58,7 @@ from platform_aware_scheduling_tpu.ops.state import (
     DeviceView,
     TensorStateMirror,
 )
+from platform_aware_scheduling_tpu.ops import solveobs
 from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache, CacheMissError
 from platform_aware_scheduling_tpu.tas import degraded as degraded_mode
 from platform_aware_scheduling_tpu.native import get_wirec
@@ -163,6 +164,16 @@ class MetricsExtender:
         # tests/test_record.py.  NOT self.recorder: that name is the
         # latency-histogram LatencyRecorder above.
         self.flight = None
+        # opt-in ops.solveobs.SolveObservatory, set by assembly when
+        # --solveObs=on: per-stage device-solve attribution rings +
+        # refresh churn telemetry, served at GET /debug/solve (404 while
+        # this is None).  The instrumented sites gate on the module
+        # global ops.solveobs.ACTIVE (the pipeline spans layers that
+        # never see this extender); this attribute only routes the debug
+        # endpoint and documents ownership.  Off (None) costs the solve
+        # one module-global read and keeps the wire byte-identical —
+        # pinned by tests/test_solveobs.py.
+        self.solveobs = None
         # opt-in tas.degraded.DegradedModeController, set by assembly:
         # when telemetry goes stale or a circuit opens, Filter fails
         # open/closed per --degradedMode and Prioritize degrades to
@@ -217,6 +228,8 @@ class MetricsExtender:
         fastpath = self.fastpath
         if fastpath is None:
             return
+        obs = solveobs.ACTIVE
+        warm_t0 = obs.clock() if obs is not None else 0.0
         try:
             policies, view, host_only_map = self.mirror.policies_snapshot()
 
@@ -257,6 +270,23 @@ class MetricsExtender:
                 # negative version markers can never collide with them
                 self.warm_forecast_rankings()
             self._warmed = True
+            if obs is not None:
+                # the warm pass is the production solve cadence: one
+                # "solve" event per pass into the causal spine, so
+                # /debug/explain narratives can place verb answers
+                # relative to when their rankings were recomputed
+                events.JOURNAL.publish(
+                    "solve",
+                    "fastpath warmed",
+                    data={
+                        "pairs": len(pairs),
+                        "policies": len(policies),
+                        "version": view.version,
+                        "duration_us": round(
+                            (obs.clock() - warm_t0) * 1e6, 1
+                        ),
+                    },
+                )
         except Exception as exc:  # warming must never break the writer
             klog.error("fastpath warm failed: %s", exc)
 

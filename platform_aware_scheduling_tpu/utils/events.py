@@ -48,7 +48,14 @@ KINDS = (
     "control",     # budget-controller actuations (utils/control.py)
     "slo",         # SLO state flips (utils/slo.py)
     "serving",     # dispatcher-level sheds (serving/dispatcher.py)
+    "churn",       # refresh-pass churn: rows changed / world (ops/solveobs.py)
+    "solve",       # fastpath warm passes: the solve cadence (tas/)
 )
+
+#: kinds that describe the WORLD rather than any one entity: explain()
+#: joins them into a chain by tick, not by correlation key, so a pod's
+#: narrative can say "the state changed under you between these events"
+CONTEXT_KINDS = ("churn", "solve")
 
 
 def _anon_corr(request_id: str, pod: str, gang: str, node: str) -> str:
@@ -221,6 +228,22 @@ class EventJournal:
 
         chain = [r for r in events if correlated(r)]
         chain.sort(key=lambda r: r["seq"])
+        # "the world changed under you": churn/solve events carry no
+        # entity keys, so they join by TICK — any context event sharing
+        # a tick with the chain rides along (the refresh that moved the
+        # state between a pod's enqueue and its verdict is causal
+        # context even though it names no pod)
+        ticks = {r["tick"] for r in chain if r["tick"] >= 0}
+        in_chain = {r["seq"] for r in chain}
+        context = [
+            r
+            for r in events
+            if r["kind"] in CONTEXT_KINDS
+            and r["tick"] >= 0
+            and r["tick"] in ticks
+            and r["seq"] not in in_chain
+        ]
+        context.sort(key=lambda r: r["seq"])
         trace.COUNTERS.inc("pas_explain_requests_total")
         trace.COUNTERS.set_gauge("pas_explain_chain_events", len(chain))
         return {
@@ -237,6 +260,8 @@ class EventJournal:
             },
             "events": chain,
             "narrative": [_narrate(r) for r in chain],
+            "context": context,
+            "context_narrative": [_narrate(r) for r in context],
             "dropped": self.dropped,
         }
 

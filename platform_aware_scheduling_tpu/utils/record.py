@@ -49,9 +49,12 @@ from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 #: replay loader can refuse captures it would misread.  /2 added the
 #: causal-spine passthrough events (kind "spine": utils/events.py
 #: forwards journal events with an irreversible process-local
-#: correlation hash); loaders that fold a capture into a twin scenario
-#: ignore kinds they don't infer from, so /2 stays replayable.
-FORMAT = "pas-flight-record/2"
+#: correlation hash); /3 added refresh-churn summaries (kind "churn":
+#: counts + fraction-of-world per pass, ops/solveobs.py — replayed
+#: captures carry production churn shape for ROADMAP item 4's
+#: delta-aware staging).  Loaders that fold a capture into a twin
+#: scenario ignore kinds they don't infer from, so both stay replayable.
+FORMAT = "pas-flight-record/3"
 
 DEFAULT_CAPACITY = 4096
 
@@ -200,6 +203,25 @@ class FlightRecorder:
                 "event": str(event),
                 "tick": int(tick),
                 "corr": str(corr),
+            }
+        )
+
+    def record_churn(
+        self, metrics: int, rows: int, world: int, fraction: float
+    ) -> None:
+        """One refresh pass's churn shape (ops/solveobs.py flushes this
+        while an observatory is wired next to the recorder).
+        Anonymization holds by construction: counts and a fraction, no
+        metric or node names — the pass SHAPE replays, nothing joins
+        back to a cluster."""
+        self._append(
+            {
+                "t": round(self.clock(), 6),
+                "kind": "churn",
+                "metrics": int(metrics),
+                "rows": int(rows),
+                "world": int(world),
+                "fraction": round(float(fraction), 4),
             }
         )
 

@@ -105,6 +105,16 @@ declare("pas_jax_kernel_compile_total", "counter", "Lowerings of watched scoring
 declare("pas_jax_retrace_total", "counter", "Watched-kernel lowerings past each kernel's first compile: unexpected hot-path retraces.")
 declare("pas_jax_backend_compile_total", "counter", "Process-wide XLA backend compilations (jax.monitoring).")
 declare("pas_jax_compile_seconds_total", "counter", "Process-wide seconds spent in XLA backend compilation.")
+declare("pas_xla_compiles_total", "counter", "Jit cache growth per watched kernel (label: fn) — the recompile watch; steady state after warmup must be flat (ops/solveobs.py).")
+# solve observatory (ops/solveobs.py; --solveObs=on): per-stage device-
+# solve attribution + refresh churn.  Families emitted only while an
+# observatory is enabled — the flight recorder's off-path convention.
+declare("pas_solve_stage_us", "histogram", "Per-stage solve latency in microseconds (label: stage — snapshot/transfer/compile/execute/readback/encode).")
+declare("pas_solve_samples_total", "counter", "Instrumented solves committed to the observatory ring (label: kind).")
+declare("pas_state_churn_rows", "histogram", "Node columns changed per metric per refresh pass (label: metric); zero has its own bucket.")
+declare("pas_state_churn_fraction", "histogram", "Changed columns as a fraction of world size per metric per refresh pass (label: metric).")
+declare("pas_state_churn_passes_total", "counter", "Refresh passes whose churn the observatory flushed.")
+declare("pas_state_churn_rows_changed_total", "counter", "Total node columns changed across all flushed refresh passes.")
 # trace buffer health
 declare("pas_traces_recorded_total", "counter", "Completed spans recorded into the trace ring buffer.")
 # health & readiness (utils/health.py: /healthz + /readyz on both front-ends)
@@ -577,6 +587,23 @@ class _JitWatch:
         self._lock = threading.Lock()
         self._seen = 0
 
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def compile_count(self) -> int:
+        """Lowerings seen so far — the recompile watch's per-kernel
+        reading, also served on /debug/solve."""
+        with self._lock:
+            return self._seen
+
+    def cache_size(self) -> int:
+        """The wrapped kernel's live jit-cache size (no lock: jax's own
+        accounting) — instrumented solve sites diff this around a call
+        to attribute compile time to the ``compile`` stage."""
+        return self._fn._cache_size()
+
     def __call__(self, *args, **kwargs):
         out = self._fn(*args, **kwargs)
         size = self._fn._cache_size()
@@ -588,6 +615,9 @@ class _JitWatch:
                 first = self._seen == 0
                 self._seen = size
             self._counters.inc("pas_jax_kernel_compile_total", grew)
+            self._counters.inc(
+                "pas_xla_compiles_total", grew, labels={"fn": self._name}
+            )
             retraces = grew - 1 if first else grew
             if retraces > 0:
                 self._counters.inc("pas_jax_retrace_total", retraces)
@@ -603,12 +633,19 @@ class _JitWatch:
         return getattr(self._fn, item)
 
 
+#: every _JitWatch in creation order — the recompile watch's roster:
+#: /debug/solve reports each watched kernel's lowering count from here
+JIT_WATCHES: List[_JitWatch] = []
+
+
 def watch_jit(name: str, fn, counters: Optional[CounterSet] = None):
     """Wrap a jitted callable with the retrace shim; a callable without a
     jit cache (older jax, plain function) passes through untouched."""
     if not hasattr(fn, "_cache_size"):
         return fn
-    return _JitWatch(name, fn, counters if counters is not None else COUNTERS)
+    watch = _JitWatch(name, fn, counters if counters is not None else COUNTERS)
+    JIT_WATCHES.append(watch)
+    return watch
 
 
 # ---------------------------------------------------------------------------
