@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-record test-control test-admission test-explain test-solveobs bench-control bench-admission bench-replay bench-ledger test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin bench-shard test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-shard test-record test-control test-admission test-explain test-solveobs bench-control bench-admission bench-replay bench-ledger test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
 
 all: test
 
@@ -114,6 +114,21 @@ test-slo:
 # nodes, verdicts = the SLO engine's judgment (testing/twin.py)
 bench-twin:
 	python -m benchmarks.twin_load
+
+# partition plane suite (docs/sharding.md): partition math +
+# rendezvous determinism, journaled/fenced ownership incl. heartbeat
+# renewal and lost write races, digest build/fencing/staleness, the
+# scatter/gather plane, /debug/shard wire codes on both front-ends,
+# off-path byte-identity, and the partitioned HA harness
+test-shard:
+	python -m pytest tests/test_shard.py -q -m 'not slow'
+
+# sharded scale-out A/B alone: 4 partition-owner subprocesses vs one
+# full-world replica — aggregate Filter rps and the measured ~1/P
+# per-replica refresh cut (benchmarks/shard_load.py); exits nonzero
+# unless both halves of the bet hold
+bench-shard:
+	python -m benchmarks.shard_load
 
 # flight recorder + trace replay + what-if suite (docs/observability.md
 # "Flight recorder & what-if"): anonymization sweep over real sockets,

@@ -171,6 +171,7 @@ def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
     chaos=None, decisions=None, gang=None, forecast=None, ha=None,
     twin=None, record=None, control=None, admission=None, ledger=None,
+    shard=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -335,6 +336,26 @@ def assemble_line(
                 f"{fo.get('evictions')}/{fo.get('evictions_baseline')}"
             ),
             "duplicate_evictions": fo.get("duplicate_evictions"),
+        }
+    if shard is not None:
+        # full per-owner drive dicts + refresh accounting to disk; the
+        # line keeps the scale-out bet: aggregate Filter rps across the
+        # partition owners vs one full-world replica, and the measured
+        # per-replica refresh fraction vs the 1/P ideal — the ISSUE 19
+        # acceptance surface (benchmarks/shard_load.py; docs/sharding.md)
+        detail["shard"] = shard
+        result["shard"] = {
+            "num_nodes": shard.get("num_nodes"),
+            "partitions": shard.get("partitions"),
+            "rps_ratio_sharded_vs_full": shard.get(
+                "rps_ratio_sharded_vs_full"
+            ),
+            "aggregate_requests_per_s": shard.get(
+                "aggregate_requests_per_s"
+            ),
+            "refresh_fraction_mean": shard.get("refresh_fraction_mean"),
+            "refresh_fraction_ideal": shard.get("refresh_fraction_ideal"),
+            "passed": shard.get("passed"),
         }
     if twin is not None:
         # full per-scenario verdicts (checks + SLO judgments) to disk;
@@ -679,6 +700,29 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"ha bench failed: {exc}", file=sys.stderr)
 
+    # --- partition plane: 4 partition-owner subprocesses vs one
+    # full-world replica — aggregate Filter rps + the measured ~1/P
+    # per-replica refresh cut (benchmarks/shard_load.py;
+    # docs/sharding.md) ---
+    shard_out = None
+    try:
+        from benchmarks import shard_load
+
+        shard_out = shard_load.run()
+        print(
+            f"shard: {shard_out['num_nodes']} nodes / "
+            f"{shard_out['partitions']} partitions — aggregate "
+            f"{shard_out['aggregate_requests_per_s']} rps = "
+            f"x{shard_out['rps_ratio_sharded_vs_full']} vs full-world "
+            f"{shard_out['baseline']['requests_per_s']} rps; refresh "
+            f"fraction {shard_out['refresh_fraction_mean']} "
+            f"(ideal {shard_out['refresh_fraction_ideal']}); "
+            f"passed={shard_out['passed']}",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"shard bench failed: {exc}", file=sys.stderr)
+
     # --- digital twin: the SLO-gated scenario matrix at 10k nodes
     # (benchmarks/twin_load.py; docs/observability.md "SLOs & error
     # budgets") ---
@@ -816,7 +860,7 @@ def main():
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
         decisions_out, gang, forecast_out, ha_out, twin_out, record_out,
-        control_out, admission_out, ledger_out,
+        control_out, admission_out, ledger_out, shard_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

@@ -249,6 +249,40 @@ def check_front_end(serving: str) -> str:
             f"{serving}: wired plane's families missing from /metrics"
         )
         assert "pas_admission_queue_depth" in families
+        # partition plane: 404 while off (--shard=off), then 200 with
+        # ownership + digest state once a plane is attached — and its
+        # pas_shard_* families appear on /metrics only from that moment
+        assert "/debug/shard" in paths, f"{serving}: index missing shard"
+        status, _payload = _get(port, "/debug/shard")
+        assert status == 404, (
+            f"{serving}: /debug/shard must 404 while off -> {status}"
+        )
+        status, payload = _get(port, "/metrics")
+        assert "pas_shard_ticks_total" not in payload.decode()
+        from platform_aware_scheduling_tpu.shard import ShardPlane
+
+        shard = ShardPlane(
+            "smoke-replica",
+            2,
+            kube_client=None,
+            static_owners={0: "smoke-replica", 1: "smoke-replica"},
+        )
+        shard.attach(server.scheduler.cache, server.scheduler.mirror)
+        server.scheduler.shard = shard
+        shard.on_refresh_pass()
+        status, payload = _get(port, "/debug/shard")
+        assert status == 200, f"{serving}: /debug/shard -> {status}"
+        shard_snap = json.loads(payload)
+        assert shard_snap["identity"] == "smoke-replica"
+        assert shard_snap["coordinator"]["owned"] == [0, 1], shard_snap
+        assert shard_snap["digests"], (
+            f"{serving}: refresh pass published no digests: {shard_snap}"
+        )
+        status, payload = _get(port, "/metrics")
+        families = trace.parse_prometheus_text(payload.decode())
+        assert "pas_shard_ticks_total" in families, (
+            f"{serving}: wired plane's families missing from /metrics"
+        )
         # wire-path caches: 200 with universe/skeleton state on a device
         # extender (404 belongs to host-only assemblies, pinned in tests)
         assert "/debug/wire" in paths, f"{serving}: index missing wire"

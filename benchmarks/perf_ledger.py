@@ -70,6 +70,67 @@ def _tolerance_pct(values: List[float]) -> float:
     return round(min(TOL_MAX_PCT, max(TOL_MIN_PCT, 300.0 * iqr / med)), 1)
 
 
+def _measure_shard(num_nodes: int, reps: int) -> Dict[str, List[float]]:
+    """{shard_refresh_pass, shard_digest_build} sample lists (µs): a
+    hermetic one-owner partition plane (static owner map, 4 partitions)
+    over a seeded cache — the same assembly benchmarks/shard_load.py
+    spawns per subprocess, minus the sockets."""
+    from benchmarks.http_load import _policy_obj, node_names
+    from platform_aware_scheduling_tpu.ops.state import TensorStateMirror
+    from platform_aware_scheduling_tpu.shard import ShardPlane
+    from platform_aware_scheduling_tpu.shard.digest import (
+        build_partition_digests,
+    )
+    from platform_aware_scheduling_tpu.tas.cache import AutoUpdatingCache
+    from platform_aware_scheduling_tpu.tas.policy.v1alpha1 import TASPolicy
+    from platform_aware_scheduling_tpu.testing.faults import FakeMetricsClient
+
+    names = node_names(num_nodes)
+    cache = AutoUpdatingCache()
+    mirror = TensorStateMirror()
+    mirror.attach(cache)
+    cache.write_policy(
+        "default", "load-pol", TASPolicy.from_obj(_policy_obj())
+    )
+    cache.write_metric("load_metric")
+    client = FakeMetricsClient()
+    client.set_all(
+        "load_metric",
+        {n: (i * 37) % 1_000_000 for i, n in enumerate(names)},
+    )
+    plane = ShardPlane(
+        "ledger-owner",
+        4,
+        kube_client=None,
+        static_owners={
+            p: "ledger-owner" if p == 0 else f"other-{p}" for p in range(4)
+        },
+    )
+    plane.attach(cache, mirror)
+    cache.update_all_metrics(client)  # warm: interning + first digests
+    out: Dict[str, List[float]] = {
+        "shard_refresh_pass": [],
+        "shard_digest_build": [],
+    }
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        cache.update_all_metrics(client)
+        out["shard_refresh_pass"].append((time.perf_counter() - t0) * 1e6)
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        build_partition_digests(
+            mirror,
+            plane.pmap,
+            plane.coordinator.owned(),
+            identity=plane.identity,
+            epoch_of=plane.coordinator.epoch,
+            topk_of=plane.topk_for,
+            clock=plane.clock,
+        )
+        out["shard_digest_build"].append((time.perf_counter() - t0) * 1e6)
+    return out
+
+
 def measure(
     num_nodes: int = 2000, solve_reps: int = 30, verb_reps: int = 200
 ) -> Dict:
@@ -118,6 +179,13 @@ def measure(
                     )
     finally:
         solveobs.ACTIVE = saved
+
+    # sharded-refresh floors (docs/sharding.md): one telemetry pass
+    # through the ~1/P ingest cut, and one digest build over the owned
+    # partition — the partition plane's per-pass costs.  Anchored so a
+    # regression in the refresh_filter walk or the top-k summarizer
+    # flags here instead of shipping as slow refresh loops.
+    samples.update(_measure_shard(num_nodes, reps=max(6, solve_reps // 3)))
 
     # warm Filter verb floor, observatory OFF — the production path the
     # wire SLOs actually see; gc-fenced so a pause can't land mid-batch

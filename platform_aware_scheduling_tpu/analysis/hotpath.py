@@ -102,6 +102,11 @@ EXTRA_BINDINGS: Dict[Tuple[str, str, str], Tuple[str, str]] = {
     ("gas.scheduler", "GASExtender", "slo"): ("utils.slo", "SLOEngine"),
     ("gas.scheduler", "GASExtender", "flight"): ("utils.record", "FlightRecorder"),
     ("gang.group", "GangTracker", "journal"): ("gang.journal", "GangJournal"),
+    ("tas.telemetryscheduler", "MetricsExtender", "shard"): ("shard.plane", "ShardPlane"),
+    ("shard.plane", "ShardPlane", "pmap"): ("shard.partition", "PartitionMap"),
+    ("shard.plane", "ShardPlane", "coordinator"): ("shard.partition", "HandoffCoordinator"),
+    ("shard.plane", "ShardPlane", "store"): ("shard.digest", "DigestStore"),
+    ("shard.plane", "ShardPlane", "gossip"): ("shard.digest", "ShardGossip"),
 }
 
 

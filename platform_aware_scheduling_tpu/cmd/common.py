@@ -302,6 +302,106 @@ def build_admission_plane(
     return plane
 
 
+def add_shard_flags(parser: argparse.ArgumentParser) -> None:
+    """Partition-plane flag surface (docs/sharding.md)."""
+    parser.add_argument("--shard", default="off", choices=["off", "on"],
+                        help="consistent-hash partition plane: the node "
+                        "universe hashes into --shardPartitions "
+                        "partitions, ownership is journaled+fenced in a "
+                        "ConfigMap, each replica refreshes and mirrors "
+                        "ONLY its owned partitions, and Filter/"
+                        "Prioritize answer scatter/gather from the "
+                        "local solve plus gossiped remote digests "
+                        "(peer /debug/shard pulls).  Bypasses the "
+                        "Filter response cache while on (the merged "
+                        "verdict depends on digest freshness).  Off "
+                        "(the default) constructs nothing and leaves "
+                        "the wire byte-identical")
+    parser.add_argument("--shardPartitions", type=int, default=4,
+                        help="partition count P; every replica must "
+                        "agree on it (it is the modulus of the "
+                        "consistent hash)")
+    parser.add_argument("--shardPeers", default="",
+                        help="comma-separated peer base URLs "
+                        "(http://host:port) whose /debug/shard this "
+                        "replica pulls remote-partition digests from; "
+                        "empty serves local partitions only")
+    parser.add_argument("--shardTopK", type=int, default=16,
+                        help="per-metric candidate summaries carried in "
+                        "each partition digest (k lowest + k highest); "
+                        "the budget controller's per-partition shed "
+                        "knob steps this down under freshness burn")
+    parser.add_argument("--shardStaleBound", default="30s",
+                        help="digest staleness bound (Go duration): a "
+                        "remote digest older than this stops serving "
+                        "and the gather fails open to local-only "
+                        "answers (edge-triggered digest_stale event)")
+    parser.add_argument("--shardMemberTTL", default="15s",
+                        help="membership heartbeat TTL (Go duration): a "
+                        "replica silent for longer drops from the "
+                        "rendezvous and its partitions hand off")
+    parser.add_argument("--shardConfigMap", default="pas-shard-partitions",
+                        help="ConfigMap name holding the journaled "
+                        "partition-ownership state")
+
+
+def shard_peers(args) -> tuple:
+    """The parsed --shardPeers URL list."""
+    return tuple(
+        s.strip() for s in getattr(args, "shardPeers", "").split(",")
+        if s.strip()
+    )
+
+
+def validate_shard_flags(parser: argparse.ArgumentParser, args) -> None:
+    """Fail fast (exit 2 with usage) on contradictory shard wiring."""
+    if getattr(args, "shard", "off") != "on":
+        return
+    if args.shardPartitions < 1:
+        parser.error(
+            f"--shardPartitions {args.shardPartitions} must be >= 1"
+        )
+    if args.shardTopK < 1:
+        parser.error(f"--shardTopK {args.shardTopK} must be >= 1")
+    for peer in shard_peers(args):
+        if not (peer.startswith("http://") or peer.startswith("https://")):
+            parser.error(
+                f"--shardPeers entry {peer!r} is not a base URL "
+                f"(expected http://host:port)"
+            )
+
+
+def build_shard_plane(
+    args, extender, kube_client, cache, mirror, leadership=None
+):
+    """The ShardPlane for --shard=on (None when off), attached as
+    ``extender.shard`` (the verbs, /metrics, and /debug/shard all key
+    off that attr) and wired into the cache/mirror: the refresh filter
+    drops non-owned nodes at ingest and the refresh pass drives
+    coordination + digest publish + gossip — no new threads."""
+    if getattr(args, "shard", "off") != "on":
+        return None
+    from platform_aware_scheduling_tpu.shard import ShardPlane
+    from platform_aware_scheduling_tpu.utils.duration import parse_duration
+
+    plane = ShardPlane(
+        identity=replica_identity(args),
+        partitions=args.shardPartitions,
+        kube_client=kube_client,
+        namespace=getattr(args, "leaseNamespace", "default") or "default",
+        configmap=args.shardConfigMap,
+        leadership=leadership,
+        peers=shard_peers(args),
+        topk=args.shardTopK,
+        stale_after_s=parse_duration(args.shardStaleBound),
+        member_ttl_s=parse_duration(args.shardMemberTTL),
+    )
+    if cache is not None and mirror is not None:
+        plane.attach(cache, mirror)
+    extender.shard = plane
+    return plane
+
+
 def add_forecast_flags(
     parser: argparse.ArgumentParser, forecast: bool = True
 ) -> None:

@@ -110,6 +110,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     common.add_event_flags(parser)
     common.add_gang_flags(parser)
     common.add_admission_flags(parser)
+    common.add_shard_flags(parser)
     common.add_forecast_flags(parser)
     common.add_ha_flags(parser)
     common.add_slo_flags(parser)
@@ -309,6 +310,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     common.validate_control_flags(parser, args)
     common.validate_admission_flags(parser, args)
+    common.validate_shard_flags(parser, args)
     klog.set_verbosity(args.v)
     sync_period_s = parse_duration(args.syncPeriod)
     # decision provenance + causal event journal on/off + ring sizes,
@@ -335,7 +337,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # assemble's warm pass triggers — install before assembly
     common.install_cost_visibility()
     gang_tracker = common.build_gang_tracker(args, kube_client)
-    cache, _, extender, controller, _, stop = assemble(
+    cache, mirror, extender, controller, _, stop = assemble(
         kube_client,
         metrics_client,
         sync_period_s,
@@ -373,6 +375,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         leadership=leadership,
     )
 
+    # partition plane (--shard=on; docs/sharding.md): consistent-hash
+    # partition ownership journaled in a ConfigMap, the telemetry
+    # refresh cut to owned partitions, scatter/gather serving over
+    # gossiped digests.  Built BEFORE the budget controller so the
+    # per-partition shed knobs can attach.  Off (the default) builds
+    # nothing — the wire stays byte-identical
+    shard_plane = common.build_shard_plane(
+        args,
+        extender,
+        kube_client=kube_client,
+        cache=cache,
+        mirror=mirror,
+        leadership=leadership,
+    )
+
     # SLO engine (--slo=on; docs/observability.md "SLOs & error
     # budgets"): judged over the extender's recorder + the cache's
     # freshness signal, ticked on its own daemon loop; attaching it to
@@ -391,13 +408,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     budget_controller = common.build_budget_controller(
         args, extender, slo_engine
     )
+    if budget_controller is not None and shard_plane is not None:
+        # per-partition digest top-k shed knobs
+        # (pas_control_knob_setting{knob=shard_topk_p<N>, partition=})
+        budget_controller.attach_shard(shard_plane)
 
     # flight recorder (--flightRecorder=on; docs/observability.md
     # "Flight recorder & what-if"): anonymized verb/telemetry/control
     # events into a bounded ring behind GET /debug/record and
     # POST /debug/whatif.  Off (the default) builds nothing — the verbs
     # skip one attribute check and the wire stays byte-identical
-    common.build_flight_recorder(args, extender, cache=cache)
+    flight_recorder = common.build_flight_recorder(args, extender, cache=cache)
+    if flight_recorder is not None and shard_plane is not None:
+        # ownership changes land in the capture as anonymized shard
+        # events (partition ids + fencing epochs only — record_shard)
+        shard_plane.coordinator.flight = flight_recorder
 
     # solve observatory (--solveObs=on; docs/observability.md "Solve
     # observatory"): per-stage solve attribution + refresh churn behind

@@ -94,6 +94,7 @@ DEBUG_ENDPOINTS = [
     {"path": "/debug/explain", "description": "causal event spine: the ordered event chain + narrative for one entity; filters: ?pod=<ns/name>&gang=<id>&request_id=<id>&node=<name> (404 when --events=off)"},
     {"path": "/debug/record", "description": "flight-recorder capture as versioned JSONL: anonymized verb arrivals, telemetry deciles, eviction/leader events, spine passthrough (404 when --flightRecorder=off)"},
     {"path": "/debug/solve", "description": "solve observatory: per-stage solve attribution (snapshot/transfer/compile/execute/readback/encode), refresh churn per metric, recompile watch (404 when --solveObs=off)"},
+    {"path": "/debug/shard", "description": "partition plane: partition map, journaled ownership + fencing epochs, digest ages, gossip health (404 when --shard=off)"},
     {"path": "/debug/whatif", "method": "POST", "description": "twin replay of a capture under transform knobs (load_multiplier, remove_nodes, thresholds): projected SLO verdicts + budget ledgers (404 when --flightRecorder=off)"},
 ]
 
@@ -557,6 +558,24 @@ class Server:
                 status=200,
                 headers={"Content-Type": "application/json"},
                 body=observatory.to_json(),
+            )
+        if bare_path == "/debug/shard":
+            # partition plane (shard/plane.py): ownership, fencing
+            # epochs, digest ages — and the GOSSIP surface: peers pull
+            # this JSON and ingest the digests it carries; 404 when no
+            # plane is wired (--shard=off), same convention
+            if request.method != "GET":
+                return HTTPResponse(status=405)
+            shard_plane = getattr(self.scheduler, "shard", None)
+            if shard_plane is None:
+                return HTTPResponse.json(
+                    b'{"error": "shard plane not configured"}\n',
+                    status=404,
+                )
+            return HTTPResponse(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=shard_plane.to_json(),
             )
         if bare_path == "/debug/wire":
             # wire-path cache state (tas/fastpath.py wire_debug): interned

@@ -213,6 +213,7 @@ class DeviceView:
         intern_version: int = 0,
         values_milli: Optional[np.ndarray] = None,
         metric_index: Optional[Dict[str, int]] = None,
+        partition_versions: Optional[Dict[int, int]] = None,
     ):
         self.values = values
         self.present = present
@@ -231,6 +232,18 @@ class DeviceView:
         # history tensor, ops/forecast.py) can be built against this
         # exact snapshot.  None in synthetic views built without it.
         self.metric_index = metric_index
+        # partition id -> change counter, populated only in partition-
+        # scoped mode (shard/plane.py): a digest built for partition p is
+        # stale iff partition_versions[p] moved, independent of churn in
+        # the other partitions this replica happens to own.  None when
+        # the mirror is unscoped (full-world mode — the global ``version``
+        # is the only clock).
+        self.partition_versions = partition_versions
+
+    def partition_version(self, partition: int) -> int:
+        if self.partition_versions is None:
+            return self.version
+        return self.partition_versions.get(int(partition), 0)
 
     def row_version(self, row: int) -> int:
         return self.row_versions[row] if row < len(self.row_versions) else 0
@@ -296,6 +309,14 @@ class TensorStateMirror:
         # path; drained per refresh pass by the observatory's
         # cache.on_refresh_pass hook
         self._churn_pending: Dict[str, List[int]] = {}
+        # partition-scoped mode (shard/plane.py): (PartitionMap, callable
+        # returning the owned-partition set).  When set, metric writes
+        # skip non-owned nodes BEFORE interning — the ~1/P memory cut —
+        # and per-partition change counters ride the version bumps.  None
+        # (the default) is full-world mode: zero cost, zero behavior
+        # change.
+        self._partition_scope = None
+        self._partition_versions: Dict[int, int] = {}
 
     # -- wiring ---------------------------------------------------------------
 
@@ -305,6 +326,17 @@ class TensorStateMirror:
         cache.on_metric_delete.append(self.on_metric_delete)
         cache.on_policy_write.append(self.on_policy_write)
         cache.on_policy_delete.append(self.on_policy_delete)
+
+    def set_partition_scope(self, pmap, owned) -> None:
+        """Enter partition-scoped mode: metric writes keep only nodes in
+        partitions ``owned()`` currently returns (re-read per write, so
+        ownership handoff takes effect on the next refresh pass without
+        re-wiring).  Already-interned non-owned nodes keep their columns
+        but stop receiving values — their presence decays to False on the
+        next write of each metric, which is exactly the host semantics of
+        a node leaving the metric map."""
+        with self._lock:
+            self._partition_scope = (pmap, owned)
 
     # -- interning ------------------------------------------------------------
 
@@ -383,7 +415,20 @@ class TensorStateMirror:
             # invalidate snapshots/plans or force device re-uploads
             host_only = False
             staged: Dict[int, int] = {}
+            scope = self._partition_scope
+            owned_parts = None
+            if scope is not None:
+                pmap, owned = scope
+                try:
+                    owned_parts = owned()
+                except Exception:
+                    owned_parts = frozenset()
+            changed_partitions: Dict[int, bool] = {}
             for node_name, metric in info.items():
+                if owned_parts is not None:
+                    partition = pmap.partition_of(node_name)
+                    if partition not in owned_parts:
+                        continue  # not ours: never interned, never stored
                 col = self._intern_node(node_name)
                 milli, exact = metric.value.milli_value_exact()
                 if not exact:
@@ -416,6 +461,23 @@ class TensorStateMirror:
                 entry[0] += moved
             self._host_only_metrics[metric_name] = host_only
             if changed:
+                if owned_parts is not None:
+                    # attribute the change to the partitions whose columns
+                    # actually moved, so a digest for a quiet partition
+                    # stays valid through churn in a noisy one
+                    diff = np.nonzero(
+                        (self._values[row] != new_values)
+                        | (self._present[row] != new_present)
+                    )[0]
+                    for col in diff:
+                        if col < len(self._node_names):
+                            changed_partitions[
+                                pmap.partition_of(self._node_names[col])
+                            ] = True
+                    for partition in changed_partitions:
+                        self._partition_versions[partition] = (
+                            self._partition_versions.get(partition, 0) + 1
+                        )
                 self._values[row] = new_values
                 self._present[row] = new_present
                 self._version += 1
@@ -636,6 +698,11 @@ class TensorStateMirror:
             intern_version=self._intern_version,
             values_milli=values_milli,
             metric_index=dict(self._metric_index),
+            partition_versions=(
+                dict(self._partition_versions)
+                if self._partition_scope is not None
+                else None
+            ),
         )
         if timer is not None:
             timer.mark("encode")

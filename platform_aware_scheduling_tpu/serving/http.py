@@ -130,6 +130,9 @@ class AsyncServer:
                 admission = getattr(self.scheduler, "admission", None)
                 if admission is not None:
                     sets.append(admission.counters)
+                shard = getattr(self.scheduler, "shard", None)
+                if shard is not None:
+                    sets.append(shard.counters)
                 return trace.exposition(
                     recorders=[self.recorder], counter_sets=sets
                 )

@@ -1,0 +1,35 @@
+"""Partition plane: consistent-hash sharding of the node universe
+(docs/sharding.md).
+
+``PartitionMap`` hashes every node name into one of P partitions;
+``HandoffCoordinator`` journals partition -> replica ownership in a
+ConfigMap (fenced by per-partition epochs, handoff-safe on membership
+change); ``PartitionDigest``/``DigestStore`` carry the compact remote
+summaries scatter/gather serving answers from; ``ShardPlane`` ties them
+together behind the extender's ``shard`` attribute.
+
+Off by default (``--shard=off``): nothing here is constructed and the
+wire stays byte-identical — pinned by tests/test_shard.py.
+"""
+
+from platform_aware_scheduling_tpu.shard.digest import (
+    DigestStore,
+    PartitionDigest,
+    ShardGossip,
+    build_partition_digests,
+)
+from platform_aware_scheduling_tpu.shard.partition import (
+    HandoffCoordinator,
+    PartitionMap,
+)
+from platform_aware_scheduling_tpu.shard.plane import ShardPlane
+
+__all__ = [
+    "DigestStore",
+    "HandoffCoordinator",
+    "PartitionDigest",
+    "PartitionMap",
+    "ShardGossip",
+    "ShardPlane",
+    "build_partition_digests",
+]

@@ -134,6 +134,11 @@ class AutoUpdatingCache:
         # every per-metric write of the pass landed) — the forecast
         # subsystem refits here, once per pass instead of once per metric
         self.on_refresh_pass: List[Callable[[], None]] = []
+        # optional fetched-map transform applied between the metrics API
+        # fetch and write_metric: the shard plane's ~1/P ingest cut drops
+        # non-owned nodes here (shard/plane.py).  None (the default) is a
+        # straight passthrough — full-world mode unchanged.
+        self.refresh_filter: Optional[Callable] = None
         # refresh-history substrate (docs/forecast.md): a bounded ring of
         # the last W data-bearing refreshes per metric — (monotonic stamp,
         # {node: milli int}) samples.  A FAILED refresh appends nothing,
@@ -416,6 +421,8 @@ class AutoUpdatingCache:
 
     def _update_metric(self, client: Client, metric_name: str) -> None:
         info = client.get_node_metric(metric_name)
+        if self.refresh_filter is not None and info:
+            info = self.refresh_filter(info)
         self.write_metric(metric_name, info)
 
     def periodic_update(

@@ -201,6 +201,17 @@ class MetricsExtender:
         # check and keeps the wire byte-identical — pinned by
         # tests/test_admission.py.
         self.admission = None
+        # opt-in shard.ShardPlane, set by assembly when --shard=on: the
+        # mirror holds only OWNED partitions, Filter merges remote
+        # partitions' digest violators into the local verdict, Prioritize
+        # ranks over local values + remote top-k summaries, and the
+        # front-ends serve GET /debug/shard (404 while this is None).
+        # While set, the Filter response cache is bypassed — the merged
+        # verdict depends on digest freshness the span-keyed cache cannot
+        # key (docs/sharding.md).  Off (None) costs the verbs one
+        # attribute check and keeps the wire byte-identical — pinned by
+        # tests/test_shard.py.
+        self.shard = None
         # request-independent ranking/violation caches + byte-fragment
         # encoder (tas/fastpath.py) — the per-request device dispatch and
         # per-node Python objects the round-1 verdict flagged are gone
@@ -447,6 +458,8 @@ class MetricsExtender:
             counter_sets.append(self.flight.counters)
         if self.admission is not None:
             counter_sets.append(self.admission.counters)
+        if self.shard is not None:
+            counter_sets.append(self.shard.counters)
         return trace.exposition(
             recorders=[self.recorder], counter_sets=counter_sets
         )
@@ -504,6 +517,14 @@ class MetricsExtender:
                     return self._neutral_prioritize(request, span)
                 if action == degraded_mode.ACTION_LAST_KNOWN_GOOD:
                     span.set("degraded", reason)  # serving retained scores
+            if self.shard is not None:
+                # scatter/gather: local partitions from the mirror,
+                # remote partitions from fresh digests; None falls
+                # through to the full-world paths (which then answer
+                # from whatever the partition-scoped mirror holds)
+                response = self._shard_prioritize(request, span)
+                if response is not None:
+                    return response
             # the native path attributes itself (native vs native_host —
             # partition counters, see trace.py declarations)
             response = self._prioritize_native(request)
@@ -608,11 +629,22 @@ class MetricsExtender:
                 if self.gangs is not None:
                     gang_token = self._gang_cache_token(request)
                 if (
-                    self.gangs is None or gang_token is not None
-                ) and self.admission is None:
+                    (self.gangs is None or gang_token is not None)
+                    and self.admission is None
+                    and (
+                        self.shard is None
+                        or not self.shard.remote_holds_possible()
+                    )
+                ):
                     # admission mode bypasses entirely: whether a pod is
                     # admitted, held, or queued is per-pod queue state
-                    # that changes between identical request bodies
+                    # that changes between identical request bodies;
+                    # shard mode bypasses only while a remote digest
+                    # actually lists violators — otherwise the merged
+                    # verdict equals the local one for ANY candidate
+                    # set, so the native fastpath (and its ~1/P-size
+                    # problem) serves sharded Filter at full speed
+                    # (shard/plane.py remote_holds_possible)
                     with span.stage("cache_probe"):
                         probe = self._filter_cache_probe(
                             request, gang_token
@@ -654,6 +686,9 @@ class MetricsExtender:
                 klog.v(2).info_s("No filtered nodes returned", component="extender")
                 return HTTPResponse.json(b"null\n", status=404)
             span.set("pod", f"{args.pod.namespace}/{args.pod.name}")
+            if self.shard is not None:
+                with span.stage("shard"):
+                    result = self._shard_review(args, result, span)
             if self.admission is not None:
                 with span.stage("admission"):
                     result = self._admission_review(
@@ -766,6 +801,108 @@ class MetricsExtender:
             failed_nodes=merged,
             error=result.error,
         )
+
+    def _shard_review(self, args, result, span):
+        """Merge REMOTE partitions' digest violators into the locally
+        computed Filter verdict (shard/plane.py review contract): the
+        local solve already judged every owned-partition candidate; a
+        fresh remote digest contributes its violator set; a
+        missing/stale/fenced digest contributes nothing — fail open, the
+        node passes on remote facts and the degradation is visible on
+        the gather counters + digest_stale events.  Plane trouble must
+        never take down Filter."""
+        try:
+            policy_name = args.pod.get_labels().get(TAS_POLICY_LABEL, "")
+            if not policy_name:
+                return result
+            held, consulted = self.shard.review_filter(
+                policy_name, self._candidate_names(args)
+            )
+            span.set("shard_remote_partitions", str(consulted))
+            held_set = set(held) - set(result.failed_nodes)
+            if not held_set:
+                return result
+            merged = dict(result.failed_nodes)
+            for name in held_set:
+                merged[name] = (
+                    f"node {name} violates policy {policy_name} "
+                    "(remote partition digest)"
+                )
+            nodes = result.nodes
+            if nodes is not None:
+                nodes = [n for n in nodes if n.name not in held_set]
+            node_names = result.node_names
+            if node_names is not None:
+                node_names = [n for n in node_names if n not in held_set]
+            return FilterResult(
+                nodes=nodes,
+                node_names=node_names,
+                failed_nodes=merged,
+                error=result.error,
+            )
+        except Exception as exc:
+            klog.error("shard filter review failed open: %r", exc)
+            return result
+
+    def _shard_prioritize(self, request: HTTPRequest, span):
+        """Scatter/gather Prioritize: rank candidates over the merged
+        {node: milli} map — owned partitions from the mirror's exact
+        values, remote partitions from digest top-k summaries — with the
+        host path's ordering semantics (GreaterThan descending, LessThan
+        ascending, anything else input order; nodes absent from the
+        merged map are dropped exactly like nodes absent from metric
+        data).  Returns None to fall through: gang pods (the overlay
+        owns the exact path), unresolvable policy/rule, an unusable
+        local view, or any plane trouble — a local-only full-world
+        answer beats no answer."""
+        try:
+            if self.gangs is not None:
+                return None
+            decoded = self._decode_prioritize_args(request, span)
+            if isinstance(decoded, HTTPResponse):
+                return decoded
+            args, names, status = decoded
+            try:
+                policy = self._policy_from_pod(args.pod)
+            except Exception:
+                return None
+            rule = self._scheduling_rule(policy)
+            if rule is None:
+                return None
+            merged = self.shard.gather_metric(rule.metricname, names)
+            if merged is None:
+                return None
+            entries = [(name, merged[name]) for name in names if name in merged]
+            if rule.operator == "GreaterThan":
+                entries.sort(key=lambda kv: kv[1], reverse=True)
+            elif rule.operator == "LessThan":
+                entries.sort(key=lambda kv: kv[1])
+            result = self._apply_plan(
+                args.pod,
+                [
+                    HostPriority(host=name, score=10 - i)
+                    for i, (name, _milli) in enumerate(entries)
+                ],
+            )
+            span.set("path", "shard")
+            span.set("pod", f"{args.pod.namespace}/{args.pod.name}")
+            with span.stage("encode"):
+                body = encode_host_priority_list(result)
+            self._record_prioritize(
+                span, args.pod.namespace, args.pod.name, policy.name,
+                "shard", rule, len(names), result=result,
+            )
+            events.JOURNAL.publish(
+                "verdict",
+                "prioritize",
+                request_id=span.trace_id,
+                pod=f"{args.pod.namespace}/{args.pod.name}",
+                data={"candidates": len(names), "path": "shard"},
+            )
+            return HTTPResponse.json(body, status=status)
+        except Exception as exc:
+            klog.error("shard prioritize failed open: %r", exc)
+            return None
 
     def _gang_cache_token(self, request: HTTPRequest):
         """(reservation version, held map) when this request may use the
@@ -1284,19 +1421,33 @@ class MetricsExtender:
         """prioritizeNodes (telemetryscheduler.go:81-100) down to response
         bytes: any failure degrades to an empty priority list."""
         if self.gangs is not None:
-            try:
-                # a Prioritize-FIRST arrival drives the same reservation
-                # path Filter would, so it must solve over the same
-                # telemetry-clean candidate set — otherwise it could
-                # reserve a slice containing a violating node that
-                # Filter will then never pass (the livelock the Filter
-                # path explicitly excludes)
-                gang_result = self.gangs.prioritize_overlay(
-                    args.pod, self._telemetry_clean(args.pod, names)
-                )
-            except Exception as exc:  # overlay fails open to the ranking
-                klog.error("gang prioritize overlay failed open: %s", exc)
+            if self.shard is not None and not self.shard.owns_anchor(names):
+                # sharded mode: a slice that straddles partitions
+                # resolves through the owner of the ANCHOR partition
+                # (the first candidate's partition — deterministic, so
+                # every front-end agrees).  A non-owner serves the plain
+                # ranking; the journaled reservation the owner creates
+                # is visible to everyone (docs/sharding.md "Straddling
+                # gangs")
+                self.shard.counters.inc("pas_shard_gang_deferred_total")
+                span.set("shard_gang", "deferred")
                 gang_result = None
+            else:
+                try:
+                    # a Prioritize-FIRST arrival drives the same
+                    # reservation path Filter would, so it must solve
+                    # over the same telemetry-clean candidate set —
+                    # otherwise it could reserve a slice containing a
+                    # violating node that Filter will then never pass
+                    # (the livelock the Filter path explicitly excludes)
+                    gang_result = self.gangs.prioritize_overlay(
+                        args.pod, self._telemetry_clean(args.pod, names)
+                    )
+                except Exception as exc:  # overlay fails open to the ranking
+                    klog.error(
+                        "gang prioritize overlay failed open: %s", exc
+                    )
+                    gang_result = None
             if gang_result is not None:
                 # gang member: the reserved slice in row-major order (the
                 # anchor already minimizes stranded fragments); empty

@@ -253,6 +253,32 @@ class ReplicaStack:
                 )
             self.admission = plane
             self.extender.admission = plane
+        # the partition plane (cmd/common.build_shard_plane's twin):
+        # per-replica, coordinating through the SHARED fake ConfigMap
+        # journal, gossiping in-process (callable peers resolve other
+        # stacks through the harness at pull time, so restart() swaps in
+        # the rebuilt replica's plane without rewiring anything)
+        self.shard = None
+        if harness.shard_partitions:
+            from platform_aware_scheduling_tpu.shard import ShardPlane
+
+            shard = ShardPlane(
+                self.identity,
+                harness.shard_partitions,
+                self.ft_kube,
+                leadership=self.elector,
+                member_ttl_s=harness.shard_member_ttl_s,
+                stale_after_s=harness.shard_stale_s,
+                clock=clock.now,
+            )
+            for j in range(harness.replica_count):
+                if j != index:
+                    shard.gossip.peers.append(
+                        lambda j=j: harness.shard_payload(j)
+                    )
+            shard.attach(self.cache, self.mirror)
+            self.extender.shard = shard
+            self.shard = shard
 
     def step(self) -> None:
         """This replica's slice of one fleet tick: election round, then
@@ -292,6 +318,9 @@ class HAHarness:
         preemption: bool = False,
         preemption_max_victims: int = 8,
         admission_starve_consults: int = 16,
+        shard_partitions: int = 0,
+        shard_member_ttl_s: Optional[float] = None,
+        shard_stale_s: float = 30.0,
     ):
         self.clock = FakeClock()
         self.plan = FaultPlan(seed=seed)
@@ -311,6 +340,18 @@ class HAHarness:
         self.preemption_max_victims = preemption_max_victims
         self.admission_starve_consults = admission_starve_consults
         self.journal_name = journal_name
+        #: partition-plane options: ``shard_partitions`` > 0 gives every
+        #: replica a ShardPlane over the shared ConfigMap journal; the
+        #: member TTL defaults to the lease duration so a crashed owner
+        #: loses its partitions on the same clock it loses the lease
+        self.shard_partitions = shard_partitions
+        self.shard_member_ttl_s = (
+            lease_duration_s
+            if shard_member_ttl_s is None
+            else shard_member_ttl_s
+        )
+        self.shard_stale_s = shard_stale_s
+        self.replica_count = replicas
         self.fake = FakeKubeClient()
         self.fake.fault_plan = self.plan
         self.fake.fault_clock = self.clock
@@ -444,6 +485,28 @@ class HAHarness:
                 dups.append(key)
             seen.add(key)
         return dups
+
+    def shard_payload(self, index: int) -> bytes:
+        """Gossip peer accessor: replica ``index``'s /debug/shard payload,
+        raising when that replica is crashed/unbuilt — exactly an HTTP
+        peer going dark (the puller counts it in ``pulls_failed`` and the
+        gatherer fails open until the digest ages out)."""
+        stack = self.replicas[index]
+        if stack is None or index in self.crashed or stack.shard is None:
+            raise RuntimeError(f"shard peer replica-{index} down")
+        return stack.shard.to_json()
+
+    def shard_owners(self) -> Dict[int, str]:
+        """partition -> owner per the journal (via any live replica's
+        coordinator view — they all read the same ConfigMap)."""
+        for stack in self.live():
+            if stack.shard is not None:
+                snap = stack.shard.coordinator.snapshot()
+                return {
+                    int(p): rec["replica"]
+                    for p, rec in snap["owners"].items()
+                }
+        return {}
 
     def hot_node_load(self) -> int:
         with self.fake._lock:

@@ -195,6 +195,7 @@ class TwinCluster(HAHarness):
         preemption: bool = False,
         preemption_max_victims: int = 8,
         admission_starve_consults: int = 16,
+        shard_partitions: int = 0,
     ):
         super().__init__(
             replicas=replicas,
@@ -216,6 +217,9 @@ class TwinCluster(HAHarness):
             preemption=preemption,
             preemption_max_victims=preemption_max_victims,
             admission_starve_consults=admission_starve_consults,
+            # the partition plane (shard/): > 0 gives every replica a
+            # ShardPlane over the shared journal, with in-process gossip
+            shard_partitions=shard_partitions,
             # capacity below the violation threshold (4 x POD_LOAD=400
             # <= THRESHOLD=450): a capacity-legal rebalance plan can
             # never manufacture the next violating node, so scenarios
@@ -1430,6 +1434,182 @@ class LeaderKillComposite(Scenario):
         return checks
 
 
+class PartitionHandoff(Scenario):
+    """Partition ownership moves mid-traffic (docs/sharding.md): a
+    3-replica sharded fleet serves scatter/gather verbs over 4
+    partitions while a partition OWNER is killed cold.  Its membership
+    heartbeat ages out, the coordinator hands its partitions to
+    survivors under bumped fencing epochs, gossip re-converges, and the
+    serving SLOs never notice.  The fencing audit is double: no live
+    replica's store may still SERVE a moved partition under the dead
+    owner's epoch, and the causal spine must carry the handoff as
+    queryable context next to the verdicts that rode through it."""
+
+    name = "partition_handoff"
+    kill_at = 8
+    partitions = 4
+
+    def build(self, scale: Dict) -> TwinCluster:
+        scale = dict(scale)
+        scale["replicas"] = 3
+        scale["shard_partitions"] = self.partitions
+        scale["gas"] = False
+        # one causal story per run, as the admission scenarios do
+        events.JOURNAL.reset()
+        self.victim: Optional[str] = None
+        self.victim_owned: List[int] = []
+        self.pre_epochs: Dict[int, int] = {}
+        return TwinCluster(**scale)
+
+    def ticks(self, scale: Dict) -> int:
+        return 24
+
+    def apply(self, twin: TwinCluster, t: int) -> None:
+        if t == self.kill_at:
+            # kill a partition owner that is NOT serving traffic: the
+            # handoff story is this scenario's subject — the serving
+            # replica's failover story is LeaderKillComposite's
+            serving = twin.live()[0].index
+            victim_idx = None
+            for i, stack in enumerate(twin.replicas):
+                if stack is None or i in twin.crashed or i == serving:
+                    continue
+                if stack.shard.coordinator.owned():
+                    victim_idx = i
+                    break
+            if victim_idx is None:
+                victim_idx = serving
+            stack = twin.replicas[victim_idx]
+            self.victim = stack.identity
+            self.victim_owned = sorted(stack.shard.coordinator.owned())
+            self.pre_epochs = {
+                p: stack.shard.coordinator.epoch(p)
+                for p in self.victim_owned
+            }
+            twin.crash(victim_idx)
+        # a gentle moving curve keeps telemetry and digests changing
+        loads = {
+            node: 50 + 20 * ((t + i) % 5)
+            for i, node in enumerate(twin.live_node_names())
+        }
+        twin.set_base_load(loads)
+
+    def checks(self, twin: TwinCluster) -> List[Dict]:
+        checks = self.slo_gates(twin, compliant=_CORE_SLOS)
+        owners = twin.shard_owners()
+        moved = {p: owners.get(p, "") for p in self.victim_owned}
+        checks.append(
+            self._check(
+                "ownership_moved",
+                bool(self.victim_owned)
+                and all(o and o != self.victim for o in moved.values()),
+                f"{self.victim} owned {self.victim_owned} -> {moved}",
+            )
+        )
+        live0 = twin.live()[0]
+        epochs = {
+            p: live0.shard.coordinator.epoch(p) for p in self.victim_owned
+        }
+        checks.append(
+            self._check(
+                "epochs_fenced_forward",
+                bool(epochs)
+                and all(
+                    epochs[p] > self.pre_epochs.get(p, 0)
+                    for p in self.victim_owned
+                ),
+                f"epochs {self.pre_epochs} -> {epochs}",
+            )
+        )
+        # fencing audit, store side: a digest the dead owner published
+        # must not be SERVABLE anywhere after the handoff — fresh()
+        # either answers with the new owner's epoch or fails open
+        fenced_servable = []
+        for stack in twin.live():
+            for p in self.victim_owned:
+                digest = stack.shard.store.fresh(p)
+                if digest is not None and (
+                    digest.owner == self.victim
+                    or digest.epoch < epochs.get(p, 0)
+                ):
+                    fenced_servable.append(
+                        (stack.identity, p, digest.owner, digest.epoch)
+                    )
+        checks.append(
+            self._check(
+                "no_verdict_from_fenced_owner",
+                not fenced_servable,
+                f"fenced digests servable: {fenced_servable}"
+                if fenced_servable
+                else "every servable digest carries the post-handoff epoch",
+            )
+        )
+        duplicates = twin.duplicate_evictions()
+        checks.append(
+            self._check(
+                "zero_duplicate_evictions",
+                not duplicates,
+                f"duplicates: {duplicates}",
+            )
+        )
+        # every live replica really ingested partition-scoped: its
+        # refresh filter dropped the non-owned world on every pass
+        unscoped = [
+            stack.identity
+            for stack in twin.live()
+            if stack.shard.counters.get(
+                "pas_shard_refresh_nodes_total",
+                kind="counter",
+                labels={"scope": "skipped"},
+            )
+            <= 0
+        ]
+        checks.append(
+            self._check(
+                "refresh_partition_scoped",
+                not unscoped,
+                f"replicas that never skipped a non-owned node: {unscoped}",
+            )
+        )
+        # fencing audit, spine side: ask /debug/explain about a pod the
+        # verb traffic served and demand the handoff ride the chain as
+        # tick-joined world-state context ("who owned this node when the
+        # verdict fired" reads off these partition/epoch records)
+        from platform_aware_scheduling_tpu.extender.server import Server
+
+        extender = live0.extender
+        server = Server(extender, metrics_provider=extender.metrics_text)
+        response = server.route(
+            HTTPRequest(
+                method="GET",
+                path="/debug/explain?pod=default/twin-pod-0",
+                headers={},
+                body=b"",
+            )
+        )
+        handoffs = []
+        if response.status == 200:
+            payload = json.loads(response.body)
+            handoffs = [
+                r
+                for r in (payload.get("context") or [])
+                + (payload.get("events") or [])
+                if r["kind"] == "shard"
+                and r["event"] == "partition_handoff"
+                and r.get("data", {}).get("partition") in self.victim_owned
+            ]
+        checks.append(
+            self._check(
+                "handoff_in_event_spine",
+                response.status == 200 and len(handoffs) >= 1,
+                f"{len(handoffs)} partition_handoff context events for "
+                f"partitions {self.victim_owned} "
+                f"(HTTP {response.status})",
+            )
+        )
+        return checks
+
+
 class GangWave(Scenario):
     """A gang deployment wave on a TPU mesh: two competing multi-host
     gangs arrive interleaved and must BOTH land as valid contiguous
@@ -2511,6 +2691,7 @@ DEFAULT_SCENARIOS: Tuple[Scenario, ...] = (
     NodeFailureWave(),
     MetricStorm(),
     LeaderKillComposite(),
+    PartitionHandoff(),
     GangWave(),
 )
 

@@ -96,12 +96,17 @@ class Knob:
         ladder: Sequence,
         write: Callable[[object], None],
         read: Optional[Callable[[], object]] = None,
+        labels: Optional[Dict[str, str]] = None,
     ):
         self.name = name
         self.slo = slo
         self.ladder = _ladder(ladder)
         self.write = write
         self.read = read
+        # extra gauge labels beyond {"knob": name} — the per-partition
+        # shed knobs surface as pas_control_knob_setting{knob=...,
+        # partition=...} (docs/sharding.md)
+        self.labels = dict(labels) if labels else {}
         self.level = 0  # index into the ladder; 0 == baseline
         self.last_step_tick = -1  # rate limit: one step per engine tick
         self.steps = 0  # lifetime actuation count
@@ -189,7 +194,7 @@ class BudgetController:
         self.counters.set_gauge(
             "pas_control_knob_setting",
             float(knob.setting),
-            labels={"knob": knob.name},
+            labels={"knob": knob.name, **knob.labels},
         )
         return knob
 
@@ -278,6 +283,35 @@ class BudgetController:
                 read=lambda: forecaster.horizon_cap or forecaster.window,
             ),
         ]
+        return [self.add_knob(knob) for knob in knobs]
+
+    def attach_shard(self, plane, floor: int = 2) -> List[Knob]:
+        """The per-partition shed knobs: each partition's digest top-k
+        width halves toward ``floor`` under telemetry-freshness pressure
+        — a smaller summary is cheaper to build and gossip, at the cost
+        of remote ranking resolution (the classic shed: degrade answer
+        quality before availability).  One knob per partition, surfaced
+        as ``pas_control_knob_setting{knob=shard_topk_p<N>,
+        partition=<N>}`` so operators see which partitions are running
+        thin (docs/sharding.md)."""
+        baseline = int(plane.default_topk())
+        ladder: List[int] = [baseline]
+        while ladder[-1] // 2 >= max(1, int(floor)):
+            ladder.append(ladder[-1] // 2)
+        if len(ladder) < 2:
+            ladder = [baseline, max(1, int(floor))]
+        knobs = []
+        for partition in range(plane.pmap.partitions):
+            knobs.append(
+                Knob(
+                    f"shard_topk_p{partition}",
+                    "telemetry_freshness",
+                    ladder,
+                    lambda v, p=partition: plane.set_topk(p, int(v)),
+                    read=lambda p=partition: plane.topk_for(p),
+                    labels={"partition": str(partition)},
+                )
+            )
         return [self.add_knob(knob) for knob in knobs]
 
     def attach_degraded(self, degraded) -> Knob:
@@ -438,7 +472,7 @@ class BudgetController:
         self.counters.set_gauge(
             "pas_control_knob_setting",
             float(after),
-            labels={"knob": knob.name},
+            labels={"knob": knob.name, **knob.labels},
         )
         record = {
             "tick": tick,

@@ -50,12 +50,16 @@ KINDS = (
     "serving",     # dispatcher-level sheds (serving/dispatcher.py)
     "churn",       # refresh-pass churn: rows changed / world (ops/solveobs.py)
     "solve",       # fastpath warm passes: the solve cadence (tas/)
+    "shard",       # partition ownership + digest lifecycle (shard/)
 )
 
 #: kinds that describe the WORLD rather than any one entity: explain()
 #: joins them into a chain by tick, not by correlation key, so a pod's
 #: narrative can say "the state changed under you between these events"
-CONTEXT_KINDS = ("churn", "solve")
+#: — partition assignment/handoff is world state too: "who owned this
+#: node when the verdict fired" reads off the shard events whose ticks
+#: bracket the verdict
+CONTEXT_KINDS = ("churn", "solve", "shard")
 
 
 def _anon_corr(request_id: str, pod: str, gang: str, node: str) -> str:

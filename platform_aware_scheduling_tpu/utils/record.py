@@ -52,9 +52,12 @@ from platform_aware_scheduling_tpu.utils.tracing import CounterSet
 #: correlation hash); /3 added refresh-churn summaries (kind "churn":
 #: counts + fraction-of-world per pass, ops/solveobs.py — replayed
 #: captures carry production churn shape for ROADMAP item 4's
-#: delta-aware staging).  Loaders that fold a capture into a twin
-#: scenario ignore kinds they don't infer from, so both stay replayable.
-FORMAT = "pas-flight-record/3"
+#: delta-aware staging).  /4 added partition-plane events (kind
+#: "shard": ownership assigns/handoffs as partition id + fencing epoch,
+#: utils/record.record_shard — ids and epochs only, no member names).
+#: Loaders that fold a capture into a twin scenario ignore kinds they
+#: don't infer from, so all stay replayable.
+FORMAT = "pas-flight-record/4"
 
 DEFAULT_CAPACITY = 4096
 
@@ -222,6 +225,21 @@ class FlightRecorder:
                 "rows": int(rows),
                 "world": int(world),
                 "fraction": round(float(fraction), 4),
+            }
+        )
+
+    def record_shard(self, event: str, partition: int, epoch: int) -> None:
+        """One partition-ownership event (shard/partition.py publishes
+        assigns/handoffs here while wired).  Anonymization holds by
+        construction: a partition id and a fencing epoch — replica
+        identities and node names never enter the capture."""
+        self._append(
+            {
+                "t": round(self.clock(), 6),
+                "kind": "shard",
+                "event": str(event),
+                "partition": int(partition),
+                "epoch": int(epoch),
             }
         )
 

@@ -246,6 +246,19 @@ declare("pas_events_dropped_total", "counter", "Oldest journal events evicted by
 declare("pas_explain_requests_total", "counter", "GET /debug/explain queries served (both front-ends).")
 declare("pas_explain_chain_events", "gauge", "Events in the causal chain returned by the most recent /debug/explain query.")
 
+# partition plane (shard/, docs/sharding.md) — populated only while a
+# ShardPlane is wired (--shard=on); the off-path convention means every
+# family below reads 0/absent in full-world mode
+declare("pas_shard_ticks_total", "counter", "Shard refresh-pass drives completed (coordination tick + digest publish + gossip round, one per telemetry refresh pass).")
+declare("pas_shard_refresh_nodes_total", "counter", "Nodes seen by the telemetry refresh ingest filter (label: scope in owned/skipped) — skipped/owned ratio is the measured ~1/P refresh-volume cut.")
+declare("pas_shard_digests_published_total", "counter", "Per-partition digests built and shelved for owned partitions (one per owned partition per refresh pass with a usable view).")
+declare("pas_shard_gossip_ingested_total", "counter", "Remote partition digests accepted from peer /debug/shard pulls (fenced and out-of-date digests are rejected before this counts).")
+declare("pas_shard_digest_fenced_total", "counter", "Digests rejected at ingest because their ownership epoch predates the journaled epoch — a fenced-out owner's view stopped here (label: partition).")
+declare("pas_shard_digest_stale_total", "counter", "Staleness-bound trips per partition, edge-triggered per episode: serving failed open to local-only answers until a fresh digest landed (label: partition).")
+declare("pas_shard_gather_local_only_total", "counter", "Scatter/gather lookups answered WITHOUT a needed remote partition (digest missing/stale/fenced) — the fail-open visibility counter (label: verb).")
+declare("pas_shard_gather_held_total", "counter", "Filter candidates held on REMOTE partition facts: a fresh digest listed them as policy violators.")
+declare("pas_shard_gang_deferred_total", "counter", "Gang overlays skipped because another replica owns the slice's anchor partition (straddling-gang resolution, docs/sharding.md).")
+
 #: process-wide counters: path attribution + JAX compile visibility.
 #: Layer-local CounterSets (the dispatcher's serving counters) stay where
 #: they are; everything request-path-shaped that crosses layers lands here.

@@ -1,0 +1,896 @@
+"""Partition plane (docs/sharding.md):
+
+  * partition math — consistent-hash determinism, the request-path
+    memo, rendezvous ownership with minimal churn;
+  * journaled/fenced ownership — first-tick assignment, convergence of
+    concurrent coordinators, dead-owner handoff with epoch bumps +
+    event-spine provenance, heartbeat renewal cadence, the lost-write
+    race (serve what you READ, retry next tick), the leadership gate,
+    and the static-owner bench mode;
+  * digests — build from a seeded mirror (violators, both-ends top-k,
+    universe digest), lossless wire round trip, fenced ingest,
+    edge-triggered staleness, and the has_violations fastpath gate's
+    deliberately conservative edges;
+  * scatter/gather serving — review_filter's remote-violator merge and
+    fail-open accounting, gather_metric's local+digest merge,
+    remote_holds_possible routing, straddling-gang anchor resolution,
+    and the extender-level Filter/Prioritize integration;
+  * wire — /debug/shard indexed, 404 unwired, 405 non-GET, 200 payload
+    on BOTH front-ends; off path (--shard=off, the default) constructs
+    nothing, exports no pas_shard_* families, and serves byte-identical
+    responses; an all-owning plane changes no Filter byte either;
+  * trace — every pas_shard_* family the plane emits is declared;
+  * HA harness — a partitioned fleet covers the world exactly once and
+    a killed owner's partitions move to survivors.
+"""
+
+import json
+
+import pytest
+
+from benchmarks.http_load import _policy_obj, build_extender, make_bodies
+from platform_aware_scheduling_tpu.extender.server import (
+    DEBUG_ENDPOINTS,
+    HTTPRequest,
+)
+from platform_aware_scheduling_tpu.kube.retry import stable_hash
+from platform_aware_scheduling_tpu.shard import ShardPlane
+from platform_aware_scheduling_tpu.shard.digest import (
+    DIGEST_FORMAT,
+    DigestStore,
+    PartitionDigest,
+    ShardGossip,
+    build_partition_digests,
+    universe_digest,
+)
+from platform_aware_scheduling_tpu.shard.partition import (
+    OWNERS_FORMAT,
+    HandoffCoordinator,
+    PartitionMap,
+    rendezvous_owner,
+)
+from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
+from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.utils import trace
+from platform_aware_scheduling_tpu.utils.events import JOURNAL
+from platform_aware_scheduling_tpu.utils.quantity import Quantity
+from wirehelpers import (
+    get_request,
+    post_bytes,
+    raw_request,
+    start_async,
+    start_threaded,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_journal():
+    JOURNAL.reset()
+    yield
+    JOURNAL.reset()
+
+
+def verb_request(path, body):
+    return HTTPRequest(
+        method="POST",
+        path=path,
+        headers={"Content-Type": "application/json"},
+        body=body,
+    )
+
+
+def journal_events(event):
+    return [r for r in JOURNAL.snapshot() if r["event"] == event]
+
+
+def static_plane(identity="r0", partitions=4, owners=None, **kw):
+    """A plane in bench mode: fixed ownership, no kube I/O."""
+    if owners is None:
+        owners = {p: identity for p in range(partitions)}
+    return ShardPlane(
+        identity, partitions, kube_client=None, static_owners=owners, **kw
+    )
+
+
+class TestPartitionMap:
+    def test_partition_of_is_the_stable_hash_mod_p(self):
+        pmap = PartitionMap(4)
+        for name in ("node-0", "node-1", "tpu-worker-99"):
+            assert pmap.partition_of(name) == stable_hash(name) % 4
+            # second lookup serves from the memo and must agree
+            assert pmap.partition_of(name) == stable_hash(name) % 4
+            assert pmap._memo[name] == stable_hash(name) % 4
+
+    def test_group_partitions_every_name_and_preserves_order(self):
+        pmap = PartitionMap(3)
+        names = [f"node-{i:03d}" for i in range(60)]
+        groups = pmap.group(names)
+        regrouped = [n for p in sorted(groups) for n in groups[p]]
+        assert sorted(regrouped) == sorted(names)
+        for p, members in groups.items():
+            assert members == [n for n in names if pmap.partition_of(n) == p]
+            assert pmap.nodes_in(names, p) == members
+
+    def test_group_serves_from_the_memo(self):
+        """The request path must probe the memo, not rehash: poisoning
+        a memo entry visibly redirects group()."""
+        pmap = PartitionMap(4)
+        pmap.partition_of("node-x")
+        honest = pmap._memo["node-x"]
+        pmap._memo["node-x"] = (honest + 1) % 4
+        assert pmap.group(["node-x"]) == {(honest + 1) % 4: ["node-x"]}
+
+    def test_single_partition_and_validation(self):
+        pmap = PartitionMap(1)
+        assert pmap.group(["a", "b"]) == {0: ["a", "b"]}
+        with pytest.raises(ValueError):
+            PartitionMap(0)
+
+
+class TestRendezvous:
+    MEMBERS = ["replica-a", "replica-b", "replica-c", "replica-d"]
+
+    def test_deterministic_and_order_independent(self):
+        for p in range(8):
+            winner = rendezvous_owner(p, self.MEMBERS)
+            assert winner in self.MEMBERS
+            assert winner == rendezvous_owner(p, list(reversed(self.MEMBERS)))
+
+    def test_minimal_churn_on_member_departure(self):
+        """Removing one member moves ONLY the partitions it owned —
+        every other partition keeps its winner (the rendezvous
+        property that makes handoff cheap)."""
+        before = {p: rendezvous_owner(p, self.MEMBERS) for p in range(32)}
+        gone = "replica-b"
+        survivors = [m for m in self.MEMBERS if m != gone]
+        after = {p: rendezvous_owner(p, survivors) for p in range(32)}
+        for p in range(32):
+            if before[p] != gone:
+                assert after[p] == before[p], f"partition {p} moved"
+            else:
+                assert after[p] in survivors
+
+    def test_empty_membership(self):
+        assert rendezvous_owner(0, []) is None
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_coordinator(client, identity, clock, partitions=4, ttl=15.0, **kw):
+    return HandoffCoordinator(
+        client, identity=identity, partitions=partitions,
+        member_ttl_s=ttl, clock=clock, **kw,
+    )
+
+
+class TestHandoffCoordinator:
+    def test_first_tick_journals_and_assigns_everything(self):
+        client, clock = FakeKubeClient(), FakeClock()
+        coord = make_coordinator(client, "replica-a", clock)
+        coord.tick()
+        assert coord.owned() == frozenset(range(4))
+        assert all(coord.epoch(p) == 1 for p in range(4))
+        # the journal is durable, schema-stamped state
+        cm = client.get_configmap("default", "pas-shard-partitions")
+        state = json.loads(cm["data"]["state"])
+        assert state["format"] == OWNERS_FORMAT
+        assert set(state["owners"]) == {"0", "1", "2", "3"}
+        # cold assignment publishes partition_assign, never handoff
+        assert len(journal_events("partition_assign")) == 4
+        assert journal_events("partition_handoff") == []
+        assert coord.handoffs() == 0
+
+    def test_concurrent_coordinators_converge(self):
+        client, clock = FakeKubeClient(), FakeClock()
+        a = make_coordinator(client, "replica-a", clock)
+        b = make_coordinator(client, "replica-b", clock)
+        a.tick()
+        b.tick()
+        a.tick()  # a re-reads the journal that now includes b
+        expected = {
+            p: rendezvous_owner(p, ["replica-a", "replica-b"])
+            for p in range(4)
+        }
+        for p in range(4):
+            assert a.owner(p) == b.owner(p) == expected[p]
+        assert a.owned() | b.owned() == frozenset(range(4))
+        assert a.owned() & b.owned() == frozenset()
+
+    def test_dead_owner_hands_off_with_epoch_bump(self):
+        client, clock = FakeKubeClient(), FakeClock()
+        a = make_coordinator(client, "replica-a", clock, ttl=10.0)
+        b = make_coordinator(client, "replica-b", clock, ttl=10.0)
+        a.tick()
+        b.tick()
+        a.tick()
+        lost = sorted(b.owned())
+        assert lost, "rendezvous should give replica-b something at P=4"
+        epochs_before = {p: a.epoch(p) for p in lost}
+        JOURNAL.reset()
+        # b never heartbeats again; past the TTL its partitions move
+        clock.t = 11.0
+        a.tick()
+        assert a.owned() == frozenset(range(4))
+        for p in lost:
+            assert a.epoch(p) == epochs_before[p] + 1
+        handoffs = journal_events("partition_handoff")
+        assert {e["data"]["partition"] for e in handoffs} == set(lost)
+        for e in handoffs:
+            assert e["data"]["from"] == "replica-b"
+            assert e["data"]["to"] == "replica-a"
+        assert a.handoffs() == len(lost)
+
+    def test_heartbeat_renews_at_a_third_of_the_ttl(self):
+        client, clock = FakeKubeClient(), FakeClock()
+        coord = make_coordinator(client, "replica-a", clock, ttl=15.0)
+        coord.tick()
+        rv0 = client.get_configmap("default", "pas-shard-partitions")[
+            "metadata"
+        ]["resourceVersion"]
+        clock.t = 2.0  # inside TTL/3: a quiet tick must not write
+        coord.tick()
+        rv1 = client.get_configmap("default", "pas-shard-partitions")[
+            "metadata"
+        ]["resourceVersion"]
+        assert rv1 == rv0
+        clock.t = 6.0  # past TTL/3: the stamp must renew
+        coord.tick()
+        cm = client.get_configmap("default", "pas-shard-partitions")
+        assert cm["metadata"]["resourceVersion"] != rv0
+        state = json.loads(cm["data"]["state"])
+        assert state["members"]["replica-a"] == 6.0
+
+    def test_lost_write_race_serves_what_was_read(self):
+        """A failed journal write must leave the coordinator serving
+        the journaled assignment it READ — no phantom local handoffs,
+        no events — and succeed on the next tick."""
+        client, clock = FakeKubeClient(), FakeClock()
+        a = make_coordinator(client, "replica-a", clock)
+        a.tick()
+        b = make_coordinator(client, "replica-b", clock)
+        real_update = client.update_configmap
+
+        def failing_update(cm):
+            raise RuntimeError("409 conflict: resourceVersion mismatch")
+
+        client.update_configmap = failing_update
+        JOURNAL.reset()
+        b.tick()
+        # b computed a reassignment but could not journal it: it must
+        # keep serving the read state (everything owned by replica-a)
+        assert b.owned() == frozenset()
+        assert all(b.owner(p) == "replica-a" for p in range(4))
+        assert b.handoffs() == 0
+        assert journal_events("partition_handoff") == []
+        assert journal_events("partition_assign") == []
+        client.update_configmap = real_update
+        clock.t = 6.0
+        b.tick()
+        assert b.owned(), "retry against the fresh journal must land"
+
+    def test_follower_never_reassigns(self):
+        class Leadership:
+            def __init__(self, leader):
+                self.leader = leader
+
+            def is_leader(self):
+                return self.leader
+
+        client, clock = FakeKubeClient(), FakeClock()
+        follower = make_coordinator(
+            client, "replica-a", clock, leadership=Leadership(False)
+        )
+        follower.tick()
+        assert follower.owned() == frozenset()
+        # its heartbeat still lands, so a leader sees it as live
+        leader = make_coordinator(
+            client, "replica-b", clock, leadership=Leadership(True)
+        )
+        leader.tick()
+        owners = {leader.owner(p) for p in range(4)}
+        assert owners <= {"replica-a", "replica-b"}
+        assert "replica-a" in json.loads(
+            client.get_configmap("default", "pas-shard-partitions")["data"][
+                "state"
+            ]
+        )["members"]
+
+    def test_static_owners_mode_touches_no_journal(self):
+        coord = HandoffCoordinator(
+            None, identity="owner-1", partitions=3,
+            static_owners={0: "owner-0", 1: "owner-1", 2: "owner-2"},
+        )
+        coord.tick()  # must not raise despite kube_client=None
+        assert coord.owned() == frozenset({1})
+        assert coord.owner(2) == "owner-2"
+        assert all(coord.epoch(p) == 1 for p in range(3))
+
+
+def seeded_extender(num_nodes=24):
+    ext, names = build_extender(num_nodes, device=True)
+    return ext, names
+
+
+def make_digest(partition, epoch=1, stamp=0.0, violations=None, topk=None,
+                owner="remote"):
+    return PartitionDigest(
+        partition=partition,
+        owner=owner,
+        epoch=epoch,
+        version=1,
+        stamp=stamp,
+        node_count=1,
+        universe=7,
+        topk=topk or {},
+        violations=violations or {},
+    )
+
+
+class TestDigestBuild:
+    def test_build_summarizes_owned_partitions_only(self):
+        ext, names = seeded_extender()
+        pmap = PartitionMap(4)
+        groups = pmap.group(names)
+        owned = frozenset({0, 2})
+        # push two partition-0 nodes over the dontschedule target
+        # (write_metric replaces the whole per-node map, so re-seed
+        # every node and boost just the violators)
+        violators = sorted(groups[0][:2])
+        ext.cache.write_metric(
+            "load_metric",
+            {
+                n: NodeMetric(
+                    value=Quantity(2 * 10**9 if n in violators else i + 1)
+                )
+                for i, n in enumerate(names)
+            },
+        )
+        digests = build_partition_digests(
+            ext.mirror, pmap, owned, identity="replica-a",
+            epoch_of=lambda p: 5, topk_of=lambda p: 3, clock=lambda: 42.0,
+        )
+        assert [d.partition for d in digests] == [0, 2]
+        for d in digests:
+            assert d.owner == "replica-a"
+            assert d.epoch == 5
+            assert d.stamp == 42.0
+            assert d.node_count == len(groups[d.partition])
+            assert d.universe == universe_digest(groups[d.partition])
+            summary = d.topk["load_metric"]
+            # both ends, capped at 2k entries, nodes of this partition
+            assert len(summary) <= 6
+            assert set(summary) <= set(groups[d.partition])
+        by_partition = {d.partition: d for d in digests}
+        assert sorted(
+            by_partition[0].violations["load-pol"]
+        ) == violators
+        # partition 2 has no violators: the empty set is OMITTED, so
+        # has_violations stays a cheap truthiness walk
+        assert by_partition[2].violations == {}
+        # the violators also top the high end of the top-k summary
+        summary = by_partition[0].topk["load_metric"]
+        for v in violators:
+            assert summary[v] == max(summary.values())
+
+    def test_wire_round_trip_is_lossless(self):
+        digest = make_digest(
+            3, epoch=7, stamp=1.5,
+            violations={"load-pol": ["node-a", "node-b"]},
+            topk={"load_metric": {"node-a": 11, "node-b": -2}},
+        )
+        obj = json.loads(json.dumps(digest.to_obj()))
+        back = PartitionDigest.from_obj(obj)
+        assert back.to_obj() == digest.to_obj()
+        assert obj["format"] == DIGEST_FORMAT
+        assert PartitionDigest.from_obj({"format": "bogus/9"}) is None
+
+
+class TestDigestStore:
+    def make_store(self, epoch=1, stale=10.0):
+        clock = FakeClock()
+        epochs = {"value": epoch}
+        store = DigestStore(
+            epoch_of=lambda p: epochs["value"],
+            stale_after_s=stale,
+            clock=clock,
+        )
+        return store, clock, epochs
+
+    def test_fenced_ingest_rejected_and_published(self):
+        store, _clock, _epochs = self.make_store(epoch=3)
+        assert store.put(make_digest(1, epoch=2)) is False
+        assert store.fenced_rejects == 1
+        (event,) = journal_events("digest_fenced")
+        assert event["data"] == {
+            "partition": 1, "owner": "remote", "epoch": 2,
+            "current_epoch": 3,
+        }
+        assert store.fresh(1) is None
+        # current-epoch digests land
+        assert store.put(make_digest(1, epoch=3)) is True
+        assert store.fresh(1).epoch == 3
+
+    def test_never_replace_newer_with_older(self):
+        store, _clock, _epochs = self.make_store()
+        assert store.put(make_digest(0, epoch=1, stamp=5.0)) is True
+        assert store.put(make_digest(0, epoch=1, stamp=2.0)) is False
+        assert store.fresh(0).stamp == 5.0
+
+    def test_staleness_fails_open_edge_triggered(self):
+        store, clock, _epochs = self.make_store(stale=10.0)
+        store.put(make_digest(2, stamp=0.0))
+        clock.t = 5.0
+        assert store.fresh(2) is not None
+        clock.t = 10.5
+        assert store.fresh(2) is None
+        assert store.fresh(2) is None  # second trip, same episode
+        assert len(journal_events("digest_stale")) == 1
+        # a fresh digest ends the episode; the NEXT one is a new event
+        store.put(make_digest(2, stamp=11.0))
+        assert store.fresh(2) is not None
+        clock.t = 30.0
+        assert store.fresh(2) is None
+        assert len(journal_events("digest_stale")) == 2
+
+    def test_fenced_since_ingest_fails_open(self):
+        store, _clock, epochs = self.make_store(epoch=1)
+        store.put(make_digest(0, epoch=1))
+        epochs["value"] = 2  # handoff mid-shelf-life
+        assert store.fresh(0) is None
+
+    def test_has_violations_is_deliberately_conservative(self):
+        store, clock, epochs = self.make_store(stale=10.0)
+        assert store.has_violations() is False
+        store.put(make_digest(0, violations={"pol": ["n1"]}))
+        store.put(make_digest(1))
+        assert store.has_violations() is True
+        # the gate excludes owned partitions: their violators are the
+        # local solve's own facts
+        assert store.has_violations(exclude={0}) is False
+        # stale and fenced-since-ingest digests KEEP the gate True —
+        # the only safe direction is toward the reviewed path
+        clock.t = 99.0
+        assert store.fresh(0) is None
+        assert store.has_violations() is True
+        epochs["value"] = 7
+        assert store.has_violations() is True
+
+
+class TestGossip:
+    def test_callable_peers_and_dead_peer_accounting(self):
+        store, _clock, _epochs = TestDigestStore().make_store()
+        payload = {
+            "digests": {
+                "1": make_digest(1, violations={"pol": ["n"]}).to_obj()
+            }
+        }
+
+        def dead_peer():
+            raise OSError("connection refused")
+
+        gossip = ShardGossip(
+            store, peers=[lambda: payload, dead_peer, lambda: b"{}"]
+        )
+        assert gossip.pull() == 1
+        assert gossip.pulls_ok == 2
+        assert gossip.pulls_failed == 1
+        assert store.fresh(1) is not None
+        # once a FRESHER digest is shelved, re-offering the old one
+        # ingests nothing (the store's newer-wins rule)
+        store.put(make_digest(1, stamp=5.0))
+        assert gossip.pull() == 0
+
+
+class TestShardPlane:
+    def test_review_filter_merges_remote_violators(self):
+        plane = static_plane("r0", 4, owners={0: "r0", 1: "r1", 2: "r2",
+                                              3: "r3"})
+        names = [f"node-{i:04d}" for i in range(40)]
+        remote = [n for n in names if plane.pmap.partition_of(n) == 1]
+        stamp = plane.clock()
+        plane.store.put(make_digest(
+            1, stamp=stamp, violations={"load-pol": [remote[0], "absent-n"]}
+        ))
+        plane.store.put(make_digest(2, stamp=stamp))
+        held, consulted = plane.review_filter("load-pol", names)
+        # only violators IN the request are held; partition 3 had no
+        # digest so the review failed open for it, visibly
+        assert held == [remote[0]]
+        assert consulted == 2
+        assert plane.gather_local_only == 1
+        # a policy the digests never mention holds nothing
+        held, consulted = plane.review_filter("other-pol", names)
+        assert held == []
+        assert consulted == 2
+
+    def test_review_filter_skips_owned_partitions(self):
+        plane = static_plane("r0", 2, owners={0: "r0", 1: "r0"})
+        plane.store.put(make_digest(
+            0, stamp=plane.clock(), violations={"load-pol": ["node-x"]}
+        ))
+        held, consulted = plane.review_filter("load-pol", ["node-x"])
+        assert held == [] and consulted == 0
+        assert plane.gather_local_only == 0
+
+    def test_remote_holds_possible_routes_the_fastpath(self):
+        plane = static_plane("r0", 2, owners={0: "r0", 1: "r1"})
+        assert plane.remote_holds_possible() is False
+        # an OWN-partition digest with violators never flips the gate
+        plane.store.put(make_digest(
+            0, stamp=plane.clock(), violations={"pol": ["mine"]}
+        ))
+        assert plane.remote_holds_possible() is False
+        plane.store.put(make_digest(
+            1, stamp=plane.clock(), violations={"pol": ["theirs"]}
+        ))
+        assert plane.remote_holds_possible() is True
+
+    def test_gather_metric_merges_local_and_digest_values(self):
+        ext, names = seeded_extender()
+        plane = static_plane("r0", 2, owners={0: "r0", 1: "r1"})
+        plane.attach(ext.cache, ext.mirror)
+        groups = plane.pmap.group(names)
+        local, remote = groups[0], groups[1]
+        plane.store.put(make_digest(
+            1, stamp=plane.clock(),
+            topk={"load_metric": {remote[0]: 123456}},
+        ))
+        merged = plane.gather_metric("load_metric", names)
+        view = ext.mirror.device_view()
+        row = view.metric_index["load_metric"]
+        for name in local:
+            assert merged[name] == int(
+                view.values_milli[row, view.node_index[name]]
+            )
+        assert merged[remote[0]] == 123456
+        # remote nodes outside the top-k are absent, like missing
+        # metric data on the host path — and the miss is not a
+        # local-only event (the digest WAS consulted)
+        for name in remote[1:]:
+            assert name not in merged
+        assert plane.gather_local_only == 0
+
+    def test_gather_metric_counts_missing_remote_digest(self):
+        ext, names = seeded_extender()
+        plane = static_plane("r0", 2, owners={0: "r0", 1: "r1"})
+        plane.attach(ext.cache, ext.mirror)
+        plane.gather_metric("load_metric", names)
+        assert plane.gather_local_only == 1
+        assert plane.counters.get(
+            "pas_shard_gather_local_only_total",
+            kind="counter",
+            labels={"verb": "prioritize"},
+        ) == 1
+
+    def test_anchor_partition_resolution(self):
+        plane = static_plane("r0", 4, owners={0: "r0", 1: "r1", 2: "r0",
+                                              3: "r1"})
+        names = [f"node-{i}" for i in range(12)]
+        anchored = plane.anchor_partition(names)
+        assert anchored == plane.pmap.partition_of(names[0])
+        assert plane.owns_anchor(names) == (
+            anchored in plane.coordinator.owned()
+        )
+        # an empty slice anchors nowhere and is always "ours" (the
+        # overlay then applies as in full-world mode)
+        assert plane.anchor_partition([]) is None
+        assert plane.owns_anchor([]) is True
+
+    def test_refresh_filter_cuts_ingest_to_owned(self):
+        ext, names = seeded_extender()
+        plane = static_plane("r0", 4, owners={0: "r0", 1: "r1", 2: "r2",
+                                              3: "r3"})
+        plane.attach(ext.cache, ext.mirror)
+        info = {n: object() for n in names}
+        kept = ext.cache.refresh_filter(info)
+        owned_names = plane.pmap.nodes_in(names, 0)
+        assert sorted(kept) == sorted(owned_names)
+        counters = plane.counters
+        assert counters.get(
+            "pas_shard_refresh_nodes_total", kind="counter",
+            labels={"scope": "owned"},
+        ) == len(owned_names)
+        assert counters.get(
+            "pas_shard_refresh_nodes_total", kind="counter",
+            labels={"scope": "skipped"},
+        ) == len(names) - len(owned_names)
+
+    def test_refresh_pass_publishes_own_digests(self):
+        ext, _names = seeded_extender()
+        plane = static_plane("r0", 2, owners={0: "r0", 1: "r0"})
+        plane.attach(ext.cache, ext.mirror)
+        plane.on_refresh_pass()
+        assert set(plane.store.snapshot()["digests"]) == {"0", "1"}
+        assert plane.counters.get(
+            "pas_shard_ticks_total", kind="counter"
+        ) == 1
+
+
+def find_remote_node(plane, names, partition):
+    for name in names:
+        if plane.pmap.partition_of(name) == partition:
+            return name
+    raise AssertionError(f"no node hashed into partition {partition}")
+
+
+class TestServingIntegration:
+    def test_filter_holds_remote_digest_violators(self):
+        ext, names = seeded_extender()
+        plane = static_plane("r0", 2, owners={0: "r0", 1: "r1"})
+        plane.attach(ext.cache, ext.mirror)
+        ext.shard = plane
+        victim = find_remote_node(plane, names, 1)
+        plane.store.put(make_digest(
+            1, stamp=plane.clock(), owner="r1",
+            violations={"load-pol": [victim]},
+        ))
+        body = make_bodies(names, "nodenames", count=1)[0]
+        response = ext.filter(verb_request("/scheduler/filter", body))
+        assert response.status == 200
+        out = json.loads(response.body)
+        assert victim not in out["NodeNames"]
+        assert "remote partition digest" in out["FailedNodes"][victim]
+        assert plane.counters.get(
+            "pas_shard_gather_held_total", kind="counter"
+        ) == 1
+
+    def test_filter_without_remote_violators_matches_full_world(self):
+        """The fastpath gate: while no remote digest lists a violator
+        the sharded Filter verdict — served natively — is byte-equal to
+        the full-world build's."""
+        ext_off, names = seeded_extender()
+        body = make_bodies(names, "nodenames", count=1)[0]
+        baseline = ext_off.filter(verb_request("/scheduler/filter", body))
+        ext_on, _names = seeded_extender()
+        plane = static_plane("r0", 2, owners={0: "r0", 1: "r1"})
+        plane.attach(ext_on.cache, ext_on.mirror)
+        ext_on.shard = plane
+        plane.store.put(make_digest(1, stamp=plane.clock(), owner="r1"))
+        sharded = ext_on.filter(verb_request("/scheduler/filter", body))
+        assert sharded.status == baseline.status == 200
+        assert sharded.body == baseline.body
+
+    def test_shard_prioritize_ranks_the_merged_map(self):
+        ext, names = seeded_extender()
+        plane = static_plane("r0", 2, owners={0: "r0", 1: "r1"})
+        plane.attach(ext.cache, ext.mirror)
+        ext.shard = plane
+        remote = find_remote_node(plane, names, 1)
+        plane.store.put(make_digest(
+            1, stamp=plane.clock(), owner="r1",
+            topk={"load_metric": {remote: 10**10}},
+        ))
+        body = make_bodies(names, "nodenames", count=1)[0]
+        response = ext.prioritize(verb_request("/scheduler/prioritize", body))
+        assert response.status == 200
+        ranked = json.loads(response.body)
+        # GreaterThan: the digest's huge value must rank first even
+        # though the node lives on a partition this replica never held
+        assert ranked[0]["Host"] == remote
+        by_host = {r["Host"]: r["Score"] for r in ranked}
+        assert max(by_host.values()) == by_host[remote]
+
+
+@pytest.mark.parametrize("front_end", ["threaded", "async"])
+class TestDebugShardEndpoint:
+    def _start(self, front_end, ext):
+        return start_async(ext) if front_end == "async" else start_threaded(
+            ext
+        )
+
+    def test_404_when_off(self, front_end):
+        ext, _names = seeded_extender(8)
+        server = self._start(front_end, ext)
+        try:
+            status, _, body = get_request(server.port, "/debug/shard")
+            assert status == 404
+            assert "shard plane" in json.loads(body)["error"]
+            status, _, body = get_request(server.port, "/metrics")
+            assert status == 200
+            assert b"pas_shard_" not in body
+        finally:
+            server.shutdown()
+
+    def test_payload_and_405(self, front_end):
+        ext, _names = seeded_extender(8)
+        plane = static_plane("wire-replica", 2,
+                             owners={0: "wire-replica", 1: "wire-replica"})
+        plane.attach(ext.cache, ext.mirror)
+        ext.shard = plane
+        plane.on_refresh_pass()
+        server = self._start(front_end, ext)
+        try:
+            status, headers, payload = get_request(
+                server.port, "/debug/shard"
+            )
+            assert status == 200
+            assert headers["content-type"] == "application/json"
+            out = json.loads(payload)
+            assert out["identity"] == "wire-replica"
+            assert out["partitions"] == 2
+            assert out["coordinator"]["owned"] == [0, 1]
+            assert set(out["digests"]) == {"0", "1"}
+            for digest in out["digests"].values():
+                assert digest["format"] == DIGEST_FORMAT
+                assert "age_s" in digest
+            assert "gossip" in out and "topk" in out
+            # the payload IS the gossip wire format: a peer ingests it
+            store, _c, _e = TestDigestStore().make_store()
+            assert ShardGossip(store, peers=[lambda: payload]).pull() == 2
+            status, _, _ = raw_request(
+                server.port, post_bytes("/debug/shard", b"{}")
+            )
+            assert status == 405
+            # the wired plane's families reach the SERVED /metrics on
+            # this front-end (the async server aggregates counter sets
+            # dynamically — serving/http.py must include the shard set)
+            status, _, body = get_request(server.port, "/metrics")
+            assert status == 200
+            assert b"pas_shard_ticks_total" in body
+        finally:
+            server.shutdown()
+
+    def test_indexed(self, front_end):
+        assert "/debug/shard" in {e["path"] for e in DEBUG_ENDPOINTS}
+
+
+class TestOffPath:
+    def test_default_constructs_nothing(self):
+        ext, _names = seeded_extender(8)
+        assert ext.shard is None
+
+    @pytest.mark.parametrize("front_end", ["threaded", "async"])
+    def test_off_path_wire_byte_identical_and_no_families(self, front_end):
+        """Two independent --shard=off builds answer byte-identically
+        over real sockets (modulo X-Request-ID) and expose no
+        pas_shard_* family at all; an all-owning plane doesn't change
+        the Filter bytes either (the gate keeps it on the native
+        path)."""
+        wire = {}
+        for label in ("off_a", "off_b", "on"):
+            ext, names = seeded_extender(12)
+            if label == "on":
+                plane = static_plane("solo", 2,
+                                     owners={0: "solo", 1: "solo"})
+                plane.attach(ext.cache, ext.mirror)
+                ext.shard = plane
+                plane.on_refresh_pass()
+            server = (
+                start_async(ext) if front_end == "async"
+                else start_threaded(ext)
+            )
+            try:
+                body = make_bodies(names, "nodenames", count=1)[0]
+                wire[label] = {
+                    path: raw_request(server.port, post_bytes(path, body))
+                    for path in (
+                        "/scheduler/prioritize", "/scheduler/filter",
+                    )
+                }
+                text = ext.metrics_text()
+                if label == "on":
+                    assert "pas_shard_" in text
+                else:
+                    assert "pas_shard_" not in text
+            finally:
+                server.shutdown()
+        drop = "x-request-id"
+        for path, (status, headers, body) in wire["off_a"].items():
+            b_status, b_headers, b_body = wire["off_b"][path]
+            assert status == b_status == 200
+            assert body == b_body
+            assert {k: v for k, v in headers.items() if k != drop} == {
+                k: v for k, v in b_headers.items() if k != drop
+            }
+        status, _headers, body = wire["on"]["/scheduler/filter"]
+        assert status == 200
+        assert body == wire["off_a"]["/scheduler/filter"][2]
+
+
+class TestTraceFamilies:
+    FAMILIES = (
+        "pas_shard_ticks_total",
+        "pas_shard_refresh_nodes_total",
+        "pas_shard_digests_published_total",
+        "pas_shard_gossip_ingested_total",
+        "pas_shard_digest_fenced_total",
+        "pas_shard_digest_stale_total",
+        "pas_shard_gather_local_only_total",
+        "pas_shard_gather_held_total",
+        "pas_shard_gang_deferred_total",
+    )
+
+    def test_every_family_declared(self):
+        for family in self.FAMILIES:
+            assert family in trace.METRICS, f"undeclared {family!r}"
+            kind, _help = trace.METRICS[family]
+            assert kind == "counter"
+
+    def test_wired_plane_exports_parseable_families(self):
+        ext, names = seeded_extender(8)
+        plane = static_plane("m0", 2, owners={0: "m0", 1: "m1"})
+        plane.attach(ext.cache, ext.mirror)
+        ext.shard = plane
+        plane.on_refresh_pass()
+        ext.cache.refresh_filter({n: object() for n in names})
+        plane.store.put(make_digest(1, epoch=0))  # fenced
+        text = ext.metrics_text()
+        families = trace.parse_prometheus_text(text)
+        for family in (
+            "pas_shard_ticks_total",
+            "pas_shard_refresh_nodes_total",
+            "pas_shard_digest_fenced_total",
+        ):
+            assert family in families, family
+        for family in families:
+            assert family in trace.METRICS, f"undeclared {family!r}"
+
+
+class TestHAHarnessShard:
+    def test_partitioned_fleet_covers_the_world_once(self):
+        from platform_aware_scheduling_tpu.testing.ha import HAHarness
+
+        harness = HAHarness(
+            replicas=3, num_nodes=12, shard_partitions=4, period_s=1.0
+        )
+        harness.run(4)
+        owned = [
+            stack.shard.coordinator.owned() for stack in harness.live()
+        ]
+        assert frozenset().union(*owned) == frozenset(range(4))
+        for i, a in enumerate(owned):
+            for b in owned[i + 1:]:
+                assert a & b == frozenset()
+        # every OWNED partition's nodes are interned in the owner's
+        # mirror (the ~1/P ingest cut never starves a local solve)
+        names = [f"node-{i}" for i in range(12)]
+        for stack in harness.live():
+            mine = {
+                n for n in names
+                if stack.shard.pmap.partition_of(n)
+                in stack.shard.coordinator.owned()
+            }
+            view = stack.mirror.device_view()
+            assert mine <= set(view.node_names)
+
+    def test_crashed_owner_hands_partitions_to_survivors(self):
+        from platform_aware_scheduling_tpu.testing.ha import HAHarness
+
+        harness = HAHarness(
+            replicas=3, num_nodes=12, shard_partitions=4, period_s=1.0,
+            lease_duration_s=3.0,
+        )
+        harness.run(4)
+        victim_index = next(
+            i for i, stack in enumerate(harness.replicas)
+            if stack.shard.coordinator.owned()
+        )
+        victim = harness.replicas[victim_index]
+        lost = victim.shard.coordinator.owned()
+        epochs_before = {
+            p: max(
+                s.shard.coordinator.epoch(p) for s in harness.live()
+            )
+            for p in lost
+        }
+        harness.crash(victim_index)
+        harness.run(8)
+        survivors = harness.live()
+        merged = frozenset().union(
+            *(s.shard.coordinator.owned() for s in survivors)
+        )
+        assert merged == frozenset(range(4))
+        # every lost partition moved AND its fencing epoch advanced, so
+        # a digest the victim stamped pre-crash can never land again
+        for p in lost:
+            assert harness.shard_owners()[p] != victim.identity
+            epoch_now = max(
+                s.shard.coordinator.epoch(p) for s in survivors
+            )
+            assert epoch_now > epochs_before[p]

@@ -687,7 +687,7 @@ class TestDebugIndexCompleteness:
         "/debug/forecast", "/debug/leader", "/debug/slo",
         "/debug/wire", "/debug/profile", "/debug/record",
         "/debug/whatif", "/debug/control", "/debug/admission",
-        "/debug/explain", "/debug/solve",
+        "/debug/explain", "/debug/solve", "/debug/shard",
     }
 
     def test_index_names_every_debug_route(self):

@@ -539,7 +539,7 @@ class TestCausalSpine:
             JOURNAL.reset()
 
     def test_flight_export_is_anonymous_and_versioned(self):
-        assert FORMAT == "pas-flight-record/3"
+        assert FORMAT == "pas-flight-record/4"
         rec = FlightRecorder()
         rec.record_churn(3, 17, 100, 0.0567)
         (event,) = rec.events()

@@ -34,6 +34,7 @@ class TestScenarioMatrix:
             tw.MetricStorm,
             tw.LeaderKillComposite,
             tw.GangWave,
+            tw.PartitionHandoff,
         ],
         ids=lambda cls: cls.name,
     )
