@@ -1,5 +1,5 @@
 # Build/test fan-out (capability parity: reference top-level Makefile:1-9).
-.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin bench-shard test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-shard test-record test-control test-admission test-explain test-solveobs bench-control bench-admission bench-replay bench-ledger test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
+.PHONY: all test e2e e2e-kind bench bench-http bench-gas bench-gang bench-configs bench-serving bench-rebalance bench-chaos bench-decisions bench-forecast bench-ha bench-twin bench-shard test-serving test-obs test-rebalance test-faults test-decisions test-gang test-forecast test-ha test-slo test-shard test-record test-control test-admission test-explain test-solveobs bench-control bench-admission bench-replay bench-ledger test-fuzz fuzz-smoke test-wirec trace-lint pascheck obs-smoke lint image clean dryrun
 
 all: test
 
@@ -187,6 +187,25 @@ bench-ledger:
 # better and the quiet day stays silent
 bench-admission:
 	python -m benchmarks.admission_load
+
+# adversarial scenario search suite (docs/robustness.md "Adversarial
+# scenario search"): seeded-LCG determinism + the pinned draw values,
+# genome generation/mutation/validation, byte-identical candidate
+# replay, coverage-novelty corpus, delta-debug minimization, planted
+# bugs, the oracle no-false-positive matrix, and the committed
+# minimized scenarios under tests/scenarios/
+test-fuzz:
+	python -m pytest tests/test_fuzz.py tests/test_oracles.py -q
+
+# coverage-guided fuzzing smoke (benchmarks/fuzz_load.py): the four CI
+# gates inside one wall-clock budget — reproducibility (same seed =>
+# byte-identical candidate sequence), planted-bug detection (the
+# stale-digest splice must be found AND minimized to <= 20 ticks /
+# <= 8 events), no false positives on the healthy tree, and the
+# candidate-throughput floor; exits nonzero on any gate failure.  Any
+# find on the healthy tree is a real bug and is printed, never swallowed
+fuzz-smoke:
+	env JAX_PLATFORMS=cpu python -m benchmarks.fuzz_load
 
 # replay throughput (legacy vs vectorized twin load model) + the
 # what-if demo: 2x load must degrade the availability verdict a 1x

@@ -171,7 +171,7 @@ def assemble_line(
     headline, load, configs_out, gas=None, serving=None, rebalance=None,
     chaos=None, decisions=None, gang=None, forecast=None, ha=None,
     twin=None, record=None, control=None, admission=None, ledger=None,
-    shard=None,
+    shard=None, fuzz=None,
 ):
     """(result, detail): the printed JSON line dict — insertion-ordered so
     the headline aliases and {metric, value, unit, vs_baseline} are the
@@ -419,6 +419,22 @@ def assemble_line(
             "overhead_pct_filter_p99": record.get(
                 "overhead_pct_filter_p99"
             ),
+        }
+    if fuzz is not None:
+        # full search summary + any finds to disk; the line keeps the
+        # reproducibility verdict, the search volume, and the find count
+        # — on the healthy tree ANY find is a real bug, so a nonzero
+        # count here is the loudest number on the line
+        # (benchmarks/fuzz_load.py; docs/robustness.md "Adversarial
+        # scenario search")
+        detail["fuzz"] = fuzz
+        result["fuzz"] = {
+            "reproducible": fuzz.get("reproducible"),
+            "candidates": fuzz.get("candidates"),
+            "candidates_per_s": fuzz.get("candidates_per_s"),
+            "coverage_signals": fuzz.get("coverage_signals"),
+            "finds": fuzz.get("finds"),
+            "find_failures": fuzz.get("find_failures"),
         }
     if ledger is not None:
         # full measurement + overhead pin to disk; the line keeps the
@@ -815,6 +831,30 @@ def main():
     except Exception as exc:  # must never sink the headline
         print(f"record bench failed: {exc}", file=sys.stderr)
 
+    # --- adversarial scenario fuzzing: a short budgeted coverage-guided
+    # search + the reproducibility pin (benchmarks/fuzz_load.py;
+    # docs/robustness.md "Adversarial scenario search") ---
+    fuzz_out = None
+    try:
+        from benchmarks import fuzz_load
+
+        fuzz_out = fuzz_load.run()
+        print(
+            f"fuzz: reproducible={fuzz_out['reproducible']}, "
+            f"{fuzz_out['candidates']} candidates "
+            f"({fuzz_out['candidates_per_s']}/s, "
+            f"{fuzz_out['coverage_signals']} coverage signals, corpus "
+            f"{fuzz_out['corpus_size']}); finds={fuzz_out['finds']}"
+            + (
+                f" REAL BUGS {fuzz_out['find_failures']}"
+                if fuzz_out["finds"]
+                else ""
+            ),
+            file=sys.stderr,
+        )
+    except Exception as exc:  # must never sink the headline
+        print(f"fuzz bench failed: {exc}", file=sys.stderr)
+
     # --- perf-regression ledger: fresh per-stage solve floors vs the
     # COMMITTED anchor + the observatory instrumented-vs-off pin
     # (benchmarks/perf_ledger.py; docs/observability.md "Solve
@@ -860,7 +900,7 @@ def main():
     result, detail = assemble_line(
         headline, load, configs_out, gas, serving, rebalance, chaos,
         decisions_out, gang, forecast_out, ha_out, twin_out, record_out,
-        control_out, admission_out, ledger_out, shard_out,
+        control_out, admission_out, ledger_out, shard_out, fuzz_out,
     )
     # detail (and its stderr pointer) go FIRST; the headline JSON must be
     # the LAST stdout line so a tail-capturing driver always parses it

@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import sys
 import time
+from dataclasses import replace
 from pathlib import Path
 from typing import List, Optional
 
@@ -73,6 +74,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     started = time.perf_counter()
     try:
         findings = run_checks(root, checks, skip=skip)
+        # the repo's benchmarks/ sits OUTSIDE the package but feeds the
+        # fuzz/bench reproducibility pins, so the randomness check
+        # covers it too (default-root runs only — an explicit --root
+        # means the caller picked their own scope)
+        bench_root = root.parent / "benchmarks"
+        if (
+            args.root is None
+            and bench_root.is_dir()
+            and (checks is None or "randomness" in checks)
+        ):
+            for finding in run_checks(bench_root, ("randomness",)):
+                findings.append(
+                    replace(finding, path=f"benchmarks/{finding.path}")
+                )
+            findings.sort(
+                key=lambda f: (f.path, f.line, f.check, f.code, f.symbol)
+            )
     except ValueError as exc:
         print(f"pascheck: {exc}", file=sys.stderr)
         return 2

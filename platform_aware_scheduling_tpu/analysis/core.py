@@ -34,8 +34,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-#: the four project checkers + the pragma meta-check
-CHECK_NAMES = ("clock", "hotpath", "locks", "metrics")
+#: the five project checkers + the pragma meta-check
+CHECK_NAMES = ("clock", "hotpath", "locks", "metrics", "randomness")
 
 PACKAGE = "platform_aware_scheduling_tpu"
 
@@ -395,6 +395,7 @@ def run_checks(
         hotpath,
         locks,
         metricscheck,
+        randomness,
     )
 
     selected = tuple(checks) if checks else CHECK_NAMES
@@ -410,6 +411,8 @@ def run_checks(
         findings.extend(locks.check(modules))
     if "metrics" in selected:
         findings.extend(metricscheck.check(modules, inventory=metrics_inventory))
+    if "randomness" in selected:
+        findings.extend(randomness.check(modules))
     kept: List[Finding] = []
     for finding in findings:
         mod = _module_for(modules, finding.path)

@@ -1,0 +1,97 @@
+"""Checker ``randomness``: no ambient randomness outside injected seeds.
+
+The fuzzer's reproducibility pin (same seed + budget => byte-identical
+candidate sequences, ``make fuzz-smoke`` gate 1) and every twin replay
+hold only because all randomness flows from an explicitly injected
+seed.  One ``random.random()`` in a load generator and a minimized
+scenario stops replaying; one ``np.random.seed(...)`` and two tests
+sharing a process silently couple.  Process-global RNG state is the
+clock problem all over again, so it gets the same treatment as
+``clock``: a checker, not a convention.
+
+Flagged (calls only — seeded constructor CALLS are the boundary):
+
+  * module-level convenience calls: ``random.random()``,
+    ``random.randint(...)``, ``random.shuffle(...)``,
+    ``np.random.rand(...)``, ... — they read/mutate hidden global state
+  * global seeding: ``random.seed(...)``, ``np.random.seed(...)`` —
+    cross-test coupling dressed up as determinism
+  * zero-argument constructors: ``random.Random()``,
+    ``np.random.default_rng()`` — an RNG object, but seeded off entropy
+
+Sanctioned: constructing a generator FROM an injected seed —
+``random.Random(seed)``, ``np.random.default_rng(seed)``,
+``np.random.RandomState(seed)``, ``np.random.Generator(bitgen)`` —
+and every method call on the resulting object (``rng.random()``
+resolves to a local name, not the ``random`` module, so it never
+matches).  ``jax.random`` is keyed by construction and not in scope.
+
+Genuine boundaries carry ``# pascheck: allow[randomness] -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from platform_aware_scheduling_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    enclosing_functions,
+)
+
+#: generator constructors that are FINE when handed a seed (>= 1
+#: argument) and a finding when called bare (entropy-seeded)
+SEEDED_CONSTRUCTORS = frozenset({
+    "random.Random",
+    "numpy.random.default_rng",
+    "numpy.random.RandomState",
+    "numpy.random.Generator",
+})
+
+#: dotted prefixes whose remaining calls are ambient-state randomness
+AMBIENT_PREFIXES = ("random.", "numpy.random.")
+
+
+def check(modules: Dict[str, ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules.values():
+        spans = None
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func, mod.imports)
+            if callee is None:
+                continue
+            if callee in SEEDED_CONSTRUCTORS:
+                if node.args or node.keywords:
+                    continue  # seeded — the sanctioned boundary
+                code = "unseeded-rng"
+                message = (
+                    f"{callee}() constructed without a seed — thread the "
+                    "injected seed through (e.g. "
+                    "np.random.default_rng(seed)) so runs replay"
+                )
+            elif callee.startswith(AMBIENT_PREFIXES):
+                code = "ambient-rng"
+                message = (
+                    f"{callee}() uses process-global RNG state — draw "
+                    "from a generator built off an injected seed instead "
+                    "(np.random.default_rng(seed) / random.Random(seed)); "
+                    "global state breaks fuzz/scenario reproducibility"
+                )
+            else:
+                continue
+            if spans is None:
+                spans = enclosing_functions(mod.tree)
+            func = spans.get(node.lineno, "<module>")
+            findings.append(Finding(
+                "randomness",
+                code,
+                mod.relpath,
+                node.lineno,
+                f"{func}:{callee}",
+                message,
+            ))
+    return findings

@@ -279,6 +279,7 @@ class Rebalancer:
                 "considered_pods": plan.considered,
                 "skipped_pods": plan.skipped_pods,
                 "truncated_moves": plan.truncated,
+                "deferred_moves": plan.deferred,
                 "moves": [m._asdict() for m in plan.moves],
                 "executed": [m.pod_key for m in actuation.executed],
                 "skipped": actuation.skip_counts(),

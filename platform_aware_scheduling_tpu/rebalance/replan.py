@@ -58,6 +58,16 @@ DEFAULT_VIOLATION_PENALTY = 4.0
 #: this much headroom over the pod's current node
 DEFAULT_MIGRATION_COST = 0.1
 DEFAULT_MAX_MOVES = 5
+#: incoming moves any one destination accepts per cycle.  Telemetry
+#: utilities rank nodes globally, so every evictee prefers the SAME
+#: least-loaded node; slot capacity alone lets the whole herd land
+#: there, which overshoots the very threshold the move was curing and
+#: ping-pongs the same pods between destinations every hysteresis
+#: window (found by the scenario fuzzer: tests/scenarios/
+#: rebalance_herd.json).  One-in-per-cycle spreads the herd across
+#: distinct destinations; the next cycle replans against fresh
+#: telemetry that already includes the landed pods.
+DEFAULT_MAX_INFLOW = 1
 
 
 class Move(NamedTuple):
@@ -76,6 +86,7 @@ class PlanResult(NamedTuple):
     truncated: int  # moves dropped by the churn budget
     latency_s: float
     view_version: int
+    deferred: int = 0  # moves held back by the per-destination inflow cap
 
 
 @partial(jax.jit, static_argnames=("solver",))
@@ -138,6 +149,7 @@ class IncrementalReplanner:
         violation_penalty: float = DEFAULT_VIOLATION_PENALTY,
         max_moves: int = DEFAULT_MAX_MOVES,
         default_node_capacity: int = DEFAULT_NODE_CAPACITY,
+        max_inflow: Optional[int] = DEFAULT_MAX_INFLOW,
     ):
         if solver not in ("greedy", "sinkhorn"):
             raise ValueError(f"unknown rebalance solver {solver!r}")
@@ -147,6 +159,7 @@ class IncrementalReplanner:
         self.violation_penalty = float(violation_penalty)
         self.max_moves = int(max_moves)
         self.default_node_capacity = int(default_node_capacity)
+        self.max_inflow = None if max_inflow is None else max(1, int(max_inflow))
 
     def plan(
         self,
@@ -248,6 +261,30 @@ class IncrementalReplanner:
                 )
             )
         moves.sort(key=lambda m: (-m.gain, m.pod_key))
+        deferred = 0
+        if self.max_inflow is not None:
+            # anti-herding (DEFAULT_MAX_INFLOW): keep only the
+            # highest-gain ``max_inflow`` moves per destination; the
+            # rest stay put this cycle and replan next cycle against
+            # telemetry that already includes the landed pods.  Applied
+            # host-side so the solvers' capacity semantics (sinkhorn's
+            # column scaling in particular) are untouched.
+            inflow: Dict[str, int] = {}
+            spread: List[Move] = []
+            for move in moves:
+                landed = inflow.get(move.to_node, 0)
+                if landed >= self.max_inflow:
+                    deferred += 1
+                    continue
+                inflow[move.to_node] = landed + 1
+                spread.append(move)
+            if deferred:
+                klog.v(4).info_s(
+                    f"inflow cap: {deferred} moves deferred "
+                    f"(max {self.max_inflow} per destination/cycle)",
+                    component="rebalance",
+                )
+            moves = spread
         truncated = max(0, len(moves) - self.max_moves)
         if truncated:
             klog.v(4).info_s(
@@ -263,6 +300,7 @@ class IncrementalReplanner:
             truncated=truncated,
             latency_s=time.perf_counter() - t0,
             view_version=view.version,
+            deferred=deferred,
         )
 
     def _capacity_vector(

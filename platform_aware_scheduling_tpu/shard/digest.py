@@ -378,17 +378,33 @@ class ShardGossip:
     ``/debug/shard`` with a short timeout, for the multi-process bench
     and production) or zero-arg callables returning the same JSON (the
     in-process harness/twin).  Each pull ingests every digest found —
-    the store's epoch fencing and freshness rules decide what sticks."""
+    the store's epoch fencing and freshness rules decide what sticks.
+
+    The gossip path is fault-injectable like every other verb: set
+    ``fault_plan`` (a ``testing.faults.FaultPlan``) and the pull
+    consults verb ``"shard_gossip"`` once per peer — an erroring fault
+    is a failed pull (the peer went dark mid-exchange), a latency fault
+    advances ``fault_clock`` before the fetch (a slow peer ages the
+    digests it delivers), and a ``truncate`` fault keeps only the first
+    N digests of the payload (a cut-off answer; whatever survives
+    merges normally)."""
+
+    #: the FaultPlan verb name the pull consumes, one entry per peer
+    FAULT_VERB = "shard_gossip"
 
     def __init__(
         self,
         store: DigestStore,
         peers: Sequence = (),
         timeout_s: float = 1.0,
+        fault_plan=None,
+        fault_clock=None,
     ):
         self.store = store
         self.peers = list(peers)
         self.timeout_s = float(timeout_s)
+        self.fault_plan = fault_plan
+        self.fault_clock = fault_clock
         self.pulls_ok = 0
         self.pulls_failed = 0
 
@@ -407,6 +423,20 @@ class ShardGossip:
         Never raises — a dead peer costs one failed-pull count."""
         ingested = 0
         for peer in self.peers:
+            fault = None
+            if self.fault_plan is not None:
+                fault = self.fault_plan.next(self.FAULT_VERB)
+            if fault is not None and fault.latency_s and (
+                self.fault_clock is not None
+            ):
+                self.fault_clock.advance(fault.latency_s)
+            if fault is not None and fault.exc_factory is not None:
+                self.pulls_failed += 1
+                klog.v(2).info_s(
+                    "shard gossip pull failed: injected fault",
+                    component="shard",
+                )
+                continue
             try:
                 obj = self._fetch(peer)
             except Exception as exc:
@@ -416,7 +446,14 @@ class ShardGossip:
                 )
                 continue
             self.pulls_ok += 1
-            for raw in ((obj or {}).get("digests") or {}).values():
+            digests = (obj or {}).get("digests") or {}
+            items = list(digests.values())
+            if fault is not None and fault.truncate is not None:
+                # deterministic cut: partition order, first ``keep``
+                items = sorted(
+                    items, key=lambda raw: raw.get("partition", -1)
+                )[: fault.truncate]
+            for raw in items:
                 digest = PartitionDigest.from_obj(raw)
                 if digest is not None and self.store.put(digest):
                     ingested += 1

@@ -15,7 +15,8 @@ hoping.
     error), ``outage(verb)``/``clear(verb)`` (hard down until cleared),
     ``flap(verb, ok, fail, cycles)``, ``error_rate(verb, rate)``
     (seeded, deterministic), ``latency(verb, n, seconds)`` (advances the
-    fault clock); per-verb call counts are recorded for retry-storm
+    fault clock), ``truncate(verb, n, keep)`` (payload verbs answer cut
+    short); per-verb call counts are recorded for retry-storm
     assertions;
   * plans inject two ways: natively into ``FakeKubeClient`` (set its
     ``fault_plan``/``fault_clock`` attributes) or by wrapping ANY client
@@ -68,17 +69,26 @@ class FakeClock:
 
 
 class Fault:
-    """One scripted outcome for one call: raise and/or delay."""
+    """One scripted outcome for one call: raise, delay, and/or truncate.
 
-    __slots__ = ("exc_factory", "latency_s")
+    ``truncate`` is for payload-shaped verbs (today: the shard gossip
+    pull): the call succeeds but the consumer must keep only the first
+    N items of the payload — a peer answering with a short/cut-off
+    digest set, which exercises partial-merge fail-open paths that a
+    hard error never reaches.  Raising faults ignore it (there is no
+    payload to cut)."""
+
+    __slots__ = ("exc_factory", "latency_s", "truncate")
 
     def __init__(
         self,
         exc_factory: Optional[Callable[[], BaseException]] = None,
         latency_s: float = 0.0,
+        truncate: Optional[int] = None,
     ):
         self.exc_factory = exc_factory
         self.latency_s = float(latency_s)
+        self.truncate = None if truncate is None else max(0, int(truncate))
 
     def apply(self, clock: Optional[FakeClock]) -> None:
         if self.latency_s and clock is not None:
@@ -136,6 +146,15 @@ class FaultPlan:
         ``seconds`` before answering (slow API, not dead)."""
         return self.script(
             verb, [Fault(latency_s=seconds) for _ in range(count)]
+        )
+
+    def truncate(self, verb: str, count: int, keep: int) -> "FaultPlan":
+        """The next ``count`` calls of ``verb`` answer with only the
+        first ``keep`` payload items (shard gossip: digests) — the
+        consumer-side contract is that whatever survives the cut merges
+        normally and the rest simply isn't there this round."""
+        return self.script(
+            verb, [Fault(truncate=keep) for _ in range(count)]
         )
 
     def flap(

@@ -161,7 +161,7 @@ class ReplicaStack:
             max_moves=harness.max_moves,
             rate_per_s=1000.0,
             burst=100,
-            cooldown_s=0.0,
+            cooldown_s=harness.eviction_cooldown_s,
             min_available=0,
             clock=clock.now,
         )
@@ -276,6 +276,12 @@ class ReplicaStack:
                     shard.gossip.peers.append(
                         lambda j=j: harness.shard_payload(j)
                     )
+            # the gossip path consumes the SHARED fault plan (verb
+            # "shard_gossip"), so chaos scenarios and the fuzzer can
+            # delay, error, and truncate digest exchanges exactly like
+            # any kube/metrics verb
+            shard.gossip.fault_plan = harness.plan
+            shard.gossip.fault_clock = harness.clock
             shard.attach(self.cache, self.mirror)
             self.extender.shard = shard
             self.shard = shard
@@ -321,12 +327,20 @@ class HAHarness:
         shard_partitions: int = 0,
         shard_member_ttl_s: Optional[float] = None,
         shard_stale_s: float = 30.0,
+        eviction_cooldown_s: float = 0.0,
     ):
         self.clock = FakeClock()
         self.plan = FaultPlan(seed=seed)
         self.period_s = period_s
         self.hysteresis_cycles = hysteresis_cycles
         self.max_moves = max_moves
+        #: per-pod eviction cooldown for every replica's SafeActuator.
+        #: The bare HA harness keeps it OFF (its subject is election and
+        #: actuator parity, and tests pin exact eviction counts); the
+        #: digital twin arms it scaled to its tick period — the fuzzer
+        #: found that without it a globally saturated timeline re-evicts
+        #: one pod every cycle (tests/scenarios/eviction_pingpong.json)
+        self.eviction_cooldown_s = float(eviction_cooldown_s)
         self.lease_duration_s = lease_duration_s
         self.rebalance_mode = rebalance_mode
         self.gang = gang

@@ -1,0 +1,520 @@
+"""The oracle pack: the twin's hard invariants as composable,
+always-on assertions (docs/robustness.md "Adversarial scenario
+search").
+
+The thirteen hand-scripted scenarios each buried a few invariants
+inside their ``checks()`` methods — population conservation in
+DeploymentWave, epoch fencing in PartitionHandoff, gang wholeness in
+PreemptionCascade.  The fuzzer (testing/fuzz.py) runs timelines nobody
+scripted, so those invariants must hold WITHOUT a scenario author
+remembering to assert them.  This module factors them into oracles:
+objects observing a running :class:`~.twin.TwinCluster` tick by tick
+and emitting check dicts (the same ``{"check","ok","detail"}`` shape
+``Scenario._check`` builds) at the end.
+
+An oracle NEVER fails on a healthy timeline — the no-false-positive
+pin (tests/test_oracles.py) runs every committed scenario with the full
+pack attached and requires silence.  Oracles that only make sense on
+declared-quiet timelines (zero actuations, zero evictions) live behind
+``OraclePack(quiet=True)``.
+
+Catalog:
+
+  * ``population`` — no pod is ever lost: every pod name present at
+    start is still present (rebinds, failure-wave reschedules, and
+    leader failovers conserve the population).  Non-gang twins only:
+    gang members legitimately leave when their job completes.
+  * ``shard_epoch`` — per (replica, partition) fencing epochs never
+    decrease across the run (the handoff invariant).
+  * ``shard_splice`` — every digest a replica's store actually SERVES
+    (``DigestStore.fresh``) satisfies both safety rules from the
+    outside: current epoch per that replica's coordinator, and age
+    inside the staleness bound.  This re-checks the contract
+    independently of the implementation, so a splice bug in the store
+    itself (the PR-19 class) is caught here.
+  * ``gang_atomicity`` — a gang that reached full strength never
+    shrinks to a partial remnant (eviction/preemption is whole-gang or
+    nothing), and never exceeds its declared size.
+  * ``preemption_progress`` — no pod rides an admit/evict/re-admit
+    loop: per-pod eviction counts stay under K rounds.
+  * ``verb_parity`` — the read path is deterministic: the same
+    Prioritize/Filter request issued twice back-to-back at scenario end
+    answers byte-identically.
+  * ``quiet`` (``quiet=True`` packs only) — a declared-quiet timeline
+    actuates nothing: zero evictions, zero controller actuations, zero
+    traffic errors, zero admission-plane rejections/preemptions, no
+    SLO paging.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from platform_aware_scheduling_tpu.utils import labels as shared_labels
+from platform_aware_scheduling_tpu.utils.slo import ALERT_PAGE
+
+#: admit/evict/re-admit rounds one pod may ride before the progress
+#: oracle calls it a loop.  Healthy programs re-evict a pod at most a
+#: couple of times across waves; a planner ping-ponging the same victim
+#: blows past this within a short timeline.
+DEFAULT_PROGRESS_K = 6
+
+
+def _check(name: str, ok: bool, detail: str = "") -> Dict:
+    return {"check": name, "ok": bool(ok), "detail": detail}
+
+
+class Oracle:
+    """One invariant: observe the twin per tick, judge at the end."""
+
+    name = "oracle"
+
+    def start(self, twin) -> None:
+        pass
+
+    def on_tick(self, twin, t: int) -> None:
+        pass
+
+    def checks(self, twin) -> List[Dict]:
+        return []
+
+
+class PopulationConservation(Oracle):
+    """No pod is ever lost.  Evictions rebind, failure waves
+    reschedule, crashes fail over — but the set of pod names present at
+    start must be a subset of the set present at the end.  Gang twins
+    are exempt: completed gangs leave the cluster by design."""
+
+    name = "population"
+
+    def __init__(self):
+        self._initial: Optional[frozenset] = None
+
+    def start(self, twin) -> None:
+        if twin.gang:
+            return
+        with twin.fake._lock:
+            self._initial = frozenset(
+                (raw.get("metadata") or {}).get("namespace", "default")
+                + "/"
+                + (raw.get("metadata") or {}).get("name", "")
+                for raw in twin.fake._pods.values()
+            )
+
+    def checks(self, twin) -> List[Dict]:
+        if self._initial is None:
+            return []
+        with twin.fake._lock:
+            now = {
+                (raw.get("metadata") or {}).get("namespace", "default")
+                + "/"
+                + (raw.get("metadata") or {}).get("name", "")
+                for raw in twin.fake._pods.values()
+            }
+        missing = sorted(self._initial - now)
+        return [
+            _check(
+                f"oracle:{self.name}",
+                not missing,
+                f"{len(missing)} pod(s) lost: {missing[:5]}"
+                if missing
+                else f"{len(self._initial)} initial pods all present",
+            )
+        ]
+
+
+class _ShardOracle(Oracle):
+    """Shared iteration: live replicas that carry a shard plane."""
+
+    @staticmethod
+    def _planes(twin):
+        for i, stack in enumerate(twin.replicas):
+            if (
+                stack is not None
+                and i not in twin.crashed
+                and getattr(stack, "shard", None) is not None
+            ):
+                yield i, stack.shard
+
+
+class EpochMonotonicity(_ShardOracle):
+    """Fencing epochs never move backwards: for every (replica index,
+    partition), the coordinator's journal view is non-decreasing tick
+    over tick.  A backwards epoch means a fenced-out owner's write
+    reached the journal — the exact splice the fencing exists to
+    stop."""
+
+    name = "shard_epoch"
+
+    def __init__(self):
+        self._seen: Dict[tuple, int] = {}
+        self._violations: List[str] = []
+
+    def on_tick(self, twin, t: int) -> None:
+        for i, plane in self._planes(twin):
+            snap = plane.coordinator.snapshot()
+            for p, rec in snap["owners"].items():
+                key = (i, int(p))
+                epoch = int(rec.get("epoch", 0))
+                last = self._seen.get(key)
+                if last is not None and epoch < last:
+                    self._violations.append(
+                        f"tick {t}: replica-{i} partition {p} epoch "
+                        f"{last} -> {epoch}"
+                    )
+                self._seen[key] = epoch
+
+    def checks(self, twin) -> List[Dict]:
+        if not self._seen and not self._violations:
+            return []
+        return [
+            _check(
+                f"oracle:{self.name}",
+                not self._violations,
+                "; ".join(self._violations[:3])
+                if self._violations
+                else f"{len(self._seen)} (replica, partition) epochs "
+                f"monotonic",
+            )
+        ]
+
+
+class NoStaleSplice(_ShardOracle):
+    """Everything a store SERVES obeys both digest safety rules.  The
+    oracle re-derives the rules from the coordinator's journal and the
+    store's own staleness bound instead of trusting ``fresh()`` — so a
+    store whose fencing or staleness check was broken (planted bug
+    ``stale_digest_splice``) is caught by the digests it hands out."""
+
+    name = "shard_splice"
+
+    def __init__(self):
+        self._served = 0
+        self._violations: List[str] = []
+
+    def on_tick(self, twin, t: int) -> None:
+        for i, plane in self._planes(twin):
+            partitions = plane.coordinator.partitions
+            store = plane.store
+            now = store.clock()
+            for p in range(partitions):
+                digest = store.fresh(p)
+                if digest is None:
+                    continue
+                self._served += 1
+                known = plane.coordinator.epoch(p)
+                age = now - digest.stamp
+                if digest.epoch < known:
+                    self._violations.append(
+                        f"tick {t}: replica-{i} served partition {p} "
+                        f"digest at epoch {digest.epoch} < journal "
+                        f"epoch {known}"
+                    )
+                elif age > store.stale_after_s:
+                    self._violations.append(
+                        f"tick {t}: replica-{i} served partition {p} "
+                        f"digest aged {age:.1f}s > "
+                        f"{store.stale_after_s:g}s bound"
+                    )
+
+    def checks(self, twin) -> List[Dict]:
+        if not self._served and not self._violations:
+            return []
+        return [
+            _check(
+                f"oracle:{self.name}",
+                not self._violations,
+                "; ".join(self._violations[:3])
+                if self._violations
+                else f"{self._served} served digests all fenced+fresh",
+            )
+        ]
+
+
+class GangAtomicity(Oracle):
+    """A gang is all-or-nothing, both directions: member count never
+    exceeds the declared size, and once a gang reached full strength it
+    never shows a PARTIAL remnant at a tick boundary (whole-gang
+    eviction executes within the tick; a job completing removes every
+    member in the same apply step).  Mid-admission partials — members
+    still arriving under a reservation — are legal and ignored."""
+
+    name = "gang_atomicity"
+
+    def __init__(self):
+        self._full: Dict[str, int] = {}  # gang -> declared size
+        self._violations: List[str] = []
+
+    @staticmethod
+    def _census(twin) -> Dict[str, tuple]:
+        gangs: Dict[str, List[int]] = {}
+        with twin.fake._lock:
+            for raw in twin.fake._pods.values():
+                meta = raw.get("metadata") or {}
+                pod_labels = meta.get("labels") or {}
+                size = pod_labels.get(shared_labels.GANG_SIZE_LABEL)
+                group = pod_labels.get(shared_labels.GROUP_LABEL)
+                if not size or not group:
+                    continue
+                if (raw.get("status") or {}).get("phase") in (
+                    "Succeeded",
+                    "Failed",
+                ):
+                    continue
+                gangs.setdefault(group, [0, int(size)])[0] += 1
+        return {g: (c, s) for g, (c, s) in gangs.items()}
+
+    def on_tick(self, twin, t: int) -> None:
+        if not twin.gang:
+            return
+        census = self._census(twin)
+        for gang, (count, size) in census.items():
+            if count > size:
+                self._violations.append(
+                    f"tick {t}: gang {gang} has {count} members, "
+                    f"declared size {size}"
+                )
+            if count == size:
+                self._full[gang] = size
+        for gang, size in self._full.items():
+            count = census.get(gang, (0, size))[0]
+            if 0 < count < size:
+                self._violations.append(
+                    f"tick {t}: gang {gang} partially evicted — "
+                    f"{count}/{size} members remain"
+                )
+
+    def checks(self, twin) -> List[Dict]:
+        if not twin.gang:
+            return []
+        return [
+            _check(
+                f"oracle:{self.name}",
+                not self._violations,
+                "; ".join(self._violations[:3])
+                if self._violations
+                else f"{len(self._full)} gang(s) stayed whole",
+            )
+        ]
+
+
+class PreemptionProgress(Oracle):
+    """No admit/evict/re-admit loop: across the run, no single pod is
+    evicted more than K times.  A planner ping-ponging one victim (or
+    two gangs preempting each other) blows through K within a short
+    timeline; legitimate programs re-evict a pod once or twice."""
+
+    name = "preemption_progress"
+
+    def __init__(self, k: int = DEFAULT_PROGRESS_K):
+        self.k = int(k)
+
+    def checks(self, twin) -> List[Dict]:
+        counts: Dict[tuple, int] = {}
+        for ev in twin.fake.evictions:
+            key = (ev["namespace"], ev["pod"])
+            counts[key] = counts.get(key, 0) + 1
+        loops = sorted(
+            (key, n) for key, n in counts.items() if n > self.k
+        )
+        return [
+            _check(
+                f"oracle:{self.name}",
+                not loops,
+                f"evict loops past K={self.k}: "
+                + ", ".join(f"{ns}/{pod} x{n}" for (ns, pod), n in loops[:3])
+                if loops
+                else f"max per-pod evictions "
+                f"{max(counts.values()) if counts else 0} <= K={self.k}",
+            )
+        ]
+
+
+class VerbParity(Oracle):
+    """The read path is a pure function of cluster state: the same
+    Prioritize and Filter bodies issued twice back-to-back (no tick in
+    between) must answer byte-identically — nondeterministic ranking,
+    unstable encodes, and state leaks between requests all land here."""
+
+    name = "verb_parity"
+
+    def checks(self, twin) -> List[Dict]:
+        if twin.gang:
+            return []  # mesh verbs mutate reservations by design
+        live = twin.live()
+        if not live:
+            return [
+                _check(
+                    f"oracle:{self.name}",
+                    True,
+                    "no live replica at scenario end (nothing to serve)",
+                )
+            ]
+        from platform_aware_scheduling_tpu.testing.twin import (
+            _prioritize_body,
+            _request,
+        )
+
+        extender = live[0].extender
+        body = _prioritize_body("oracle-parity-pod", twin.live_node_names())
+        mismatches: List[str] = []
+        for verb, path in (
+            ("prioritize", "/scheduler/prioritize"),
+            ("filter", "/scheduler/filter"),
+        ):
+            try:
+                first = getattr(extender, verb)(_request(path, body))
+                second = getattr(extender, verb)(_request(path, body))
+            except Exception as exc:
+                mismatches.append(f"{verb} raised {exc!r}")
+                continue
+            if (first.status, first.body) != (second.status, second.body):
+                mismatches.append(
+                    f"{verb}: {first.status}/{len(first.body)}B vs "
+                    f"{second.status}/{len(second.body)}B"
+                )
+        return [
+            _check(
+                f"oracle:{self.name}",
+                not mismatches,
+                "; ".join(mismatches)
+                if mismatches
+                else "prioritize+filter byte-identical on repeat",
+            )
+        ]
+
+
+class QuietTimeline(Oracle):
+    """The zero-actuation pin for DECLARED-quiet timelines: healthy
+    sub-threshold load with no faults must move nothing — no evictions,
+    no controller actuations, no traffic errors, no admission-plane
+    rejections or preemptions, no SLO in the page tier."""
+
+    name = "quiet"
+
+    def checks(self, twin) -> List[Dict]:
+        problems: List[str] = []
+        evictions = len(twin.evictions())
+        if evictions:
+            problems.append(f"{evictions} evictions")
+        if twin.traffic.get("errors"):
+            problems.append(f"{twin.traffic['errors']} traffic errors")
+        controller = getattr(twin, "controller", None)
+        if controller is not None and controller.actuation_count():
+            problems.append(
+                f"{controller.actuation_count()} controller actuations"
+            )
+        plane = twin.priority_plane()
+        if plane is not None:
+            counters = plane.snapshot()["counters"]
+            for key in ("blocked", "starved", "rejected", "preemptions"):
+                if counters.get(key):
+                    problems.append(f"admission {key}={counters[key]:g}")
+        paging = [
+            name
+            for name, entry in twin.judgment().items()
+            if entry.get("alert") == ALERT_PAGE
+        ]
+        if paging:
+            problems.append(f"paging: {paging}")
+        return [
+            _check(
+                f"oracle:{self.name}",
+                not problems,
+                "; ".join(problems) if problems else "nothing actuated",
+            )
+        ]
+
+
+class OraclePack:
+    """The composed pack: every default oracle, plus the quiet pin when
+    the timeline declares itself quiet.  One pack instance observes ONE
+    run (oracles carry per-run state)."""
+
+    def __init__(
+        self,
+        oracles: Optional[List[Oracle]] = None,
+        quiet: bool = False,
+        progress_k: int = DEFAULT_PROGRESS_K,
+    ):
+        if oracles is None:
+            oracles = [
+                PopulationConservation(),
+                EpochMonotonicity(),
+                NoStaleSplice(),
+                GangAtomicity(),
+                PreemptionProgress(k=progress_k),
+                VerbParity(),
+            ]
+            if quiet:
+                oracles.append(QuietTimeline())
+        self.oracles = list(oracles)
+
+    def start(self, twin) -> None:
+        for oracle in self.oracles:
+            oracle.start(twin)
+
+    def on_tick(self, twin, t: int) -> None:
+        for oracle in self.oracles:
+            oracle.on_tick(twin, t)
+
+    def checks(self, twin) -> List[Dict]:
+        out: List[Dict] = []
+        for oracle in self.oracles:
+            out.extend(oracle.checks(twin))
+        return out
+
+
+def run_scenario(scenario, scale: Optional[Dict] = None, pack=None) -> Dict:
+    """``Scenario.run`` with an oracle pack riding along: the pack
+    observes after every tick and its checks join the scenario's own —
+    the no-false-positive pin runs every committed scenario through
+    here and requires ``oracles_ok``."""
+    scale = dict(scale or {})
+    if pack is None:
+        pack = OraclePack()
+    twin = scenario.build(scale)
+    try:
+        pack.start(twin)
+        total = scenario.ticks(scale)
+        for t in range(total):
+            scenario.apply(twin, t)
+            twin.tick()
+            pack.on_tick(twin, t)
+        checks = scenario.checks(twin)
+        oracle_checks = pack.checks(twin)
+        return {
+            "name": scenario.name,
+            "passed": all(c["ok"] for c in checks),
+            "oracles_ok": all(c["ok"] for c in oracle_checks),
+            "ticks": total,
+            "checks": checks,
+            "oracle_checks": oracle_checks,
+            "traffic": dict(twin.traffic),
+            "judgment": twin.judgment(),
+        }
+    finally:
+        twin.close()
+
+
+def summarize(oracle_checks: List[Dict]) -> str:
+    failed = [c for c in oracle_checks if not c["ok"]]
+    if not failed:
+        return "all oracles green"
+    return "; ".join(f"{c['check']}: {c['detail']}" for c in failed)
+
+
+__all__ = [
+    "DEFAULT_PROGRESS_K",
+    "EpochMonotonicity",
+    "GangAtomicity",
+    "NoStaleSplice",
+    "Oracle",
+    "OraclePack",
+    "PopulationConservation",
+    "PreemptionProgress",
+    "QuietTimeline",
+    "VerbParity",
+    "run_scenario",
+    "summarize",
+]

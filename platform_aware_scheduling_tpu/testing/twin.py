@@ -196,7 +196,17 @@ class TwinCluster(HAHarness):
         preemption_max_victims: int = 8,
         admission_starve_consults: int = 16,
         shard_partitions: int = 0,
+        eviction_cooldown_s: Optional[float] = None,
     ):
+        # production runs the actuator's per-pod eviction cooldown
+        # (rebalance/actuator.DEFAULT_COOLDOWN_S) so no workload can be
+        # bounced every cycle; the twin arms the same gate scaled to its
+        # tick period.  Found by the fuzzer: with the gate off, a
+        # globally saturated timeline re-evicts ONE pod every tick — a
+        # zero-progress loop the preemption_progress oracle calls
+        # (tests/scenarios/eviction_pingpong.json)
+        if eviction_cooldown_s is None:
+            eviction_cooldown_s = 3.0 * period_s
         super().__init__(
             replicas=replicas,
             num_nodes=num_nodes,
@@ -220,6 +230,7 @@ class TwinCluster(HAHarness):
             # the partition plane (shard/): > 0 gives every replica a
             # ShardPlane over the shared journal, with in-process gossip
             shard_partitions=shard_partitions,
+            eviction_cooldown_s=eviction_cooldown_s,
             # capacity below the violation threshold (4 x POD_LOAD=400
             # <= THRESHOLD=450): a capacity-legal rebalance plan can
             # never manufacture the next violating node, so scenarios
@@ -2694,6 +2705,17 @@ DEFAULT_SCENARIOS: Tuple[Scenario, ...] = (
     PartitionHandoff(),
     GangWave(),
 )
+
+
+def load_scenario(source) -> Scenario:
+    """Load a committed fuzz find (``pas-fuzz-scenario/1`` JSON — a
+    path, JSON text, or parsed dict) as a first-class Scenario, so a
+    minimized reproducer under tests/scenarios/ replays anywhere a
+    hand-written program does.  Lazy import: the fuzzer depends on this
+    module, not the other way around."""
+    from platform_aware_scheduling_tpu.testing import fuzz
+
+    return fuzz.load_scenario(source)
 
 
 def run_matrix(

@@ -4,6 +4,7 @@ invariant — all deterministic: fault plans + fake clocks, zero real
 sleeps, zero wall-clock randomness."""
 
 import json
+import threading
 
 import pytest
 
@@ -835,3 +836,98 @@ class TestGASAnnotateBackoff:
             for n in (1, 2, 3)
         ]
         assert gaps == pytest.approx(expected)
+
+
+# ---------------------------------------------------------------------------
+# the FaultPlan contract itself: resolution order + seeded determinism
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlanResolutionOrder:
+    """Pins the docstring contract (testing/faults.py FaultPlan): per
+    call, an outage wins; else the next scripted entry (verb before the
+    ``"*"`` wildcard) is consumed; else the seeded error rate decides;
+    exhausted scripts mean healthy.  The fuzzer's fault events lean on
+    this order — an outage must mask, not consume, whatever else is
+    scheduled for the verb."""
+
+    def test_outage_wins_and_preserves_the_script(self):
+        clock = FakeClock()
+        plan = FaultPlan().latency("v", 2, 5.0).outage("v", status=503)
+        t0 = clock.now()
+        for _ in range(3):
+            with pytest.raises(KubeError):
+                plan.apply("v", clock)
+        # the outage answered every call: the latency script was NOT
+        # consumed and the fault clock never advanced
+        assert clock.now() == t0
+        with plan._lock:
+            assert len(plan._scripts["v"]) == 2
+        assert plan.call_count("v") == 3
+
+    def test_script_beats_rate_then_rate_takes_over(self):
+        clock = FakeClock()
+        plan = FaultPlan(seed=5).latency("v", 2, 5.0).error_rate("v", 1.0)
+        t0 = clock.now()
+        plan.apply("v", clock)  # scripted latency: slow, not failing
+        plan.apply("v", clock)
+        assert clock.now() == t0 + 10.0
+        with pytest.raises(KubeError):
+            plan.apply("v", clock)  # script exhausted: the rate fires
+
+    def test_verb_script_before_wildcard_then_healthy(self):
+        clock = FakeClock()
+        plan = FaultPlan().fail("*", 1).latency("v", 1, 1.0)
+        t0 = clock.now()
+        plan.apply("v", clock)  # the verb's own script first
+        assert clock.now() == t0 + 1.0
+        with pytest.raises(KubeError):
+            plan.apply("v", clock)  # then the wildcard entry
+        plan.apply("v", clock)  # everything exhausted: healthy
+        assert plan.call_count("v") == 3
+
+
+class TestErrorRateDeterminism:
+    """error_rate is a pure function of (seed, verb, call index) —
+    the property the fuzz engine's byte-identical-replay pin rides."""
+
+    def _fire_indexes(self, seed, n=400, rate=0.3):
+        plan = FaultPlan(seed=seed).error_rate("v", rate)
+        return [i for i in range(n) if plan.next("v") is not None]
+
+    def test_pure_function_of_seed_verb_and_index(self):
+        a = self._fire_indexes(11)
+        assert a == self._fire_indexes(11)
+        assert a != self._fire_indexes(12)
+        assert 0 < len(a) < 400  # a real rate, not all-or-nothing
+        # distinct verbs draw distinct (deterministic) streams
+        plan = FaultPlan(seed=11).error_rate("w", 0.3)
+        b = [i for i in range(400) if plan.next("w") is not None]
+        assert a != b
+
+    def test_concurrent_callers_see_the_same_outcome_multiset(self):
+        """Call-index allocation is atomic under the plan's lock, so
+        whichever THREAD draws index n sees outcome f(seed, verb, n):
+        the total count of fired faults is interleaving-independent
+        and equal to the sequential run's."""
+        expected = len(self._fire_indexes(11))
+        for _round in range(2):  # two genuinely different interleavings
+            plan = FaultPlan(seed=11).error_rate("v", 0.3)
+            fired = []
+
+            def worker():
+                count = 0
+                for _ in range(50):
+                    if plan.next("v") is not None:
+                        count += 1
+                fired.append(count)
+
+            threads = [
+                threading.Thread(target=worker) for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert plan.call_count("v") == 400
+            assert sum(fired) == expected

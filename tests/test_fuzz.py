@@ -1,0 +1,259 @@
+"""Coverage-guided scenario fuzzing (testing/fuzz.py; docs/robustness.md
+"Adversarial scenario search"): the seeded LCG, genome generation and
+mutation, candidate determinism (the byte-identical-replay pin), the
+coverage-novelty corpus, delta-debug minimization, versioned scenario
+serialization, the planted-bug quick gate, and the randomness
+discipline the whole layer rests on — every draw flows from an
+injected seed (pascheck check ``randomness``)."""
+
+import copy
+import json
+from pathlib import Path
+
+import pytest
+
+from platform_aware_scheduling_tpu.testing import fuzz
+from platform_aware_scheduling_tpu.utils.events import JOURNAL
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_journal():
+    JOURNAL.reset()
+    yield
+    JOURNAL.reset()
+
+
+class TestLCG:
+    def test_deterministic_per_seed(self):
+        a = [fuzz.LCG(42).u32() for _ in range(5)]
+        assert a == [fuzz.LCG(42).u32() for _ in range(5)]
+        assert a != [fuzz.LCG(43).u32() for _ in range(5)]
+
+    def test_draw_ranges(self):
+        rng = fuzz.LCG(7)
+        for _ in range(200):
+            assert 0.0 <= rng.random() < 1.0
+            assert 3 <= rng.randint(3, 9) <= 9
+            assert rng.choice(["a", "b"]) in ("a", "b")
+            assert rng.chance(1.0) is True
+            assert rng.chance(0.0) is False
+
+    def test_process_independent_values(self):
+        # pinned: the LCG is pure integer math, so these exact values
+        # hold on every machine — the cross-run reproducibility pin
+        rng = fuzz.LCG(7)
+        assert [rng.u32() for _ in range(3)] == [
+            2461488101, 3397525143, 4214469190,
+        ]
+
+
+class TestGenomes:
+    def test_generated_genomes_validate_and_replay(self):
+        for i in range(30):
+            genome = fuzz.generate_genome(fuzz.LCG(i))
+            fuzz.validate_genome(genome)
+            again = fuzz.generate_genome(fuzz.LCG(i))
+            assert genome == again, f"seed {i} not deterministic"
+
+    def test_mutations_validate_and_are_deterministic(self):
+        base = fuzz.generate_genome(fuzz.LCG(1))
+        for i in range(30):
+            mutant = fuzz.mutate_genome(fuzz.LCG(100 + i), base)
+            fuzz.validate_genome(mutant)
+            assert mutant == fuzz.mutate_genome(fuzz.LCG(100 + i), base)
+
+    def test_validate_rejects_malformed_genomes(self):
+        good = copy.deepcopy(fuzz.SEED_GENOMES[0])
+        for breakage in (
+            {"version": 999},
+            {"mode": "bogus"},
+            {"ticks": 0},
+            {"ticks": 10_000},
+            {"events": [{"type": "no_such_event", "t": 0}]},
+            {"events": [{"type": "load_flat", "t": -1, "value": 100}]},
+        ):
+            bad = dict(copy.deepcopy(good), **breakage)
+            with pytest.raises(ValueError):
+                fuzz.validate_genome(bad)
+
+    def test_digest_is_key_order_independent(self):
+        genome = fuzz.SEED_GENOMES[0]
+        reordered = json.loads(
+            json.dumps(genome, sort_keys=True)[::-1][::-1]
+        )
+        assert fuzz.genome_digest(genome) == fuzz.genome_digest(reordered)
+        other = copy.deepcopy(genome)
+        other["ticks"] += 1
+        assert fuzz.genome_digest(other) != fuzz.genome_digest(genome)
+
+    def test_seed_genomes_cover_both_modes(self):
+        modes = {g["mode"] for g in fuzz.SEED_GENOMES}
+        assert modes == {"core", "admission"}
+        for genome in fuzz.SEED_GENOMES:
+            fuzz.validate_genome(genome)
+
+
+class TestCandidateDeterminism:
+    def test_run_candidate_is_byte_identical(self):
+        # the faultiest seed genome: kills an owner mid-gossip-outage
+        genome = fuzz.SEED_GENOMES[2]
+        a = fuzz.run_candidate(genome)
+        b = fuzz.run_candidate(genome)
+        assert a == b
+        assert a["verdict"] == "ok"
+        assert a["coverage"], "a sharded run must emit coverage signals"
+
+    def test_engine_sequences_are_reproducible(self):
+        runs = []
+        for _ in range(2):
+            engine = fuzz.FuzzEngine(seed=7)
+            engine.fuzz(max_candidates=8)
+            runs.append(
+                [
+                    (r["digest"], r["verdict"], tuple(r["failures"]))
+                    for r in engine.records
+                ]
+            )
+        assert runs[0] == runs[1]
+        assert len(runs[0]) == 8
+
+    def test_quiet_genome_is_green_and_declared_quiet(self):
+        genome = fuzz.SEED_GENOMES[0]
+        assert fuzz.is_quiet_genome(genome)
+        record = fuzz.run_candidate(genome)
+        assert record["verdict"] == "ok", record
+
+
+class TestCorpus:
+    def test_coverage_novelty_admits_and_bounds_the_corpus(self):
+        engine = fuzz.FuzzEngine(seed=7, max_corpus=2)
+        engine.fuzz(max_candidates=6)
+        # candidate #0 always lands (everything is novel at the start)
+        assert engine.records[0]["new_signals"] > 0
+        assert 0 < len(engine.corpus) <= 2
+        # seen-signal set only grows, and records agree with it
+        total_new = sum(r["new_signals"] for r in engine.records)
+        assert total_new == len(engine.seen)
+
+    def test_wall_budget_only_truncates_the_sequence(self):
+        """A fake clock that expires after 3 candidates yields exactly
+        the first 3 records of the untruncated run — budgets change how
+        far the search gets, never what it computes."""
+        full = fuzz.FuzzEngine(seed=7)
+        full.fuzz(max_candidates=5)
+
+        ticks = {"n": 0}
+
+        def clock():
+            ticks["n"] += 1
+            return float(ticks["n"])
+
+        short = fuzz.FuzzEngine(seed=7)
+        short.fuzz(time_budget_s=3.0, clock=clock)
+        truncated = [
+            (r["digest"], r["verdict"]) for r in short.records
+        ]
+        prefix = [
+            (r["digest"], r["verdict"])
+            for r in full.records[: len(truncated)]
+        ]
+        assert truncated and truncated == prefix
+
+
+class TestMinimize:
+    def test_minimizer_drops_junk_and_keeps_the_failure(self):
+        base = json.loads(
+            (REPO / "tests/scenarios/lost_rebind.json").read_text()
+        )["genome"]
+        noisy = copy.deepcopy(base)
+        noisy["ticks"] = 20
+        noisy["events"].extend(
+            [
+                {"type": "load_flat", "t": 8, "value": 150},
+                {"type": "knob", "t": 9, "name": "admission_depth",
+                 "value": 32},
+                {"type": "fault", "t": 10, "verb": "get_node_metric",
+                 "op": "latency", "count": 2, "seconds": 1.0},
+            ]
+        )
+        with fuzz.planted_bug("lost_rebind"):
+            out = fuzz.minimize(noisy, ["oracle:population"])
+        assert "oracle:population" in out["failures"]
+        assert out["attempts"] > 0
+        genome = out["genome"]
+        assert len(genome["events"]) <= len(base["events"])
+        assert genome["ticks"] <= base["ticks"]
+
+    def test_minimizer_rejects_reductions_that_stop_failing(self):
+        """On the healthy tree nothing fails, so every reduction is
+        rejected and the genome comes back unchanged."""
+        base = copy.deepcopy(fuzz.SEED_GENOMES[1])
+        out = fuzz.minimize(base, ["oracle:population"], max_attempts=12)
+        assert out["genome"] == base
+        assert out["failures"] == []
+
+
+class TestPlantedBugs:
+    def test_stale_digest_splice_caught_by_seed_corpus(self):
+        """The quick planted-bug gate: detection rides the hand-built
+        seed corpus, not mutation luck."""
+        genome = fuzz.SEED_GENOMES[2]
+        assert fuzz.run_candidate(genome)["verdict"] == "ok"
+        with fuzz.planted_bug("stale_digest_splice"):
+            record = fuzz.run_candidate(genome)
+        assert "oracle:shard_splice" in record["failures"]
+        # the patch is scoped: healthy again outside the context
+        assert fuzz.run_candidate(genome)["verdict"] == "ok"
+
+    def test_unknown_plant_is_an_error(self):
+        with pytest.raises(ValueError, match="unknown planted bug"):
+            with fuzz.planted_bug("no_such_bug"):
+                pass
+
+
+class TestSerialization:
+    def test_round_trip_through_disk(self, tmp_path):
+        obj = fuzz.scenario_to_obj(
+            fuzz.SEED_GENOMES[0],
+            expect=["oracle:quiet"],
+            planted=None,
+            seed=7,
+            notes="round trip",
+        )
+        path = tmp_path / "scn.json"
+        fuzz.save_scenario(path, obj)
+        scenario = fuzz.load_scenario(path)
+        assert scenario.genome == fuzz.SEED_GENOMES[0]
+        assert scenario.expect == ["oracle:quiet"]
+        assert scenario.planted is None
+        assert scenario.notes == "round trip"
+        # text and dict sources load identically
+        assert fuzz.load_scenario(
+            path.read_text()
+        ).genome == scenario.genome
+        assert fuzz.load_scenario(obj).genome == scenario.genome
+
+    def test_loader_rejects_foreign_formats(self):
+        for fmt in (None, "pas-fuzz-scenario/2", "something-else"):
+            with pytest.raises(ValueError, match="not a fuzz scenario"):
+                fuzz.load_scenario({"format": fmt, "genome": {}})
+
+
+class TestRandomnessDiscipline:
+    def test_fuzz_layers_pass_the_randomness_check(self):
+        """The checker that guards the reproducibility pin: nothing in
+        the package or benchmarks/ draws from ambient RNG state."""
+        from platform_aware_scheduling_tpu.analysis import randomness
+        from platform_aware_scheduling_tpu.analysis.core import (
+            load_modules,
+        )
+
+        for root in (
+            REPO / "platform_aware_scheduling_tpu" / "testing",
+            REPO / "benchmarks",
+        ):
+            modules, _pragma = load_modules(root)
+            findings = randomness.check(modules)
+            assert not findings, [f.render() for f in findings]

@@ -1,0 +1,149 @@
+"""Oracle pack (testing/oracles.py; docs/robustness.md "Adversarial
+scenario search"): the no-false-positive pin — every hand-scripted
+scenario stays green with the full pack riding along — plus the
+quiet-timeline pin and per-oracle fire tests through the fuzzer's
+planted bugs.  An oracle that pages on a healthy timeline is a defect
+in the oracle; these tests are the contract that keeps the fuzzer's
+finds meaningful."""
+
+import pytest
+
+from platform_aware_scheduling_tpu.testing import fuzz, oracles
+from platform_aware_scheduling_tpu.testing import twin as tw
+from platform_aware_scheduling_tpu.utils.events import JOURNAL
+
+CORE_SCALE = {
+    "num_nodes": 16,
+    "pods": 16,
+    "period_s": 5.0,
+    "requests_per_tick": 1,
+}
+CONTROL_SCALE = {"num_nodes": 16, "pods": 16, "period_s": 5.0}
+ADMISSION_SCALE = {"period_s": 5.0}
+
+#: every hand-scripted scenario program, with the scale its own harness
+#: runs it at (scenario objects carry per-run state: factories, not
+#: instances)
+SCENARIO_MATRIX = [
+    (lambda: tw.DiurnalLoad(), CORE_SCALE),
+    (lambda: tw.DeploymentWave(), CORE_SCALE),
+    (lambda: tw.NodeFailureWave(), CORE_SCALE),
+    (lambda: tw.MetricStorm(), CORE_SCALE),
+    (lambda: tw.LeaderKillComposite(), CORE_SCALE),
+    (lambda: tw.PartitionHandoff(), CORE_SCALE),
+    (lambda: tw.GangWave(), CORE_SCALE),
+    (lambda: tw.ControlMetricStorm(control=False), CONTROL_SCALE),
+    (lambda: tw.ControlMetricStorm(control=True), CONTROL_SCALE),
+    (lambda: tw.ControlDeploymentWave(control=False), CONTROL_SCALE),
+    (lambda: tw.ControlDeploymentWave(control=True), CONTROL_SCALE),
+    (lambda: tw.PriorityInversionStorm(), ADMISSION_SCALE),
+    (lambda: tw.BackfillStarvation(), ADMISSION_SCALE),
+    (lambda: tw.PreemptionCascade(preemption=True), ADMISSION_SCALE),
+    (lambda: tw.PreemptionCascade(preemption=False), ADMISSION_SCALE),
+]
+
+
+def _ids():
+    return [factory().name for factory, _scale in SCENARIO_MATRIX]
+
+
+@pytest.fixture(autouse=True)
+def _clean_journal():
+    JOURNAL.reset()
+    yield
+    JOURNAL.reset()
+
+
+def _oracle_failures(result):
+    return [c for c in result["oracle_checks"] if not c["ok"]]
+
+
+class TestNoFalsePositives:
+    """The pin the whole fuzzing layer rests on: the full pack is
+    silent on every healthy hand-scripted timeline.  A single false
+    positive here and every fuzzer find needs manual triage."""
+
+    @pytest.mark.parametrize(
+        "factory,scale", SCENARIO_MATRIX, ids=_ids()
+    )
+    def test_scenario_green_with_the_pack_attached(self, factory, scale):
+        result = oracles.run_scenario(factory(), dict(scale))
+        assert result["passed"], [
+            c for c in result["checks"] if not c["ok"]
+        ]
+        assert result["oracles_ok"], _oracle_failures(result)
+
+
+class TestQuietTimeline:
+    def test_quiet_pack_is_green_on_a_quiet_day(self):
+        pack = oracles.OraclePack(quiet=True)
+        result = oracles.run_scenario(
+            tw.DiurnalLoad(), dict(CORE_SCALE), pack=pack
+        )
+        assert result["oracles_ok"], _oracle_failures(result)
+        assert any(
+            c["check"] == "oracle:quiet" for c in result["oracle_checks"]
+        )
+
+    def test_quiet_oracle_fires_on_an_actuating_timeline(self):
+        """Declaring a deployment wave quiet must fail loudly: the wave
+        evicts, and the zero-actuation pin calls it."""
+        pack = oracles.OraclePack(quiet=True)
+        result = oracles.run_scenario(
+            tw.DeploymentWave(), dict(CORE_SCALE), pack=pack
+        )
+        failed = {c["check"] for c in _oracle_failures(result)}
+        assert "oracle:quiet" in failed
+
+
+class TestOraclesFire:
+    """Each oracle's detection direction, demonstrated through the
+    fuzzer's planted bugs (the same ground truth ``make fuzz-smoke``
+    gates on) or a tightened bound — an oracle that can't fire proves
+    nothing by staying green."""
+
+    def test_population_fires_on_a_lost_rebind(self):
+        with fuzz.planted_bug("lost_rebind"):
+            result = oracles.run_scenario(
+                tw.DeploymentWave(), dict(CORE_SCALE)
+            )
+        failed = {c["check"] for c in _oracle_failures(result)}
+        assert "oracle:population" in failed
+
+    def test_shard_splice_fires_on_a_broken_store(self):
+        scenario = tw.load_scenario(
+            "tests/scenarios/stale_digest_splice.json"
+        )
+        with fuzz.planted_bug("stale_digest_splice"):
+            record = fuzz.run_candidate(scenario.genome)
+        assert "oracle:shard_splice" in record["failures"]
+
+    def test_preemption_progress_fires_past_a_tight_k(self):
+        """K=0 turns any legitimate eviction into a violation — the
+        bound really is counting per-pod evictions."""
+        pack = oracles.OraclePack(
+            [oracles.PreemptionProgress(k=0)]
+        )
+        result = oracles.run_scenario(
+            tw.DeploymentWave(), dict(CORE_SCALE), pack=pack
+        )
+        failed = {c["check"] for c in _oracle_failures(result)}
+        assert "oracle:preemption_progress" in failed
+
+    def test_shard_oracles_stay_out_of_unsharded_runs(self):
+        """On a twin with no shard plane the shard oracles emit NO
+        checks at all (absence, not vacuous green) — coverage signals
+        must reflect what a candidate actually exercised."""
+        result = oracles.run_scenario(
+            tw.DiurnalLoad(), dict(CORE_SCALE)
+        )
+        names = {c["check"] for c in result["oracle_checks"]}
+        assert "oracle:shard_epoch" not in names
+        assert "oracle:shard_splice" not in names
+        # sharded runs DO emit them
+        sharded = oracles.run_scenario(
+            tw.PartitionHandoff(), dict(CORE_SCALE)
+        )
+        sharded_names = {c["check"] for c in sharded["oracle_checks"]}
+        assert "oracle:shard_epoch" in sharded_names
+        assert "oracle:shard_splice" in sharded_names

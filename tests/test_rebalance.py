@@ -335,6 +335,26 @@ class TestRebalanceLoop:
             assert move["from_node"] in violating
             assert move["to_node"] not in violating
 
+    def test_inflow_cap_spreads_moves_and_records_deferrals(self):
+        """The herding pin (tests/scenarios/rebalance_herd.json, found
+        by the fuzzer): a cycle never lands more than max_inflow=1 move
+        on any destination, and the overflow shows up as
+        ``deferred_moves`` instead of a stampede onto one cool node."""
+        harness = ChurnHarness(
+            mode="active",
+            hysteresis_cycles=1,
+            max_moves=8,
+            num_nodes=4,
+            hot_nodes=2,
+            pods_per_hot_node=6,
+        )
+        record = harness.step()
+        assert record["moves"]
+        destinations = [m["to_node"] for m in record["moves"]]
+        assert len(destinations) == len(set(destinations))
+        # 12 hot pods chasing 2 cool nodes: the cap must bite
+        assert record["deferred_moves"] > 0
+
     def test_violations_published_even_when_labeling_fails(self):
         """A node-patch failure window must not freeze hysteresis
         streaks: the violation map is published every cycle regardless,

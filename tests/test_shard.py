@@ -51,6 +51,10 @@ from platform_aware_scheduling_tpu.shard.partition import (
 )
 from platform_aware_scheduling_tpu.tas.metrics import NodeMetric
 from platform_aware_scheduling_tpu.testing.fake_kube import FakeKubeClient
+from platform_aware_scheduling_tpu.testing.faults import (
+    FakeClock as FaultsFakeClock,
+    FaultPlan,
+)
 from platform_aware_scheduling_tpu.utils import trace
 from platform_aware_scheduling_tpu.utils.events import JOURNAL
 from platform_aware_scheduling_tpu.utils.quantity import Quantity
@@ -485,6 +489,97 @@ class TestGossip:
         # ingests nothing (the store's newer-wins rule)
         store.put(make_digest(1, stamp=5.0))
         assert gossip.pull() == 0
+
+
+class TestGossipFaults:
+    """Gossip rides the FaultPlan like every other verb
+    (ShardGossip.FAULT_VERB, one consult per peer per round): outages
+    and error rates make failed pulls, latency ages what the slow peer
+    delivers, a truncated payload merges its surviving prefix — every
+    mode fails open, never raises."""
+
+    def _store(self, stale=10.0):
+        clock = FaultsFakeClock(start=0.0)
+        store = DigestStore(
+            epoch_of=lambda p: 1, stale_after_s=stale, clock=clock
+        )
+        return store, clock
+
+    def _peer(self, partitions, stamp=0.0):
+        payload = {
+            "digests": {
+                str(p): make_digest(p, stamp=stamp).to_obj()
+                for p in partitions
+            }
+        }
+        return lambda: payload
+
+    def test_outage_fails_every_pull_until_cleared(self):
+        store, _clock = self._store()
+        plan = FaultPlan().outage(ShardGossip.FAULT_VERB)
+        gossip = ShardGossip(
+            store,
+            peers=[self._peer([0]), self._peer([1])],
+            fault_plan=plan,
+        )
+        assert gossip.pull() == 0
+        assert gossip.pulls_failed == 2 and gossip.pulls_ok == 0
+        assert store.fresh(0) is None and store.fresh(1) is None
+        plan.clear(ShardGossip.FAULT_VERB)
+        assert gossip.pull() == 2
+        assert gossip.pulls_ok == 2
+
+    def test_error_rate_is_deterministic_per_peer_slot(self):
+        outcomes = []
+        for _ in range(2):
+            store, _clock = self._store()
+            plan = FaultPlan(seed=3).error_rate(
+                ShardGossip.FAULT_VERB, 0.5
+            )
+            gossip = ShardGossip(
+                store, peers=[self._peer([p]) for p in range(4)],
+                fault_plan=plan,
+            )
+            rounds = [gossip.pull() for _ in range(4)]
+            outcomes.append((rounds, gossip.pulls_ok, gossip.pulls_failed))
+        assert outcomes[0] == outcomes[1]
+        _rounds, ok, failed = outcomes[0]
+        assert ok + failed == 16
+        assert 0 < failed < 16  # the rate really fired, and not always
+
+    def test_truncate_merges_the_surviving_prefix(self):
+        store, _clock = self._store()
+        plan = FaultPlan().truncate(ShardGossip.FAULT_VERB, 1, keep=2)
+        gossip = ShardGossip(
+            store, peers=[self._peer([3, 1, 0, 2])], fault_plan=plan
+        )
+        # the cut is deterministic: partition order, first ``keep``
+        assert gossip.pull() == 2
+        assert gossip.pulls_ok == 1 and gossip.pulls_failed == 0
+        assert store.fresh(0) is not None and store.fresh(1) is not None
+        assert store.fresh(2) is None and store.fresh(3) is None
+        # script exhausted: the next round delivers the full payload
+        # (equal-stamp digests re-shelve — newer-wins rejects only
+        # strictly older — so all four count as ingested)
+        assert gossip.pull() == 4
+        assert store.fresh(2) is not None and store.fresh(3) is not None
+
+    def test_latency_fault_ages_what_the_slow_peer_delivers(self):
+        store, clock = self._store(stale=10.0)
+        plan = FaultPlan().latency(ShardGossip.FAULT_VERB, 1, 30.0)
+        gossip = ShardGossip(
+            store,
+            peers=[self._peer([0], stamp=clock.now())],
+            fault_plan=plan,
+            fault_clock=clock,
+        )
+        # the pull succeeds — but the clock advanced past the staleness
+        # bound before the payload landed, so serving fails open
+        assert gossip.pull() == 1
+        assert gossip.pulls_ok == 1
+        assert store.fresh(0) is None
+        (event,) = journal_events("digest_stale")
+        assert event["data"]["partition"] == 0
 
 
 class TestShardPlane:

@@ -5,12 +5,17 @@ front-ends (healthy -> burn-rate page -> recovery with a fake-clock-
 consistent budget ledger), and the 100k-node tier behind ``-m slow``."""
 
 import json
+from pathlib import Path
 
 import pytest
 
+from platform_aware_scheduling_tpu.testing import fuzz
 from platform_aware_scheduling_tpu.testing import twin as tw
 from platform_aware_scheduling_tpu.utils import trace
 from wirehelpers import get_request
+
+SCENARIO_DIR = Path(__file__).resolve().parent / "scenarios"
+SCENARIO_FILES = sorted(SCENARIO_DIR.glob("*.json"))
 
 SMALL = {
     "num_nodes": 16,
@@ -226,6 +231,47 @@ class TestMetricStormAcceptance:
         finally:
             server.shutdown()
             twin.close()
+
+
+class TestCommittedFuzzScenarios:
+    """Every minimized find committed under tests/scenarios/ is a
+    first-class regression (docs/robustness.md "Adversarial scenario
+    search"): auto-discovered, loaded through ``twin.load_scenario``,
+    and held to the replay contract — green on the healthy tree, and
+    (when the find came from a planted bug) still detecting its bug
+    class when the plant is re-applied.  Scenarios with no plant pin a
+    REAL bug that was fixed in-tree; green forever IS their assertion."""
+
+    def test_scenarios_are_committed(self):
+        # the suite below parametrizes over the directory; an empty
+        # glob would silently skip the whole contract
+        assert len(SCENARIO_FILES) >= 2, SCENARIO_DIR
+
+    @pytest.mark.parametrize(
+        "path", SCENARIO_FILES, ids=lambda p: p.stem
+    )
+    def test_replays_green_on_the_healthy_tree(self, path):
+        scenario = tw.load_scenario(path)
+        result = scenario.run()
+        assert result["passed"], _failures(result)
+
+    @pytest.mark.parametrize(
+        "path", SCENARIO_FILES, ids=lambda p: p.stem
+    )
+    def test_detects_its_bug_class_when_replanted(self, path):
+        scenario = tw.load_scenario(path)
+        if not scenario.planted:
+            pytest.skip(
+                "pins a fixed real bug — no plant to re-apply; the "
+                "healthy-tree replay above is the whole contract"
+            )
+        with fuzz.planted_bug(scenario.planted):
+            record = fuzz.run_candidate(scenario.genome)
+        assert set(scenario.expect) & set(record["failures"]), record
+
+    def test_loader_rejects_unknown_format(self):
+        with pytest.raises(ValueError, match="pas-fuzz-scenario"):
+            tw.load_scenario({"format": "pas-fuzz-scenario/999"})
 
 
 @pytest.mark.slow
